@@ -1,0 +1,34 @@
+"""distlr_tpu — a TPU-native distributed linear-model training framework.
+
+A from-scratch JAX/XLA/Pallas re-design of the capabilities of
+``future-xy/dist-lr`` (a C++ parameter-server logistic-regression trainer,
+see ``/root/reference``):
+
+* **Data layer** (:mod:`distlr_tpu.data`) — libsvm parsing (native C++ fast
+  path + pure-Python fallback), epoch iterators, seeded synthetic data,
+  shard generation.  Replaces ``include/data_iter.h`` / ``examples/gen_data.py``.
+* **Models** (:mod:`distlr_tpu.models`) — dense binary logistic regression,
+  multinomial softmax regression, sparse one-hot LR — all pure-functional
+  JAX.  Replaces ``src/lr.cc`` / ``include/lr.h``.
+* **Parallel** (:mod:`distlr_tpu.parallel`) — device meshes, synchronous
+  data parallelism via ``lax.psum`` over ICI, feature-axis (model) sharding
+  for very wide models.  Replaces the worker/server BSP protocol of
+  ``src/main.cc`` with a single compiled SPMD program.
+* **PS** (:mod:`distlr_tpu.ps`) — an asynchronous parameter-server mode:
+  a native C++ KV server with Push/Pull/Wait and deferred-response
+  barriers, the TPU-native equivalent of the ps-lite runtime the reference
+  links against.
+* **Train** (:mod:`distlr_tpu.train`) — trainer loops (sync SPMD and async
+  PS), metrics, checkpointing (orbax + reference-compatible text export).
+* **Launch** (:mod:`distlr_tpu.launch`) — single-host / multi-process
+  launcher replacing ``examples/local.sh``.
+
+The sync fast path is *one* jitted SPMD step: per-shard gradients are
+``lax.psum``-reduced over the mesh's ``data`` axis and the SGD update is
+applied replicated — the reference's Push/accumulate/apply/Pull round-trip
+(``src/main.cc:41-96``, ``src/lr.cc:116-132``) collapsed into a collective.
+"""
+
+__version__ = "0.1.0"
+
+from distlr_tpu.config import Config  # noqa: F401

@@ -1,0 +1,155 @@
+"""Typed configuration with reference-compatible environment-variable shim.
+
+The reference has no CLI parser: every knob is an environment variable read
+via ``ps::Environment::Get()->find`` with *no defaults* (missing vars crash
+— see reference ``src/main.cc:26-27,129-131,153-155`` and the complete
+contract in ``examples/local.sh:12-33``).  This module gives the same knobs
+a typed home with sane defaults, plus :meth:`Config.from_env` so a
+``local.sh``-style invocation (env-only) still works.
+
+Env-var compatibility table (reference ``examples/local.sh`` defaults):
+
+=================  ==========================  =======================
+Variable            Reference default           Config field
+=================  ==========================  =======================
+``SYNC_MODE``       1 (sync)                    ``sync_mode``
+``LEARNING_RATE``   0.2                         ``learning_rate``
+``DATA_DIR``        ./a9a-data                  ``data_dir``
+``NUM_FEATURE_DIM`` 123                         ``num_feature_dim``
+``NUM_ITERATION``   100                         ``num_iteration``
+``BATCH_SIZE``      -1 (full shard)             ``batch_size``
+``TEST_INTERVAL``   10                          ``test_interval``
+``RANDOM_SEED``     10 (never read by ref, Q2)  ``random_seed``
+``C``               (hardcoded 1 in ref)        ``l2_c``
+=================  ==========================  =======================
+
+Cluster-shape vars (``DMLC_NUM_WORKER`` etc.) map onto mesh / process
+configuration; see :mod:`distlr_tpu.parallel.mesh` and
+:mod:`distlr_tpu.launch`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Any, Mapping
+
+
+def _env(env: Mapping[str, str], name: str, cast, default):
+    raw = env.get(name)
+    if raw is None or raw == "":
+        return default
+    try:
+        return cast(raw)
+    except (TypeError, ValueError) as e:
+        raise ValueError(f"bad value for env var {name}={raw!r}: {e}") from e
+
+
+def _bool_from_int(raw: str) -> bool:
+    # Reference semantics: SYNC_MODE is sync iff the string is exactly "1"
+    # (strcmp in src/main.cc:26).
+    return raw.strip() == "1"
+
+
+@dataclasses.dataclass
+class Config:
+    """Full training configuration.
+
+    Defaults reproduce the reference launcher's defaults
+    (``examples/local.sh:12-19``) so `Config()` trains the same workload
+    ``local.sh`` does.
+    """
+
+    # ---- algorithm (reference env contract) ----
+    sync_mode: bool = True            # SYNC_MODE ("1" = BSP, else async/PS)
+    learning_rate: float = 0.2        # LEARNING_RATE (server-side SGD eta)
+    data_dir: str = "./a9a-data"      # DATA_DIR (train/ test/ models/ subdirs)
+    num_feature_dim: int = 123        # NUM_FEATURE_DIM (D)
+    num_iteration: int = 100          # NUM_ITERATION (outer epochs)
+    batch_size: int = -1              # BATCH_SIZE (-1 = full shard)
+    test_interval: int = 10           # TEST_INTERVAL (eval every k epochs)
+    random_seed: int = 10             # RANDOM_SEED (unused by ref — Q2)
+    l2_c: float = 1.0                 # L2 coefficient C (hardcoded 1 in ref lr.h:10)
+
+    # ---- model ----
+    model: str = "binary_lr"          # binary_lr | softmax | sparse_lr
+    num_classes: int = 2              # softmax only
+    dtype: str = "float32"            # accumulation dtype
+    compute_dtype: str = "bfloat16"   # matmul dtype on TPU (MXU-friendly)
+
+    # ---- parity / compat with reference quirks (SURVEY.md §3.5) ----
+    # "reference" reproduces documented quirks (Q1 last-gradient sync update,
+    # Q2 identical srand(0) init, Q4 L2/B scaling); "correct" is the fixed
+    # math. Each quirk is individually gated below; compat_mode sets defaults.
+    compat_mode: str = "correct"      # correct | reference
+    # Q4: divide the L2 term by batch size (reference does; correct doesn't).
+    l2_scale_by_batch: bool | None = None
+    # Q1: sync server applies last worker's gradient instead of the mean.
+    sync_last_gradient: bool | None = None
+    # Q2: init weights with C rand() after srand(0), uniform [0,1).
+    reference_rng_init: bool | None = None
+
+    # ---- parallelism ----
+    num_workers: int = 1              # data-parallel shards (DMLC_NUM_WORKER)
+    num_servers: int = 1              # PS mode server count (DMLC_NUM_SERVER)
+    mesh_shape: dict | None = None    # e.g. {"data": 8} / {"data": 4, "model": 2}
+    feature_shards: int = 1           # model-axis sharding of the feature dim
+
+    # ---- PS / async mode ----
+    ps_host: str = "127.0.0.1"        # DMLC_PS_ROOT_URI
+    ps_port: int = 8001               # DMLC_PS_ROOT_PORT
+
+    # ---- checkpoint / obs ----
+    checkpoint_dir: str | None = None
+    checkpoint_interval: int = 0      # epochs; 0 = only final save
+    profile_dir: str | None = None
+
+    def __post_init__(self):
+        ref = self.compat_mode == "reference"
+        if self.compat_mode not in ("correct", "reference"):
+            raise ValueError(f"compat_mode must be correct|reference, got {self.compat_mode!r}")
+        if self.l2_scale_by_batch is None:
+            self.l2_scale_by_batch = ref
+        if self.sync_last_gradient is None:
+            self.sync_last_gradient = ref
+        if self.reference_rng_init is None:
+            self.reference_rng_init = ref
+        if self.model not in ("binary_lr", "softmax", "sparse_lr"):
+            raise ValueError(f"unknown model {self.model!r}")
+        if self.num_feature_dim <= 0:
+            raise ValueError("num_feature_dim must be positive")
+        if self.batch_size == 0 or self.batch_size < -1:
+            raise ValueError("batch_size must be -1 (full shard) or positive")
+
+    # -- reference env-var shim ------------------------------------------------
+    @classmethod
+    def from_env(cls, env: Mapping[str, str] | None = None, **overrides: Any) -> "Config":
+        """Build a Config from the reference's env-var contract.
+
+        Unlike the reference (which segfaults on missing vars), absent vars
+        fall back to the launcher defaults above.
+        """
+        env = os.environ if env is None else env
+        kw: dict[str, Any] = dict(
+            sync_mode=_env(env, "SYNC_MODE", _bool_from_int, True),
+            learning_rate=_env(env, "LEARNING_RATE", float, 0.2),
+            data_dir=_env(env, "DATA_DIR", str, "./a9a-data"),
+            num_feature_dim=_env(env, "NUM_FEATURE_DIM", int, 123),
+            num_iteration=_env(env, "NUM_ITERATION", int, 100),
+            batch_size=_env(env, "BATCH_SIZE", int, -1),
+            test_interval=_env(env, "TEST_INTERVAL", int, 10),
+            random_seed=_env(env, "RANDOM_SEED", int, 10),
+            l2_c=_env(env, "C", float, 1.0),
+            num_workers=_env(env, "DMLC_NUM_WORKER", int, 1),
+            num_servers=_env(env, "DMLC_NUM_SERVER", int, 1),
+            ps_host=_env(env, "DMLC_PS_ROOT_URI", str, "127.0.0.1"),
+            ps_port=_env(env, "DMLC_PS_ROOT_PORT", int, 8001),
+        )
+        kw.update(overrides)
+        return cls(**kw)
+
+    def replace(self, **kw: Any) -> "Config":
+        return dataclasses.replace(self, **kw)
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)

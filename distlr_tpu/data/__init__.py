@@ -1,0 +1,4 @@
+from distlr_tpu.data.libsvm import parse_libsvm_file, parse_libsvm_lines, write_libsvm  # noqa: F401
+from distlr_tpu.data.iterator import DataIter  # noqa: F401
+from distlr_tpu.data.synthetic import make_synthetic_dataset, write_synthetic_shards  # noqa: F401
+from distlr_tpu.data.sharding import shard_libsvm_file, prepare_data_dir  # noqa: F401

@@ -1,0 +1,6 @@
+from distlr_tpu.models.linear import (  # noqa: F401
+    BinaryLR,
+    SoftmaxRegression,
+    SparseBinaryLR,
+    get_model,
+)

@@ -1,0 +1,227 @@
+"""Linear model family as pure-functional JAX: dense binary LR, multinomial
+softmax regression, and sparse (CSR) binary LR.
+
+Replaces the reference's ``distlr::LR`` (``src/lr.cc`` / ``include/lr.h``)
+whose hot loop is an O(B*D^2) scalar nest (``src/lr.cc:35-41``: it
+re-computes the full dot product w.x inside the per-feature loop and copies
+the feature vector per access).  Here each step is two MXU matmuls —
+``X @ w`` and ``X^T @ residual`` — O(B*D), bfloat16 on the MXU with float32
+accumulation.
+
+Every model exposes the same pure-function surface:
+
+* ``init(config) -> params``          (reference-RNG or He-style init)
+* ``loss(params, batch, cfg) -> scalar``  (mean logloss + L2)
+* ``grad(params, batch, cfg) -> params-like``  (closed form, quirk-gated)
+* ``predict(params, X) -> labels``
+* ``accuracy(params, batch) -> scalar``
+
+``batch`` is ``(X, y, mask)`` with a boolean mask for padded rows (static
+shapes; see :mod:`distlr_tpu.data.iterator`).  Gradients are closed-form
+rather than ``jax.grad`` of the loss so the reference's exact formula
+``(sigma(Xw) - y)^T X / B + C*w/B`` (``src/lr.cc:38-40``, quirk Q4) can be
+reproduced bit-for-bit in compat mode; a ``jax.grad`` path is kept in tests
+as the oracle for the "correct" mode.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from distlr_tpu.config import Config
+from distlr_tpu.utils.reference_rng import reference_init_weights
+
+
+def _masked_mean(values, mask):
+    denom = jnp.maximum(jnp.sum(mask), 1)
+    return jnp.sum(values * mask) / denom
+
+
+def _l2_grad(w, cfg: Config, batch_n):
+    # Q4 gate: reference divides the L2 term by the batch size
+    # (src/lr.cc:40); "correct" applies C*w un-scaled.
+    term = cfg.l2_c * w
+    return term / batch_n if cfg.l2_scale_by_batch else term
+
+
+@dataclasses.dataclass(frozen=True)
+class BinaryLR:
+    """Dense binary logistic regression: params = w of shape (D,)."""
+
+    num_features: int
+    # MXU-friendly matmul dtype; set "float32" for bit-level parity runs.
+    compute_dtype: str = "bfloat16"
+
+    def init(self, cfg: Config) -> jnp.ndarray:
+        if cfg.reference_rng_init:
+            # Q2 parity: srand(seed); rand()/RAND_MAX per weight.
+            # Reference default seed is 0 (lr.h:10), not RANDOM_SEED.
+            return jnp.asarray(reference_init_weights(self.num_features, 0))
+        key = jax.random.PRNGKey(cfg.random_seed)
+        return jax.random.uniform(key, (self.num_features,), dtype=jnp.float32)
+
+    def logits(self, w, X):
+        cdt = jnp.dtype(self.compute_dtype)
+        return jnp.dot(
+            X.astype(cdt),
+            w.astype(cdt),
+            preferred_element_type=jnp.float32,
+        )
+
+    def loss(self, w, batch, cfg: Config):
+        X, y, mask = batch
+        z = self.logits(w, X)
+        # logloss via softplus for stability: log(1+e^z) - y*z
+        ll = jax.nn.softplus(z) - y.astype(jnp.float32) * z
+        reg = 0.5 * cfg.l2_c * jnp.sum(w * w)
+        if cfg.l2_scale_by_batch:
+            reg = reg / jnp.maximum(jnp.sum(mask), 1)
+        return _masked_mean(ll, mask) + reg
+
+    def grad(self, w, batch, cfg: Config):
+        X, y, mask = batch
+        z = self.logits(w, X)
+        resid = (jax.nn.sigmoid(z) - y.astype(jnp.float32)) * mask
+        n = jnp.maximum(jnp.sum(mask), 1).astype(jnp.float32)
+        cdt = jnp.dtype(self.compute_dtype)
+        g = (
+            jnp.dot(
+                resid.astype(cdt),
+                X.astype(cdt),
+                preferred_element_type=jnp.float32,
+            )
+            / n
+        )
+        return g + _l2_grad(w, cfg, n)
+
+    def predict(self, w, X):
+        # Reference decision rule: z > 0 (src/lr.cc:100-106).
+        return (self.logits(w, X) > 0).astype(jnp.int32)
+
+    def accuracy(self, w, batch):
+        X, y, mask = batch
+        correct = (self.predict(w, X) == y).astype(jnp.float32)
+        return _masked_mean(correct, mask)
+
+
+@dataclasses.dataclass(frozen=True)
+class SoftmaxRegression:
+    """Multinomial softmax regression: params = W of shape (D, K)."""
+
+    num_features: int
+    num_classes: int
+    compute_dtype: str = "bfloat16"
+
+    def init(self, cfg: Config) -> jnp.ndarray:
+        shape = (self.num_features, self.num_classes)
+        if cfg.reference_rng_init:
+            flat = reference_init_weights(self.num_features * self.num_classes, 0)
+            return jnp.asarray(flat.reshape(shape))
+        key = jax.random.PRNGKey(cfg.random_seed)
+        return jax.random.uniform(key, shape, dtype=jnp.float32)
+
+    def logits(self, W, X):
+        cdt = jnp.dtype(self.compute_dtype)
+        return jnp.dot(
+            X.astype(cdt),
+            W.astype(cdt),
+            preferred_element_type=jnp.float32,
+        )
+
+    def loss(self, W, batch, cfg: Config):
+        X, y, mask = batch
+        z = self.logits(W, X)
+        ll = -jax.nn.log_softmax(z)[jnp.arange(z.shape[0]), y]
+        reg = 0.5 * cfg.l2_c * jnp.sum(W * W)
+        if cfg.l2_scale_by_batch:
+            reg = reg / jnp.maximum(jnp.sum(mask), 1)
+        return _masked_mean(ll, mask) + reg
+
+    def grad(self, W, batch, cfg: Config):
+        X, y, mask = batch
+        z = self.logits(W, X)
+        p = jax.nn.softmax(z)
+        onehot = jax.nn.one_hot(y, self.num_classes, dtype=jnp.float32)
+        resid = (p - onehot) * mask[:, None]
+        n = jnp.maximum(jnp.sum(mask), 1).astype(jnp.float32)
+        cdt = jnp.dtype(self.compute_dtype)
+        g = (
+            jnp.dot(
+                X.astype(cdt).T,
+                resid.astype(cdt),
+                preferred_element_type=jnp.float32,
+            )
+            / n
+        )
+        return g + _l2_grad(W, cfg, n)
+
+    def predict(self, W, X):
+        return jnp.argmax(self.logits(W, X), axis=-1).astype(jnp.int32)
+
+    def accuracy(self, W, batch):
+        X, y, mask = batch
+        correct = (self.predict(W, X) == y).astype(jnp.float32)
+        return _masked_mean(correct, mask)
+
+
+@dataclasses.dataclass(frozen=True)
+class SparseBinaryLR:
+    """Binary LR over padded-COO sparse batches (one-hot / CTR style).
+
+    A batch is ``(cols, vals, y, mask)`` where ``cols``/``vals`` are
+    ``(B, NNZ_MAX)`` padded per-row index/value arrays (pad col = 0,
+    pad val = 0).  The forward is a gather-dot; the gradient scatter is a
+    ``segment_sum`` over the flattened column ids — the TPU-friendly
+    sparse formulation (no dynamic shapes).
+    """
+
+    num_features: int
+
+    def init(self, cfg: Config) -> jnp.ndarray:
+        if cfg.reference_rng_init:
+            return jnp.asarray(reference_init_weights(self.num_features, 0))
+        key = jax.random.PRNGKey(cfg.random_seed)
+        return jax.random.uniform(key, (self.num_features,), dtype=jnp.float32)
+
+    def logits(self, w, cols, vals):
+        return jnp.sum(w[cols] * vals, axis=-1)
+
+    def loss(self, w, batch, cfg: Config):
+        cols, vals, y, mask = batch
+        z = self.logits(w, cols, vals)
+        ll = jax.nn.softplus(z) - y.astype(jnp.float32) * z
+        reg = 0.5 * cfg.l2_c * jnp.sum(w * w)
+        if cfg.l2_scale_by_batch:
+            reg = reg / jnp.maximum(jnp.sum(mask), 1)
+        return _masked_mean(ll, mask) + reg
+
+    def grad(self, w, batch, cfg: Config):
+        cols, vals, y, mask = batch
+        z = self.logits(w, cols, vals)
+        resid = (jax.nn.sigmoid(z) - y.astype(jnp.float32)) * mask
+        n = jnp.maximum(jnp.sum(mask), 1).astype(jnp.float32)
+        contrib = (resid[:, None] * vals).reshape(-1)
+        flat_cols = cols.reshape(-1)
+        g = jax.ops.segment_sum(contrib, flat_cols, num_segments=self.num_features) / n
+        return g + _l2_grad(w, cfg, n)
+
+    def predict(self, w, cols, vals):
+        return (self.logits(w, cols, vals) > 0).astype(jnp.int32)
+
+    def accuracy(self, w, batch):
+        cols, vals, y, mask = batch
+        correct = (self.predict(w, cols, vals) == y).astype(jnp.float32)
+        return _masked_mean(correct, mask)
+
+
+def get_model(cfg: Config):
+    if cfg.model == "binary_lr":
+        return BinaryLR(cfg.num_feature_dim, compute_dtype=cfg.compute_dtype)
+    if cfg.model == "softmax":
+        return SoftmaxRegression(cfg.num_feature_dim, cfg.num_classes, compute_dtype=cfg.compute_dtype)
+    if cfg.model == "sparse_lr":
+        return SparseBinaryLR(cfg.num_feature_dim)
+    raise ValueError(f"unknown model {cfg.model!r}")

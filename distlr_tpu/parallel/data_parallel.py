@@ -1,0 +1,117 @@
+"""Synchronous data-parallel training as one compiled SPMD program.
+
+This replaces the reference's entire BSP protocol — W workers each
+``Push``-ing a gradient, the server buffering ``KVMeta`` requests and
+withholding every ``Response`` until all ``NumWorkers()`` pushes arrived,
+then applying SGD and releasing the barrier (reference
+``src/main.cc:57-78``, ``src/lr.cc:116-132``) — with a single
+``shard_map``-ped step: per-shard gradients meet in a ``psum`` over the
+mesh's ``data`` axis (ICI collectives, no RPC), the SGD update is computed
+replicated, and the BSP barrier is implicit in the collective.
+
+Quirk Q1 (SURVEY.md §3.5): the reference's sync server applies the
+*last-arriving* worker's gradient divided by W — not the merged mean
+(``src/main.cc:63-77``).  ``cfg.sync_last_gradient`` reproduces that
+(deterministically: the highest-rank shard stands in for "last-arriving",
+which in the reference is a race); the default is the correct ``pmean``.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from distlr_tpu.config import Config
+from distlr_tpu.parallel.mesh import DATA_AXIS
+
+try:  # JAX >= 0.4.35 exports shard_map at top level
+    shard_map = jax.shard_map
+except AttributeError:  # pragma: no cover - older JAX
+    from jax.experimental.shard_map import shard_map  # type: ignore
+
+
+def _batch_spec(batch) -> tuple:
+    """Every leaf of the batch pytree is sharded along its leading (batch)
+    axis over ``data``."""
+    return jax.tree.map(lambda _: P(DATA_AXIS), batch)
+
+
+def make_sync_train_step(model, cfg: Config, mesh: Mesh, *, with_metrics: bool = True):
+    """Build the jitted sync step: ``step(w, batch) -> (w_new, metrics)``.
+
+    ``batch`` is the model's batch pytree (dense: ``(X, y, mask)``), with
+    leading axes divisible by the mesh's ``data`` size.  Weights are
+    donated, so the update is in-place in HBM.
+    """
+
+    def local_step(w, batch):
+        g_local = model.grad(w, batch, cfg)
+        axis_size = lax.psum(jnp.ones((), jnp.float32), DATA_AXIS)
+        if cfg.sync_last_gradient:
+            # Q1 compat: psum of (g_i masked to the top rank) == g_last;
+            # the reference then divides by the number of workers.
+            is_last = (lax.axis_index(DATA_AXIS) == lax.axis_size(DATA_AXIS) - 1)
+            g = lax.psum(jax.tree.map(lambda t: t * is_last, g_local), DATA_AXIS)
+            g = jax.tree.map(lambda t: t / axis_size, g)
+        else:
+            g = lax.pmean(g_local, DATA_AXIS)
+        w_new = jax.tree.map(lambda p, t: p - cfg.learning_rate * t, w, g)
+        if not with_metrics:
+            return w_new, {}
+        metrics = {
+            "loss": lax.pmean(model.loss(w, batch, cfg), DATA_AXIS),
+            "grad_norm": jnp.sqrt(
+                sum(jnp.sum(t * t) for t in jax.tree.leaves(g))
+            ),
+        }
+        return w_new, metrics
+
+    def step(w, batch):
+        return shard_map(
+            local_step,
+            mesh=mesh,
+            in_specs=(P(), _batch_spec(batch)),
+            out_specs=(P(), P()),
+        )(w, batch)
+
+    return jax.jit(step, donate_argnums=0)
+
+
+def make_eval_step(model, mesh: Mesh):
+    """Jitted global accuracy over a data-sharded eval batch.
+
+    Sums correct-prediction counts and mask counts with ``psum`` so the
+    result is the exact global masked accuracy (the reference evaluates on
+    rank 0 only over the full test set, ``src/lr.cc:47-63``)."""
+
+    def local_eval(w, batch):
+        *inputs, y, mask = batch
+        pred = model.predict(w, *inputs)
+        correct = lax.psum(jnp.sum((pred == y) * mask), DATA_AXIS)
+        total = lax.psum(jnp.sum(mask), DATA_AXIS)
+        return correct.astype(jnp.float32) / jnp.maximum(total, 1)
+
+    def evaluate(w, batch):
+        return shard_map(
+            local_eval,
+            mesh=mesh,
+            in_specs=(P(), _batch_spec(batch)),
+            out_specs=P(),
+        )(w, batch)
+
+    return jax.jit(evaluate)
+
+
+def shard_batch(batch, mesh: Mesh):
+    """Place a host batch pytree onto the mesh, sharded over ``data``.
+
+    Host->HBM streaming: the successor of the reference's per-step
+    ``DataIter`` -> ``Push``/``Pull`` flow (``include/data_iter.h`` +
+    ``src/lr.cc:116-132``)."""
+    return jax.tree.map(
+        lambda x: jax.device_put(x, NamedSharding(mesh, P(DATA_AXIS))), batch
+    )

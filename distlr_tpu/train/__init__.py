@@ -1,0 +1,3 @@
+from distlr_tpu.train.trainer import Trainer, GlobalShardedData  # noqa: F401
+from distlr_tpu.train.export import save_model_text, load_model_text  # noqa: F401
+from distlr_tpu.train.metrics import MetricsLogger, StepTimer  # noqa: F401

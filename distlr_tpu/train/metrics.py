@@ -1,0 +1,73 @@
+"""Structured per-step metrics — the observability layer the reference lacks.
+
+The reference emits exactly one metric ever (rank-0 test accuracy on
+stdout, ``src/lr.cc:56-62``).  Here every step can record loss, accuracy,
+samples/sec and step latency as structured records, optionally mirrored as
+JSON lines, while keeping the reference-format accuracy line for parity
+diffs (:func:`distlr_tpu.utils.logging.log_eval_line`).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+
+class StepTimer:
+    """Wall-clock step timer with samples/sec accounting.
+
+    Note: callers must block on device results (``jax.block_until_ready``)
+    before ``stop`` for honest timings — JAX dispatch is async.
+    """
+
+    def __init__(self):
+        self.steps = 0
+        self.samples = 0
+        self.elapsed = 0.0
+        self._t0 = None
+
+    def start(self):
+        self._t0 = time.perf_counter()
+
+    def stop(self, num_samples: int):
+        if self._t0 is None:
+            raise RuntimeError("StepTimer.stop() called without a matching start()")
+        self.elapsed += time.perf_counter() - self._t0
+        self.steps += 1
+        self.samples += num_samples
+        self._t0 = None
+
+    @property
+    def samples_per_sec(self) -> float:
+        return self.samples / self.elapsed if self.elapsed > 0 else 0.0
+
+    @property
+    def sec_per_step(self) -> float:
+        return self.elapsed / self.steps if self.steps else 0.0
+
+
+class MetricsLogger:
+    """Collects structured metric records; optional JSONL sink."""
+
+    def __init__(self, jsonl_path: str | None = None):
+        self.records: list[dict] = []
+        self._file = open(jsonl_path, "a") if jsonl_path else None
+
+    def log(self, **record) -> dict:
+        record.setdefault("time", time.time())
+        self.records.append(record)
+        if self._file:
+            self._file.write(json.dumps(record) + "\n")
+            self._file.flush()
+        return record
+
+    def close(self):
+        if self._file:
+            self._file.close()
+            self._file = None
+
+    def latest(self, key: str):
+        for rec in reversed(self.records):
+            if key in rec:
+                return rec[key]
+        return None

@@ -1,0 +1,217 @@
+"""Synchronous SPMD trainer — the role-collapsed successor of the
+reference's worker loop.
+
+Reference control flow (``src/main.cc:124-170`` + ``src/lr.cc:28-45``):
+each of W worker processes re-reads its libsvm shard every epoch, pulls the
+full weight vector, computes a mean gradient over its (full-shard) batch,
+pushes it, and blocks on the server's deferred response — the BSP barrier.
+Rank 0 evaluates every ``TEST_INTERVAL`` epochs and each worker text-dumps
+its weights at the end.
+
+Here the W workers become the ``data`` axis of one mesh and the whole
+epoch is minibatch steps of a single jitted SPMD program
+(:func:`distlr_tpu.parallel.make_sync_train_step`).  Shard->device-row
+mapping preserves the reference semantics: worker i's shard rows live on
+mesh position i, and with ``batch_size=-1`` each step consumes every
+worker's full shard, exactly one reference "iteration".
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+import numpy as np
+
+from distlr_tpu.config import Config
+from distlr_tpu.data import DataIter, parse_libsvm_file
+from distlr_tpu.data.sharding import part_name
+from distlr_tpu.models import get_model
+from distlr_tpu.parallel import (
+    make_eval_step,
+    make_mesh,
+    make_sync_train_step,
+)
+from distlr_tpu.parallel.data_parallel import shard_batch
+from distlr_tpu.parallel.mesh import num_data_shards
+from distlr_tpu.train.export import save_model_text
+from distlr_tpu.train.metrics import MetricsLogger, StepTimer
+from distlr_tpu.utils.logging import get_logger, log_eval_line
+
+log = get_logger(__name__)
+
+
+class GlobalShardedData:
+    """W per-worker shards packed as one global array with lockstep batching.
+
+    Shards are padded to a common length ``n_pad`` and stacked to
+    ``(W, n_pad, ...)``; a global minibatch of per-worker size ``b`` is the
+    flattened ``(W*b, ...)`` slice ``[:, k*b:(k+1)*b]`` with a validity
+    mask.  Laying worker i's rows contiguously at block i makes a plain
+    leading-axis ``data`` sharding put each reference-worker's shard on its
+    own mesh slot.
+    """
+
+    def __init__(self, shards: list[tuple[np.ndarray, np.ndarray]]):
+        if not shards:
+            raise ValueError("need at least one shard")
+        self.num_shards = len(shards)
+        self.shard_sizes = [len(y) for _, y in shards]
+        n_pad = max(self.shard_sizes)
+        feat_shape = shards[0][0].shape[1:]
+        W = self.num_shards
+        self.X = np.zeros((W, n_pad) + feat_shape, dtype=shards[0][0].dtype)
+        self.y = np.zeros((W, n_pad), dtype=shards[0][1].dtype)
+        self.mask = np.zeros((W, n_pad), dtype=np.float32)
+        for i, (Xi, yi) in enumerate(shards):
+            self.X[i, : len(yi)] = Xi
+            self.y[i, : len(yi)] = yi
+            self.mask[i, : len(yi)] = 1.0
+        self.n_pad = n_pad
+
+    @classmethod
+    def from_data_dir(cls, data_dir: str, split: str, num_shards: int, num_features: int, *, multiclass=False):
+        """Load ``data_dir/{split}/part-001..W`` (reference layout,
+        ``src/main.cc:158-159``). If fewer parts exist than mesh shards,
+        parts are round-robined; if more, they are concatenated down."""
+        paths = []
+        i = 0
+        while True:
+            p = os.path.join(data_dir, split, part_name(i))
+            if not os.path.exists(p):
+                break
+            paths.append(p)
+            i += 1
+        if not paths:
+            raise FileNotFoundError(f"no shards under {data_dir}/{split}")
+        parts = [parse_libsvm_file(p, num_features, multiclass=multiclass) for p in paths]
+        if len(parts) != num_shards:
+            X = np.concatenate([p[0] for p in parts])
+            y = np.concatenate([p[1] for p in parts])
+            shards = [
+                (X[i::num_shards], y[i::num_shards]) for i in range(num_shards)
+            ]
+        else:
+            shards = parts
+        return cls(shards)
+
+    @property
+    def num_samples(self) -> int:
+        return int(sum(self.shard_sizes))
+
+    def batches(self, per_worker_batch: int):
+        """One epoch of lockstep global batches ``(X, y, mask)`` shaped
+        ``(W*b, ...)``. ``-1`` = full shard per worker (one step/epoch)."""
+        b = self.n_pad if per_worker_batch == -1 else min(per_worker_batch, self.n_pad)
+        for k in range(-(-self.n_pad // b)):
+            sl = slice(k * b, min((k + 1) * b, self.n_pad))
+            bw = sl.stop - sl.start
+            X = self.X[:, sl].reshape((-1,) + self.X.shape[2:])
+            y = self.y[:, sl].reshape(-1)
+            mask = self.mask[:, sl].reshape(-1)
+            if bw < b:  # pad the short final batch to static shape
+                pad = b - bw
+                W = self.num_shards
+                X = np.concatenate(
+                    [X.reshape(W, bw, -1), np.zeros((W, pad, X.shape[-1]), X.dtype)], axis=1
+                ).reshape(W * b, -1)
+                y = np.concatenate([y.reshape(W, bw), np.zeros((W, pad), y.dtype)], axis=1).reshape(-1)
+                mask = np.concatenate(
+                    [mask.reshape(W, bw), np.zeros((W, pad), mask.dtype)], axis=1
+                ).reshape(-1)
+            yield X, y, mask
+
+    def full_batch(self):
+        X = self.X.reshape((-1,) + self.X.shape[2:])
+        return X, self.y.reshape(-1), self.mask.reshape(-1)
+
+
+class Trainer:
+    """End-to-end sync training: data -> mesh -> SPMD steps -> eval -> export."""
+
+    def __init__(self, cfg: Config, *, mesh=None, metrics: MetricsLogger | None = None):
+        if cfg.model == "sparse_lr":
+            # The padded-COO data path is served by SparseBinaryLR directly;
+            # Trainer's shard loader is dense-only for now.
+            raise NotImplementedError(
+                "Trainer supports dense models (binary_lr, softmax); drive "
+                "sparse_lr via distlr_tpu.models.SparseBinaryLR directly"
+            )
+        self.cfg = cfg
+        self.mesh = mesh if mesh is not None else make_mesh(cfg.mesh_shape)
+        self.model = get_model(cfg)
+        self.metrics = metrics or MetricsLogger()
+        self.train_step = make_sync_train_step(self.model, cfg, self.mesh)
+        self.eval_step = make_eval_step(self.model, self.mesh)
+        self.timer = StepTimer()
+        self.weights = None
+        self._train_data: GlobalShardedData | None = None
+        self._test_data: GlobalShardedData | None = None
+
+    # -- data ---------------------------------------------------------------
+    def load_data(self, train: GlobalShardedData | None = None, test: GlobalShardedData | None = None):
+        W = num_data_shards(self.mesh)
+        multiclass = self.cfg.model == "softmax"
+        self._train_data = train or GlobalShardedData.from_data_dir(
+            self.cfg.data_dir, "train", W, self.cfg.num_feature_dim, multiclass=multiclass
+        )
+        self._test_data = test or GlobalShardedData.from_data_dir(
+            self.cfg.data_dir, "test", W, self.cfg.num_feature_dim, multiclass=multiclass
+        )
+        return self
+
+    # -- training -----------------------------------------------------------
+    def init_weights(self):
+        self.weights = jax.device_put(
+            self.model.init(self.cfg),
+            jax.sharding.NamedSharding(self.mesh, jax.sharding.PartitionSpec()),
+        )
+        return self.weights
+
+    def fit(self, *, epochs: int | None = None, eval_fn=None):
+        """Run the full training loop; returns final weights.
+
+        ``eval_fn(epoch, accuracy)`` is called at each test interval
+        (default: print the reference-format line)."""
+        cfg = self.cfg
+        if self._train_data is None:
+            self.load_data()
+        if self.weights is None:
+            self.init_weights()
+        epochs = cfg.num_iteration if epochs is None else epochs
+        test_batch = None
+        if self._test_data is not None:
+            test_batch = shard_batch(self._test_data.full_batch(), self.mesh)
+
+        for epoch in range(epochs):
+            for host_batch in self._train_data.batches(cfg.batch_size):
+                batch = shard_batch(host_batch, self.mesh)
+                self.timer.start()
+                self.weights, step_metrics = self.train_step(self.weights, batch)
+                jax.block_until_ready(self.weights)
+                self.timer.stop(int(host_batch[2].sum()))
+            if test_batch is not None and cfg.test_interval > 0 and (epoch + 1) % cfg.test_interval == 0:
+                acc = float(self.eval_step(self.weights, test_batch))
+                self.metrics.log(
+                    epoch=epoch + 1,
+                    accuracy=acc,
+                    loss=float(step_metrics["loss"]),
+                    samples_per_sec=self.timer.samples_per_sec,
+                )
+                if eval_fn is not None:
+                    eval_fn(epoch + 1, acc)
+                else:
+                    log_eval_line(epoch + 1, acc)
+        return self.weights
+
+    def evaluate(self) -> float:
+        test_batch = shard_batch(self._test_data.full_batch(), self.mesh)
+        return float(self.eval_step(self.weights, test_batch))
+
+    def save_model(self, path: str | None = None) -> str:
+        """Text export, reference format & layout (``models/part-001``)."""
+        if path is None:
+            path = os.path.join(self.cfg.data_dir, "models", part_name(0))
+            os.makedirs(os.path.dirname(path), exist_ok=True)
+        save_model_text(path, np.asarray(self.weights))
+        return path

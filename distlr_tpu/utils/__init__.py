@@ -1,0 +1,1 @@
+from distlr_tpu.utils.logging import check, check_eq, get_logger, log_eval_line  # noqa: F401

@@ -1,0 +1,53 @@
+"""Structured logging + CHECK-style invariants.
+
+Replaces the reference's only two observability mechanisms: dmlc-style
+fatal ``CHECK``/``CHECK_EQ`` macros (reference ``src/main.cc:49,86``) and
+the timestamped stdout eval line (``src/lr.cc:56-62``).  Unlike the
+reference, failed checks raise a structured exception instead of aborting
+the process, and eval output is also available as structured records.
+"""
+
+from __future__ import annotations
+
+import logging
+import sys
+import time
+
+
+class CheckError(AssertionError):
+    """Invariant violation — the framework's equivalent of a failed CHECK."""
+
+
+def check(cond: bool, msg: str = "") -> None:
+    if not cond:
+        raise CheckError(f"Check failed: {msg}")
+
+
+def check_eq(a, b, msg: str = "") -> None:
+    if a != b:
+        raise CheckError(f"Check failed: {a!r} != {b!r}. {msg}")
+
+
+_FORMAT = "%(asctime)s %(levelname).1s %(name)s] %(message)s"
+
+
+def get_logger(name: str = "distlr_tpu") -> logging.Logger:
+    logger = logging.getLogger(name)
+    if not logger.handlers:
+        handler = logging.StreamHandler(sys.stderr)
+        handler.setFormatter(logging.Formatter(_FORMAT, datefmt="%H:%M:%S"))
+        logger.addHandler(handler)
+        logger.setLevel(logging.INFO)
+        logger.propagate = False
+    return logger
+
+
+def log_eval_line(iteration: int, accuracy: float, *, stream=None) -> str:
+    """Emit the reference-format eval line: ``HH:MM:SS Iteration N, accuracy: A``.
+
+    Format-compatible with reference ``src/lr.cc:56-62`` so convergence
+    trajectories can be diffed line-for-line against a reference run.
+    """
+    line = f"{time.strftime('%H:%M:%S')} Iteration {iteration}, accuracy: {accuracy:g}"
+    print(line, file=stream if stream is not None else sys.stdout, flush=True)
+    return line

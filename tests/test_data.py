@@ -1,0 +1,142 @@
+import numpy as np
+import pytest
+
+from distlr_tpu.data import DataIter, parse_libsvm_lines, write_libsvm
+from distlr_tpu.data.sharding import part_name, prepare_data_dir, shard_libsvm_file
+from distlr_tpu.data.synthetic import make_synthetic_dataset, write_synthetic_shards
+
+
+SAMPLE = """\
++1 3:1 11:0.5 14:-2.5
+-1 1:1e-2 6:1
+1 2:0.25
+-1 4:3
+"""
+
+
+class TestLibsvmParse:
+    def test_dense_shapes_and_values(self):
+        X, y = parse_libsvm_lines(SAMPLE, num_features=16)
+        assert X.shape == (4, 16) and X.dtype == np.float32
+        assert y.tolist() == [1, 0, 1, 0]  # !=1 -> 0 rule (ref Q7)
+        assert X[0, 2] == 1 and X[0, 10] == 0.5
+        # signed + scientific values parse correctly (unlike ref ToFloat, Q6)
+        assert X[0, 13] == -2.5
+        assert X[1, 0] == pytest.approx(0.01)
+
+    def test_csr_output(self):
+        (row_ptr, cols, vals), y = parse_libsvm_lines(SAMPLE, dense=False)
+        assert row_ptr.tolist() == [0, 3, 5, 6, 7]
+        assert cols[:3].tolist() == [2, 10, 13]
+        assert len(vals) == 7 and len(y) == 4
+
+    def test_multiclass_labels(self):
+        text = "3 1:1\n0 2:1\n7 1:0.5\n"
+        _, y = parse_libsvm_lines(text, num_features=4, multiclass=True)
+        assert y.tolist() == [3, 0, 7]
+
+    def test_out_of_range_features_dropped(self):
+        X, y = parse_libsvm_lines("1 2:1 100:5\n", num_features=4)
+        assert X.shape == (1, 4) and X[0, 1] == 1
+
+    def test_roundtrip(self, tmp_path):
+        rng = np.random.default_rng(0)
+        X = (rng.random((10, 8)) * (rng.random((10, 8)) > 0.5)).astype(np.float32)
+        y = rng.integers(0, 2, 10).astype(np.int32)
+        p = tmp_path / "part-001"
+        write_libsvm(p, X, y, binary_pm1=True)
+        X2, y2 = parse_libsvm_lines(p.read_text(), num_features=8)
+        np.testing.assert_allclose(X, X2, rtol=1e-5)
+        np.testing.assert_array_equal(y, y2)
+
+
+class TestDataIter:
+    def _data(self, n=10, d=3):
+        X = np.arange(n * d, dtype=np.float32).reshape(n, d)
+        y = np.arange(n, dtype=np.int32) % 2
+        return X, y
+
+    def test_full_batch_minus_one(self):
+        X, y = self._data()
+        it = DataIter(X, y, batch_size=-1)
+        bx, by, mask = it.next_batch()
+        assert bx.shape == (10, 3) and mask.all()
+        assert not it.has_next()  # one batch == one epoch
+
+    def test_padding_final_batch(self):
+        X, y = self._data(10)
+        it = DataIter(X, y, batch_size=4)
+        batches = list(it)
+        assert len(batches) == 3
+        bx, by, mask = batches[-1]
+        assert bx.shape == (4, 3)  # static shape
+        assert mask.tolist() == [True, True, False, False]
+
+    def test_wrap_compat_reproduces_q5(self):
+        X, y = self._data(10)
+        it = DataIter(X, y, batch_size=4, wrap_compat=True)
+        batches = list(it)
+        bx, by, mask = batches[-1]
+        assert mask.all()
+        np.testing.assert_array_equal(bx[2], X[0])  # head duplicated
+        np.testing.assert_array_equal(bx[3], X[1])
+
+    def test_drop_remainder(self):
+        X, y = self._data(10)
+        it = DataIter(X, y, batch_size=4, drop_remainder=True)
+        assert len(list(it)) == 2
+
+    def test_shuffle_deterministic(self):
+        X, y = self._data(16)
+        a = DataIter(X, y, 16, shuffle=True, seed=7).next_batch()[0]
+        b = DataIter(X, y, 16, shuffle=True, seed=7).next_batch()[0]
+        c = DataIter(X, y, 16, shuffle=True, seed=8).next_batch()[0]
+        np.testing.assert_array_equal(a, b)
+        assert not np.array_equal(a, c)
+
+    def test_reset_restarts_epoch(self):
+        X, y = self._data()
+        it = DataIter(X, y, batch_size=5)
+        list(it)
+        assert not it.has_next()
+        it.reset()
+        assert it.has_next()
+
+
+class TestShardingAndSynthetic:
+    def test_shard_file(self, tmp_path):
+        src = tmp_path / "all"
+        src.write_text("".join(f"1 1:{i}\n" for i in range(10)))
+        paths = shard_libsvm_file(str(src), str(tmp_path / "train"), 3, seed=1)
+        assert [p.split("/")[-1] for p in paths] == ["part-001", "part-002", "part-003"]
+        total = sum(len(open(p).readlines()) for p in paths)
+        assert total == 10
+
+    def test_prepare_data_dir_layout(self, tmp_path):
+        src = tmp_path / "train_src"
+        src.write_text("".join(f"1 1:{i}\n" for i in range(8)))
+        tsrc = tmp_path / "test_src"
+        tsrc.write_text("1 1:9\n")
+        man = prepare_data_dir(str(src), str(tsrc), str(tmp_path / "data"), num_parts=2)
+        assert (tmp_path / "data/train/part-001").exists()
+        assert (tmp_path / "data/test/part-001").exists()
+        assert (tmp_path / "data/models").is_dir()
+        assert len(man["train_parts"]) == 2
+
+    def test_synthetic_deterministic_and_learnable(self):
+        X1, y1, w1 = make_synthetic_dataset(1000, 20, seed=3)
+        X2, y2, w2 = make_synthetic_dataset(1000, 20, seed=3)
+        np.testing.assert_array_equal(X1, X2)
+        np.testing.assert_array_equal(y1, y2)
+        # labels correlate with the true logistic signal
+        agree = ((X1 @ w1 > 0).astype(int) == y1).mean()
+        assert agree > 0.8
+
+    def test_write_synthetic_shards(self, tmp_path):
+        man = write_synthetic_shards(str(tmp_path / "d"), 50, 10, 2, seed=0)
+        assert len(man["train_parts"]) == 2
+        X, y = parse_libsvm_lines(open(man["test_path"]).read(), num_features=10)
+        assert X.shape[1] == 10 and set(np.unique(y)) <= {0, 1}
+
+    def test_part_name_format(self):
+        assert part_name(0) == "part-001" and part_name(11) == "part-012"

@@ -1,0 +1,156 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distlr_tpu.config import Config
+from distlr_tpu.models import BinaryLR, SoftmaxRegression, SparseBinaryLR, get_model
+from distlr_tpu.utils.reference_rng import GLIBC_RAND_MAX, glibc_rand_sequence, reference_init_weights
+
+
+def dense_batch(n=32, d=10, seed=0, masked=0):
+    rng = np.random.default_rng(seed)
+    X = rng.standard_normal((n, d)).astype(np.float32)
+    y = rng.integers(0, 2, n).astype(np.int32)
+    mask = np.ones(n, dtype=np.float32)
+    if masked:
+        mask[-masked:] = 0.0
+    return jnp.asarray(X), jnp.asarray(y), jnp.asarray(mask)
+
+
+class TestReferenceRNG:
+    def test_glibc_sequence_known_values(self):
+        # First glibc rand() outputs after srand(0) / srand(10),
+        # verified against a compiled C program on this machine.
+        assert glibc_rand_sequence(0, 3).tolist() == [1804289383, 846930886, 1681692777]
+        assert glibc_rand_sequence(10, 2).tolist() == [1215069295, 1311962008]
+
+    def test_reference_init_range_and_determinism(self):
+        w = reference_init_weights(123, 0)
+        assert w.shape == (123,) and w.dtype == np.float32
+        assert (w >= 0).all() and (w <= 1).all()
+        np.testing.assert_array_equal(w, reference_init_weights(123, 0))
+        assert w[0] == np.float32(np.float32(1804289383) / np.float32(GLIBC_RAND_MAX))
+
+
+class TestBinaryLR:
+    def test_grad_matches_autodiff_correct_mode(self):
+        cfg = Config(compat_mode="correct", l2_c=0.3)
+        model = BinaryLR(10)
+        batch = dense_batch()
+        w = jnp.asarray(np.random.default_rng(1).standard_normal(10), dtype=jnp.float32)
+        g_closed = model.grad(w, batch, cfg)
+        g_auto = jax.grad(lambda w_: model.loss(w_, batch, cfg))(w)
+        np.testing.assert_allclose(np.asarray(g_closed), np.asarray(g_auto), atol=2e-2)
+
+    def test_grad_matches_reference_formula(self):
+        # (sigma(Xw) - y)^T X / B + C*w/B  (src/lr.cc:38-40, quirk Q4)
+        cfg = Config(compat_mode="reference", l2_c=1.0)
+        model = BinaryLR(8)
+        X, y, mask = dense_batch(16, 8, seed=2)
+        w = jnp.linspace(-1, 1, 8)
+        g = np.asarray(model.grad(w, (X, y, mask), cfg))
+        Xn, yn, wn = np.asarray(X), np.asarray(y), np.asarray(w)
+        sig = 1 / (1 + np.exp(-(Xn @ wn)))
+        expect = (sig - yn) @ Xn / 16 + 1.0 * wn / 16
+        np.testing.assert_allclose(g, expect, atol=2e-2)
+
+    def test_masked_rows_do_not_contribute(self):
+        cfg = Config()
+        model = BinaryLR(10)
+        X, y, mask = dense_batch(32, 10, masked=8)
+        g_masked = model.grad(jnp.zeros(10), (X, y, mask), cfg)
+        g_trunc = model.grad(
+            jnp.zeros(10), (X[:24], y[:24], mask[:24]), cfg
+        )
+        np.testing.assert_allclose(np.asarray(g_masked), np.asarray(g_trunc), atol=1e-5)
+
+    def test_predict_rule_z_gt_0(self):
+        model = BinaryLR(2)
+        w = jnp.asarray([1.0, 0.0])
+        X = jnp.asarray([[2.0, 0.0], [-2.0, 0.0], [0.0, 5.0]])
+        assert model.predict(w, X).tolist() == [1, 0, 0]  # z==0 -> class 0
+
+    def test_init_reference_vs_prng(self):
+        model = BinaryLR(50)
+        w_ref = model.init(Config(compat_mode="reference"))
+        np.testing.assert_array_equal(np.asarray(w_ref), reference_init_weights(50, 0))
+        w_prng = model.init(Config(compat_mode="correct", random_seed=3))
+        assert not np.array_equal(np.asarray(w_ref), np.asarray(w_prng))
+
+    def test_accuracy(self):
+        model = BinaryLR(1)
+        w = jnp.asarray([1.0])
+        X = jnp.asarray([[1.0], [-1.0], [1.0], [-1.0]])
+        y = jnp.asarray([1, 0, 0, 1])
+        mask = jnp.asarray([1.0, 1.0, 1.0, 0.0])
+        assert float(model.accuracy(w, (X, y, mask))) == pytest.approx(2 / 3)
+
+
+class TestSoftmax:
+    def test_grad_matches_autodiff(self):
+        cfg = Config(model="softmax", num_classes=4, l2_c=0.1, num_feature_dim=6)
+        model = SoftmaxRegression(6, 4)
+        rng = np.random.default_rng(0)
+        X = jnp.asarray(rng.standard_normal((20, 6)), dtype=jnp.float32)
+        y = jnp.asarray(rng.integers(0, 4, 20), dtype=jnp.int32)
+        mask = jnp.ones(20)
+        W = jnp.asarray(rng.standard_normal((6, 4)), dtype=jnp.float32)
+        g_closed = model.grad(W, (X, y, mask), cfg)
+        g_auto = jax.grad(lambda w_: model.loss(w_, (X, y, mask), cfg))(W)
+        np.testing.assert_allclose(np.asarray(g_closed), np.asarray(g_auto), atol=2e-2)
+
+    def test_learns_separable_data(self):
+        cfg = Config(model="softmax", num_classes=3, num_feature_dim=8, l2_c=0.0)
+        model = SoftmaxRegression(8, 3)
+        rng = np.random.default_rng(1)
+        Wtrue = rng.standard_normal((8, 3))
+        X = rng.standard_normal((300, 8)).astype(np.float32)
+        y = np.argmax(X @ Wtrue, axis=1).astype(np.int32)
+        batch = (jnp.asarray(X), jnp.asarray(y), jnp.ones(300))
+        W = jnp.zeros((8, 3))
+        for _ in range(200):
+            W = W - 0.5 * model.grad(W, batch, cfg)
+        assert float(model.accuracy(W, batch)) > 0.9
+
+
+class TestSparseLR:
+    def _sparse_from_dense(self, X):
+        # pad-COO: (B, NNZ_MAX) cols/vals
+        n = X.shape[0]
+        nnz = max(int((X[i] != 0).sum()) for i in range(n))
+        cols = np.zeros((n, nnz), dtype=np.int32)
+        vals = np.zeros((n, nnz), dtype=np.float32)
+        for i in range(n):
+            (idx,) = np.nonzero(X[i])
+            cols[i, : len(idx)] = idx
+            vals[i, : len(idx)] = X[i, idx]
+        return jnp.asarray(cols), jnp.asarray(vals)
+
+    def test_matches_dense_model(self):
+        cfg = Config(l2_c=0.2)
+        rng = np.random.default_rng(0)
+        X = (rng.standard_normal((16, 12)) * (rng.random((16, 12)) > 0.6)).astype(np.float32)
+        y = rng.integers(0, 2, 16).astype(np.int32)
+        mask = np.ones(16, dtype=np.float32)
+        w = rng.standard_normal(12).astype(np.float32)
+        dense = BinaryLR(12)
+        sparse = SparseBinaryLR(12)
+        cols, vals = self._sparse_from_dense(X)
+        g_d = dense.grad(jnp.asarray(w), (jnp.asarray(X), jnp.asarray(y), jnp.asarray(mask)), cfg)
+        g_s = sparse.grad(jnp.asarray(w), (cols, vals, jnp.asarray(y), jnp.asarray(mask)), cfg)
+        np.testing.assert_allclose(np.asarray(g_d), np.asarray(g_s), atol=2e-2)
+        np.testing.assert_allclose(
+            float(dense.loss(jnp.asarray(w), (jnp.asarray(X), jnp.asarray(y), jnp.asarray(mask)), cfg)),
+            float(sparse.loss(jnp.asarray(w), (cols, vals, jnp.asarray(y), jnp.asarray(mask)), cfg)),
+            atol=1e-2,
+        )
+
+
+class TestGetModel:
+    def test_dispatch(self):
+        assert isinstance(get_model(Config()), BinaryLR)
+        assert isinstance(get_model(Config(model="softmax")), SoftmaxRegression)
+        assert isinstance(get_model(Config(model="sparse_lr")), SparseBinaryLR)
+        with pytest.raises(ValueError):
+            Config(model="nope")

@@ -1,0 +1,118 @@
+import os
+
+import numpy as np
+import pytest
+
+from distlr_tpu.config import Config
+from distlr_tpu.data.synthetic import make_synthetic_dataset, write_synthetic_shards
+from distlr_tpu.parallel import make_mesh
+from distlr_tpu.train import GlobalShardedData, Trainer, load_model_text, save_model_text
+from distlr_tpu.utils.logging import log_eval_line
+
+
+@pytest.fixture(scope="module")
+def data_dir(tmp_path_factory):
+    d = tmp_path_factory.mktemp("synth")
+    write_synthetic_shards(str(d), 1600, 24, num_parts=8, seed=0, sparsity=0.0)
+    return str(d)
+
+
+class TestGlobalShardedData:
+    def test_padding_and_lockstep_batches(self):
+        shards = [
+            (np.ones((5, 2), np.float32) * i, np.full(5, i % 2, np.int32)) for i in range(3)
+        ]
+        shards[2] = (np.ones((3, 2), np.float32) * 2, np.full(3, 0, np.int32))
+        g = GlobalShardedData(shards)
+        assert g.num_samples == 13 and g.n_pad == 5
+        X, y, mask = next(iter(g.batches(2)))
+        assert X.shape == (6, 2)  # 3 shards x per-worker batch 2
+        assert mask.sum() == 6
+        batches = list(g.batches(2))
+        assert len(batches) == 3
+        last_mask = batches[-1][2].reshape(3, -1)
+        assert last_mask[2].sum() == 0  # short shard's padding is masked
+
+    def test_full_shard_batch(self):
+        shards = [(np.zeros((4, 2), np.float32), np.zeros(4, np.int32))] * 2
+        g = GlobalShardedData(shards)
+        batches = list(g.batches(-1))
+        assert len(batches) == 1 and batches[0][0].shape == (8, 2)
+
+    def test_from_data_dir_resharding(self, data_dir):
+        g = GlobalShardedData.from_data_dir(data_dir, "train", 4, 24)
+        assert g.num_shards == 4
+        g8 = GlobalShardedData.from_data_dir(data_dir, "train", 8, 24)
+        assert g8.num_shards == 8
+        assert g.num_samples == g8.num_samples
+
+
+class TestTrainerEndToEnd:
+    def test_converges_on_synthetic(self, data_dir):
+        cfg = Config(
+            data_dir=data_dir,
+            num_feature_dim=24,
+            num_iteration=60,
+            learning_rate=0.5,
+            l2_c=0.0,
+            batch_size=-1,
+            test_interval=30,
+        )
+        mesh = make_mesh({"data": 8})
+        tr = Trainer(cfg, mesh=mesh).load_data()
+        evals = []
+        tr.fit(eval_fn=lambda ep, acc: evals.append((ep, acc)))
+        assert [ep for ep, _ in evals] == [30, 60]
+        final_acc = tr.evaluate()
+        assert final_acc > 0.8, f"final accuracy {final_acc}"
+        # accuracy improved over training
+        assert evals[-1][1] >= evals[0][1] - 0.02
+
+    def test_reference_compat_mode_runs(self, data_dir):
+        cfg = Config(
+            data_dir=data_dir,
+            num_feature_dim=24,
+            num_iteration=5,
+            compat_mode="reference",
+            test_interval=5,
+        )
+        tr = Trainer(cfg, mesh=make_mesh({"data": 8})).load_data()
+        w = tr.fit()
+        assert np.isfinite(np.asarray(w)).all()
+
+    def test_save_model_reference_format(self, data_dir, tmp_path):
+        cfg = Config(data_dir=data_dir, num_feature_dim=24, num_iteration=1, test_interval=10)
+        tr = Trainer(cfg, mesh=make_mesh({"data": 8})).load_data()
+        tr.fit(epochs=1)
+        path = tr.save_model()
+        assert path.endswith(os.path.join("models", "part-001"))
+        lines = open(path).read().splitlines()
+        assert lines[0] == "24"
+        w = load_model_text(path)
+        np.testing.assert_allclose(w, np.asarray(tr.weights), rtol=1e-4)
+
+    def test_minibatch_training(self, data_dir):
+        cfg = Config(
+            data_dir=data_dir, num_feature_dim=24, num_iteration=10,
+            batch_size=32, learning_rate=0.3, l2_c=0.0, test_interval=10,
+        )
+        tr = Trainer(cfg, mesh=make_mesh({"data": 8})).load_data()
+        tr.fit()
+        assert tr.evaluate() > 0.75
+        assert tr.timer.samples > 0 and tr.timer.samples_per_sec > 0
+
+
+class TestExport:
+    def test_text_roundtrip(self, tmp_path):
+        w = np.random.default_rng(0).standard_normal(17).astype(np.float32)
+        p = str(tmp_path / "m")
+        save_model_text(p, w)
+        w2 = load_model_text(p)
+        np.testing.assert_allclose(w, w2, rtol=1e-5)
+
+    def test_eval_line_format(self, capsys):
+        line = log_eval_line(10, 0.8472)
+        out = capsys.readouterr().out.strip()
+        assert out == line
+        import re
+        assert re.fullmatch(r"\d{2}:\d{2}:\d{2} Iteration 10, accuracy: 0\.8472", line)
