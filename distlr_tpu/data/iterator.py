@@ -74,8 +74,11 @@ class DataIter:
         b, n = self.batch_size, self.num_samples
         idx = self._order[self._offset : self._offset + b]
         if len(idx) < b and self.wrap_compat:
-            # Q5 parity: wrap around and duplicate head samples (data_iter.h:46-53).
-            idx = np.concatenate([idx, self._order[: b - len(idx)]])
+            # Q5 parity: wrap around and duplicate head samples, cycling as
+            # many times as needed (the reference's NextBatch loop keeps
+            # walking modulo the shard, data_iter.h:46-53).
+            extra = np.take(self._order, np.arange(b - len(idx)), mode="wrap")
+            idx = np.concatenate([idx, extra])
         self._offset += b
         real = len(idx)
         mask = np.ones(b, dtype=bool)

@@ -107,8 +107,8 @@ def parse_libsvm_lines(
 
     Args:
       text_or_lines: a str/bytes blob or an iterable of lines.
-      num_features: D. Required for dense output; for CSR output it is
-        inferred as ``max(col)+1`` when omitted.
+      num_features: D. Required for dense output; optional for CSR output
+        (used only to filter out-of-range columns).
       dense: if True return ``(X: (N,D) f32, y: (N,) i32)``; else return
         CSR ``((row_ptr, cols, vals), y)`` with out-of-range columns
         dropped when ``num_features`` is given (same rule as dense).

@@ -30,7 +30,9 @@ def shard_libsvm_file(
 ) -> list[str]:
     """Shuffle (seeded) and split a libsvm text file into equal shards."""
     with open(src_path) as f:
-        lines = [ln for ln in f if ln.strip()]
+        # normalize endings: a missing final newline must not fuse two
+        # samples into one line after shuffling
+        lines = [ln.rstrip("\n") + "\n" for ln in f if ln.strip()]
     if shuffle:
         random.Random(seed).shuffle(lines)
     os.makedirs(out_dir, exist_ok=True)

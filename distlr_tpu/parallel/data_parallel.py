@@ -50,11 +50,11 @@ def make_sync_train_step(model, cfg: Config, mesh: Mesh, *, with_metrics: bool =
 
     def local_step(w, batch):
         g_local = model.grad(w, batch, cfg)
-        axis_size = lax.psum(jnp.ones((), jnp.float32), DATA_AXIS)
         if cfg.sync_last_gradient:
             # Q1 compat: psum of (g_i masked to the top rank) == g_last;
             # the reference then divides by the number of workers.
-            is_last = (lax.axis_index(DATA_AXIS) == lax.axis_size(DATA_AXIS) - 1)
+            axis_size = lax.axis_size(DATA_AXIS)
+            is_last = (lax.axis_index(DATA_AXIS) == axis_size - 1)
             g = lax.psum(jax.tree.map(lambda t: t * is_last, g_local), DATA_AXIS)
             g = jax.tree.map(lambda t: t / axis_size, g)
         else:

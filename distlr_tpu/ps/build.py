@@ -1,0 +1,42 @@
+"""Build/locate the native PS components (server binary + client .so).
+
+The reference gets its runtime from a prebuilt submodule + vendored
+libzmq; here the native pieces live in-tree (``ps/native``) and build on
+demand with ``make`` — no external deps beyond a C++17 toolchain.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import threading
+
+_lock = threading.Lock()
+
+
+def native_dir() -> str:
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)), "native")
+
+
+def server_binary() -> str:
+    return os.path.join(native_dir(), "distlr_kv_server")
+
+
+def client_lib() -> str:
+    return os.path.join(native_dir(), "libdistlr_kv.so")
+
+
+def build_native(force: bool = False) -> None:
+    """Idempotently ``make`` the native components."""
+    with _lock:
+        if not force and os.path.exists(server_binary()) and os.path.exists(client_lib()):
+            return
+        proc = subprocess.run(
+            ["make", "-C", native_dir()] + (["clean", "all"] if force else ["all"]),
+            capture_output=True,
+            text=True,
+        )
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"native PS build failed:\n{proc.stdout}\n{proc.stderr}"
+            )

@@ -1,0 +1,112 @@
+"""Python KV worker — ctypes binding over the native client library.
+
+API mirror of ps-lite's ``KVWorker<float>`` as used by the reference
+(``Push``/``Pull``/``Wait``, call sites ``src/lr.cc:116-132``,
+``src/main.cc:135-148``), so the async/PS training loop reads like the
+reference worker while the gradient math runs in JAX on the chip.
+"""
+
+from __future__ import annotations
+
+import ctypes
+
+import numpy as np
+
+from distlr_tpu.ps.build import build_native, client_lib
+
+_lib = None
+
+
+def _load():
+    global _lib
+    if _lib is None:
+        build_native()
+        lib = ctypes.CDLL(client_lib())
+        lib.kv_connect.restype = ctypes.c_void_p
+        lib.kv_connect.argtypes = [ctypes.c_char_p, ctypes.c_uint64, ctypes.c_uint32]
+        lib.kv_push.restype = ctypes.c_int
+        lib.kv_push.argtypes = [ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p, ctypes.c_uint64]
+        lib.kv_pull.restype = ctypes.c_int
+        lib.kv_pull.argtypes = [ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p, ctypes.c_uint64]
+        lib.kv_barrier.restype = ctypes.c_int
+        lib.kv_barrier.argtypes = [ctypes.c_void_p]
+        lib.kv_wait.restype = ctypes.c_int
+        lib.kv_wait.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.kv_shutdown_servers.restype = ctypes.c_int
+        lib.kv_shutdown_servers.argtypes = [ctypes.c_void_p]
+        lib.kv_last_error.restype = ctypes.c_char_p
+        lib.kv_last_error.argtypes = [ctypes.c_void_p]
+        lib.kv_close.argtypes = [ctypes.c_void_p]
+        _lib = lib
+    return _lib
+
+
+class KVWorker:
+    """Blocking Push/Pull/Wait client over a range-sharded server group."""
+
+    def __init__(self, hosts: str, dim: int, client_id: int = 0):
+        lib = _load()
+        self._lib = lib
+        self.dim = dim
+        self._h = lib.kv_connect(hosts.encode(), dim, client_id)
+        if not self._h:
+            raise ConnectionError(f"could not connect to KV servers at {hosts}")
+        # dense default key set 0..D-1, like the reference app (src/lr.cc:117-121)
+        self._all_keys = np.arange(dim, dtype=np.uint64)
+
+    def _check(self, ts: int, what: str) -> int:
+        if ts < 0:
+            err = self._lib.kv_last_error(self._h).decode()
+            raise IOError(f"KV {what} failed: {err}")
+        return ts
+
+    def push(self, vals: np.ndarray, keys: np.ndarray | None = None) -> int:
+        """Blocking push; in sync mode returns only after ALL workers
+        pushed (the server's deferred reply = BSP barrier)."""
+        vals = np.ascontiguousarray(vals, dtype=np.float32)
+        keys = self._all_keys if keys is None else np.ascontiguousarray(keys, dtype=np.uint64)
+        if vals.shape[0] != keys.shape[0]:
+            raise ValueError(f"{vals.shape[0]} vals vs {keys.shape[0]} keys")
+        ts = self._lib.kv_push(
+            self._h,
+            keys.ctypes.data_as(ctypes.c_void_p),
+            vals.ctypes.data_as(ctypes.c_void_p),
+            keys.shape[0],
+        )
+        return self._check(ts, "push")
+
+    def pull(self, keys: np.ndarray | None = None) -> np.ndarray:
+        keys = self._all_keys if keys is None else np.ascontiguousarray(keys, dtype=np.uint64)
+        out = np.empty(keys.shape[0], dtype=np.float32)
+        ts = self._lib.kv_pull(
+            self._h,
+            keys.ctypes.data_as(ctypes.c_void_p),
+            out.ctypes.data_as(ctypes.c_void_p),
+            keys.shape[0],
+        )
+        self._check(ts, "pull")
+        return out
+
+    def wait(self, ts: int) -> None:
+        """No-op for API parity: push/pull already block (the reference
+        pairs every Push/Pull with an immediate Wait)."""
+        self._lib.kv_wait(self._h, ts)
+
+    def barrier(self) -> None:
+        """Worker-group barrier via server 0 (Postoffice::Barrier
+        equivalent, reference src/main.cc:150)."""
+        self._check(self._lib.kv_barrier(self._h), "barrier")
+
+    def shutdown_servers(self) -> None:
+        self._lib.kv_shutdown_servers(self._h)
+
+    def close(self) -> None:
+        if self._h:
+            self._lib.kv_close(self._h)
+            self._h = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

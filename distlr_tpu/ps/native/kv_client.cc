@@ -1,0 +1,253 @@
+// libdistlr_kv — native KV client with a plain-C API (consumed from
+// Python via ctypes; see distlr_tpu/ps/client.py).
+//
+// The worker-side equivalent of ps-lite's KVWorker<float>
+// (reference call sites: ctor src/main.cc:135, Push src/lr.cc:131,
+// Pull src/lr.cc:122, Wait everywhere).  Requests over multiple servers
+// are range-sliced exactly like ps-lite's key partition: server r of S
+// owns global keys [r*D/S, (r+1)*D/S), and each slice is rebased to a
+// server-local key — the client-side mirror of DecodeKey
+// (src/main.cc:98-101).
+//
+// Blocking semantics: kv_push/kv_pull send the request to every
+// involved server, then block until all responses arrive.  The reference
+// always pairs Push/Pull with an immediate Wait (src/lr.cc:122,131,
+// src/main.cc:147), so a blocking call is semantically identical — and
+// in sync mode the server's deferred reply makes kv_push the BSP
+// barrier, same as the reference.  kv_wait exists for API parity and is
+// a no-op.
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <algorithm>
+#include <cstring>
+#include <cstdio>
+#include <cstdlib>
+#include <string>
+#include <vector>
+
+#include "kv_protocol.h"
+
+namespace distlr {
+namespace {
+
+struct ServerConn {
+  int fd = -1;
+  Key range_begin = 0;  // inclusive global key
+  Key range_end = 0;    // exclusive global key
+};
+
+struct Client {
+  std::vector<ServerConn> servers;
+  uint64_t dim = 0;
+  uint32_t client_id = 0;
+  uint32_t next_ts = 0;
+  char err[256] = {0};
+};
+
+bool ReadFull(int fd, void* buf, size_t n) {
+  auto* p = static_cast<char*>(buf);
+  while (n > 0) {
+    ssize_t r = read(fd, p, n);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+bool WriteFull(int fd, const void* buf, size_t n) {
+  const auto* p = static_cast<const char*>(buf);
+  while (n > 0) {
+    // MSG_NOSIGNAL: a dead peer yields EPIPE instead of SIGPIPE, so
+    // non-Python consumers of this library survive server loss too.
+    ssize_t r = send(fd, p, n, MSG_NOSIGNAL);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+int ConnectTo(const std::string& host, int port) {
+  int fd = socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return -1;
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (inet_pton(AF_INET, host.c_str(), &addr.sin_addr) != 1) {
+    close(fd);
+    return -1;
+  }
+  if (connect(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) < 0) {
+    close(fd);
+    return -1;
+  }
+  int one = 1;
+  setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  return fd;
+}
+
+// Slice [keys, keys+n) (sorted ascending, global ids) into per-server
+// contiguous sub-ranges.  Returns per-server (begin_idx, end_idx).
+std::vector<std::pair<uint64_t, uint64_t>> SliceByRange(
+    const Client& c, const Key* keys, uint64_t n) {
+  std::vector<std::pair<uint64_t, uint64_t>> out(c.servers.size());
+  for (size_t s = 0; s < c.servers.size(); ++s) {
+    const Key* lo = std::lower_bound(keys, keys + n, c.servers[s].range_begin);
+    const Key* hi = std::lower_bound(keys, keys + n, c.servers[s].range_end);
+    out[s] = {static_cast<uint64_t>(lo - keys), static_cast<uint64_t>(hi - keys)};
+  }
+  return out;
+}
+
+int RoundTrip(Client* c, Op op, const Key* keys, const float* vals,
+              float* out_vals, uint64_t n) {
+  const uint32_t ts = c->next_ts++;
+  auto slices = SliceByRange(*c, keys, n);
+
+  // Phase 1: send the sliced request to every involved server.
+  std::vector<std::vector<Key>> local_keys(c->servers.size());
+  for (size_t s = 0; s < c->servers.size(); ++s) {
+    const auto [b, e] = slices[s];
+    if (b == e && !(op == Op::kBarrier && s == 0)) continue;
+    MsgHeader h{kMagic, static_cast<uint8_t>(op), kNone, 0,
+                c->client_id, ts, e - b};
+    auto& lk = local_keys[s];
+    lk.resize(e - b);
+    for (uint64_t i = b; i < e; ++i)
+      lk[i - b] = keys[i] - c->servers[s].range_begin;  // DecodeKey rebase
+    const int fd = c->servers[s].fd;
+    if (!WriteFull(fd, &h, sizeof(h)) ||
+        (h.num_keys && !WriteFull(fd, lk.data(), lk.size() * sizeof(Key))) ||
+        (op == Op::kPush && h.num_keys &&
+         !WriteFull(fd, vals + b, (e - b) * sizeof(Val)))) {
+      snprintf(c->err, sizeof(c->err), "send to server %zu failed", s);
+      return -1;
+    }
+  }
+
+  // Phase 2: collect every response (blocks through deferred replies —
+  // in sync mode this wait IS the BSP barrier).
+  for (size_t s = 0; s < c->servers.size(); ++s) {
+    const auto [b, e] = slices[s];
+    if (b == e && !(op == Op::kBarrier && s == 0)) continue;
+    MsgHeader rh{};
+    if (!ReadFull(c->servers[s].fd, &rh, sizeof(rh)) || rh.magic != kMagic ||
+        !(rh.flags & kResponse) || rh.timestamp != ts) {
+      snprintf(c->err, sizeof(c->err), "bad response from server %zu", s);
+      return -1;
+    }
+    if (rh.num_keys) {
+      std::vector<Val> buf(rh.num_keys);
+      if (!ReadFull(c->servers[s].fd, buf.data(), rh.num_keys * sizeof(Val))) {
+        snprintf(c->err, sizeof(c->err), "short response from server %zu", s);
+        return -1;
+      }
+      if (op == Op::kPull && out_vals != nullptr) {
+        if (rh.num_keys != e - b) {
+          snprintf(c->err, sizeof(c->err),
+                   "pull size mismatch from server %zu", s);
+          return -1;
+        }
+        std::memcpy(out_vals + b, buf.data(), buf.size() * sizeof(Val));
+      }
+    }
+  }
+  return static_cast<int>(ts);
+}
+
+}  // namespace
+}  // namespace distlr
+
+extern "C" {
+
+// hosts: comma-separated "ip:port" list, one per server, in server-rank
+// order.  dim: total key-space size D (used for the range partition).
+void* kv_connect(const char* hosts, uint64_t dim, uint32_t client_id) {
+  auto* c = new distlr::Client();
+  c->dim = dim;
+  c->client_id = client_id;
+  std::string spec(hosts);
+  std::vector<std::string> parts;
+  size_t pos = 0;
+  while (pos != std::string::npos) {
+    size_t comma = spec.find(',', pos);
+    parts.push_back(spec.substr(pos, comma == std::string::npos ? comma : comma - pos));
+    pos = comma == std::string::npos ? comma : comma + 1;
+  }
+  const size_t S = parts.size();
+  for (size_t s = 0; s < S; ++s) {
+    size_t colon = parts[s].rfind(':');
+    if (colon == std::string::npos) { delete c; return nullptr; }
+    const std::string host = parts[s].substr(0, colon);
+    const int port = std::atoi(parts[s].c_str() + colon + 1);
+    int fd = distlr::ConnectTo(host, port);
+    if (fd < 0) {
+      for (auto& sc : c->servers) close(sc.fd);
+      delete c;
+      return nullptr;
+    }
+    distlr::ServerConn sc;
+    sc.fd = fd;
+    // ps-lite-style equal contiguous ranges over [0, dim).
+    sc.range_begin = dim * s / S;
+    sc.range_end = dim * (s + 1) / S;
+    c->servers.push_back(sc);
+  }
+  return c;
+}
+
+// keys must be sorted ascending global ids; returns ts >= 0, or -1.
+int kv_push(void* handle, const uint64_t* keys, const float* vals, uint64_t n) {
+  auto* c = static_cast<distlr::Client*>(handle);
+  return distlr::RoundTrip(c, distlr::Op::kPush, keys, vals, nullptr, n);
+}
+
+int kv_pull(void* handle, const uint64_t* keys, float* out_vals, uint64_t n) {
+  auto* c = static_cast<distlr::Client*>(handle);
+  return distlr::RoundTrip(c, distlr::Op::kPull, keys, nullptr, out_vals, n);
+}
+
+// Group barrier via server 0 (Postoffice::Barrier equivalent).
+int kv_barrier(void* handle) {
+  auto* c = static_cast<distlr::Client*>(handle);
+  return distlr::RoundTrip(c, distlr::Op::kBarrier, nullptr, nullptr, nullptr, 0);
+}
+
+// No-op: kv_push/kv_pull already block until completion (see header
+// comment); kept so the Python surface mirrors KVWorker::Wait.
+int kv_wait(void* handle, int ts) {
+  (void)handle;
+  (void)ts;
+  return 0;
+}
+
+int kv_shutdown_servers(void* handle) {
+  auto* c = static_cast<distlr::Client*>(handle);
+  int rc = 0;
+  for (size_t s = 0; s < c->servers.size(); ++s) {
+    distlr::MsgHeader h{distlr::kMagic, static_cast<uint8_t>(distlr::Op::kShutdown),
+                        distlr::kNone, 0, c->client_id, c->next_ts++, 0};
+    if (!distlr::WriteFull(c->servers[s].fd, &h, sizeof(h))) rc = -1;
+    distlr::MsgHeader rh{};
+    distlr::ReadFull(c->servers[s].fd, &rh, sizeof(rh));
+  }
+  return rc;
+}
+
+const char* kv_last_error(void* handle) {
+  return static_cast<distlr::Client*>(handle)->err;
+}
+
+void kv_close(void* handle) {
+  auto* c = static_cast<distlr::Client*>(handle);
+  for (auto& sc : c->servers) close(sc.fd);
+  delete c;
+}
+
+}  // extern "C"

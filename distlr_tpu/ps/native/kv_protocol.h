@@ -1,0 +1,68 @@
+// Wire protocol for the distlr_tpu KV parameter server.
+//
+// TPU-native re-design of the ps-lite worker<->server RPC surface the
+// reference links against (reconstructed API in SURVEY.md §2.2 E1.d-f:
+// KVWorker::Push/Pull/Wait, KVServer with deferred Response, KVMeta.push
+// discriminator, SArray<Key>/SArray<Val> payloads).  This replaces
+// ZeroMQ + protobuf with a minimal length-prefixed binary framing over
+// TCP (the DCN control/data plane; the on-chip sync path never touches
+// this — it is lax.psum over ICI).
+//
+// Frame layout (little-endian, no padding):
+//   MsgHeader { magic, op, flags, client_id, timestamp, num_keys }
+//   then num_keys * u64 keys
+//   then (op == PUSH || (op == PULL && is_response)) num_keys * f32 vals
+//
+// Semantics mirror the reference server handle (src/main.cc:41-96):
+//   * first PUSH initializes server weights (src/main.cc:50-56)
+//   * sync mode: PUSH responses are DEFERRED until num_workers pushes
+//     arrive, then one SGD update is applied and all responses released
+//     at once — the reply is the BSP barrier (src/main.cc:57-78)
+//   * async mode: SGD applied per PUSH, reply immediate (src/main.cc:79-84)
+//   * PULL replies the current weight slice (src/main.cc:85-95)
+//   * BARRIER: counted per-group, released when num_workers reached
+//     (Postoffice::Barrier equivalent, src/main.cc:150)
+
+#ifndef DISTLR_TPU_PS_KV_PROTOCOL_H_
+#define DISTLR_TPU_PS_KV_PROTOCOL_H_
+
+#include <cstdint>
+
+namespace distlr {
+
+constexpr uint32_t kMagic = 0xD157C0DE;
+
+enum class Op : uint8_t {
+  kPush = 1,
+  kPull = 2,
+  kBarrier = 3,
+  kShutdown = 4,
+  kHello = 5,   // worker registration: client_id announces itself
+};
+
+enum Flags : uint8_t {
+  kNone = 0,
+  kResponse = 1,
+  kError = 2,
+};
+
+#pragma pack(push, 1)
+struct MsgHeader {
+  uint32_t magic;
+  uint8_t op;
+  uint8_t flags;
+  uint16_t reserved;
+  uint32_t client_id;
+  uint32_t timestamp;   // per-client op sequence number (ps-lite ts)
+  uint64_t num_keys;
+};
+#pragma pack(pop)
+
+static_assert(sizeof(MsgHeader) == 24, "MsgHeader must be 24 bytes");
+
+using Key = uint64_t;
+using Val = float;
+
+}  // namespace distlr
+
+#endif  // DISTLR_TPU_PS_KV_PROTOCOL_H_

@@ -1,0 +1,295 @@
+// distlr_kv_server — native parameter-server process.
+//
+// The TPU framework's host-side equivalent of the reference's
+// KVStoreDistServer<float> + the ps-lite runtime it rides on
+// (reference src/main.cc:17-114; ps-lite API surface per SURVEY.md §2.2).
+// One process owns one contiguous key range of the model ("server rank"
+// r of S owns [r*D/S, (r+1)*D/S) — the GetServerKeyRanges partition,
+// src/main.cc:98-101).  Workers connect over TCP (DCN in multi-host
+// deployments); each connection gets a receive thread, and all state
+// mutations are serialized by a single mutex — the same effective
+// serialization ps-lite's single recv thread gave the reference handler
+// ("threadsafe" comment, src/main.cc:40).
+//
+// Behavior contract (mirrors DataHandle, src/main.cc:41-96):
+//   * first PUSH initializes the weight slice and replies immediately
+//   * sync mode: PUSH replies are withheld until `num_workers` distinct
+//     pushes arrive; then ONE SGD update is applied and all replies are
+//     released together — the deferred reply is the BSP barrier
+//   * async mode (Hogwild): SGD applied immediately per PUSH
+//   * PULL replies the current weights for the requested keys
+//   * BARRIER is released once `num_workers` requests are pending
+//   * Q1 compat flag (--last_gradient): reproduce the reference bug of
+//     applying only the last-arriving gradient / W (src/main.cc:70-72)
+//     instead of the merged mean
+//
+// Usage:
+//   distlr_kv_server --port=P --num_workers=W --dim=D [--lr=0.2]
+//                    [--sync=1] [--last_gradient=0] [--key_offset=0]
+//
+// The server is dimension-elastic: --dim pre-sizes the slice, but any
+// key seen in a PUSH grows storage (keys are server-local after the
+// client rebases them by the range start, exactly like DecodeKey,
+// src/main.cc:98-101).
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <condition_variable>
+#include <cstring>
+#include <cstdio>
+#include <cstdlib>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+#include "kv_protocol.h"
+
+namespace distlr {
+
+struct PendingPush {
+  int fd;
+  MsgHeader header;       // echoed back (with kResponse) on release
+};
+
+class KVServer {
+ public:
+  KVServer(int port, int num_workers, uint64_t dim, float lr, bool sync,
+           bool last_gradient)
+      : port_(port), num_workers_(num_workers), lr_(lr), sync_(sync),
+        last_gradient_(last_gradient) {
+    weights_.resize(dim, 0.0f);
+  }
+
+  int Run() {
+    listen_fd_ = socket(AF_INET, SOCK_STREAM, 0);
+    if (listen_fd_ < 0) { perror("socket"); return 1; }
+    int one = 1;
+    setsockopt(listen_fd_, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+    addr.sin_port = htons(static_cast<uint16_t>(port_));
+    if (bind(listen_fd_, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) < 0) {
+      perror("bind");
+      return 1;
+    }
+    if (listen(listen_fd_, 128) < 0) { perror("listen"); return 1; }
+    fprintf(stderr, "[distlr_kv_server] listening on 127.0.0.1:%d "
+            "(workers=%d dim=%zu sync=%d lr=%g)\n",
+            port_, num_workers_, weights_.size(), sync_ ? 1 : 0, lr_);
+    fflush(stderr);
+
+    std::vector<std::thread> conns;
+    while (!shutdown_.load()) {
+      int fd = accept(listen_fd_, nullptr, nullptr);
+      if (fd < 0) {
+        if (shutdown_.load()) break;
+        continue;
+      }
+      setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+      conns.emplace_back(&KVServer::Serve, this, fd);
+    }
+    for (auto& t : conns) t.join();
+    close(listen_fd_);
+    return 0;
+  }
+
+ private:
+  static bool ReadFull(int fd, void* buf, size_t n) {
+    auto* p = static_cast<char*>(buf);
+    while (n > 0) {
+      ssize_t r = read(fd, p, n);
+      if (r <= 0) return false;
+      p += r;
+      n -= static_cast<size_t>(r);
+    }
+    return true;
+  }
+
+  static bool WriteFull(int fd, const void* buf, size_t n) {
+    const auto* p = static_cast<const char*>(buf);
+    while (n > 0) {
+      ssize_t r = write(fd, p, n);
+      if (r <= 0) return false;
+      p += r;
+      n -= static_cast<size_t>(r);
+    }
+    return true;
+  }
+
+  void Serve(int fd) {
+    std::vector<Key> keys;
+    std::vector<Val> vals;
+    while (true) {
+      MsgHeader h{};
+      if (!ReadFull(fd, &h, sizeof(h)) || h.magic != kMagic) break;
+      keys.resize(h.num_keys);
+      if (h.num_keys && !ReadFull(fd, keys.data(), h.num_keys * sizeof(Key))) break;
+      const Op op = static_cast<Op>(h.op);
+      if (op == Op::kPush) {
+        vals.resize(h.num_keys);
+        if (h.num_keys && !ReadFull(fd, vals.data(), h.num_keys * sizeof(Val))) break;
+        HandlePush(fd, h, keys, vals);
+      } else if (op == Op::kPull) {
+        HandlePull(fd, h, keys);
+      } else if (op == Op::kBarrier) {
+        HandleBarrier(fd, h);
+      } else if (op == Op::kHello) {
+        Respond(fd, h, nullptr, 0);
+      } else if (op == Op::kShutdown) {
+        Respond(fd, h, nullptr, 0);
+        shutdown_.store(true);
+        // unblock accept()
+        ::shutdown(listen_fd_, SHUT_RDWR);
+        break;
+      }
+    }
+    close(fd);
+  }
+
+  void Respond(int fd, MsgHeader h, const Val* vals, uint64_t nvals) {
+    h.flags |= kResponse;
+    h.num_keys = nvals;
+    // Responses carry vals only (keys are implied by the request).
+    WriteFull(fd, &h, sizeof(h));
+    if (nvals) WriteFull(fd, vals, nvals * sizeof(Val));
+  }
+
+  void EnsureCapacity(Key max_key) {
+    if (max_key >= weights_.size()) {
+      weights_.resize(max_key + 1, 0.0f);
+      merge_.resize(weights_.size(), 0.0f);
+    }
+  }
+
+  // --- PUSH: the reference DataHandle push branch (src/main.cc:48-84) ---
+  void HandlePush(int fd, const MsgHeader& h, const std::vector<Key>& keys,
+                  const std::vector<Val>& vals) {
+    std::unique_lock<std::mutex> lock(mu_);
+    if (!keys.empty()) EnsureCapacity(keys.back());
+
+    if (!initialized_) {
+      // First push seeds the weights (src/main.cc:50-56).
+      for (size_t i = 0; i < keys.size(); ++i) weights_[keys[i]] = vals[i];
+      initialized_ = true;
+      lock.unlock();
+      Respond(fd, h, nullptr, 0);
+      return;
+    }
+
+    if (!sync_) {
+      // Async/Hogwild: apply immediately (src/main.cc:79-84).
+      for (size_t i = 0; i < keys.size(); ++i)
+        weights_[keys[i]] -= lr_ * vals[i];
+      lock.unlock();
+      Respond(fd, h, nullptr, 0);
+      return;
+    }
+
+    // Sync/BSP: merge and defer the response (src/main.cc:57-78).
+    if (merge_.size() < weights_.size()) merge_.resize(weights_.size(), 0.0f);
+    for (size_t i = 0; i < keys.size(); ++i) merge_[keys[i]] += vals[i];
+    last_push_keys_ = keys;
+    last_push_vals_ = vals;
+    pending_.push_back({fd, h});
+
+    if (static_cast<int>(pending_.size()) == num_workers_) {
+      const float w = static_cast<float>(num_workers_);
+      if (last_gradient_) {
+        // Q1 compat: apply only the last-arriving gradient / W
+        // (the reference reads req_data.vals, src/main.cc:70-72).
+        for (size_t i = 0; i < last_push_keys_.size(); ++i)
+          weights_[last_push_keys_[i]] -= lr_ * last_push_vals_[i] / w;
+      } else {
+        // Correct BSP: mean of the merged gradients.
+        for (size_t i = 0; i < merge_.size(); ++i)
+          weights_[i] -= lr_ * merge_[i] / w;
+      }
+      std::fill(merge_.begin(), merge_.end(), 0.0f);
+      std::vector<PendingPush> release;
+      release.swap(pending_);
+      lock.unlock();
+      // Releasing every deferred reply at once IS the BSP barrier.
+      for (auto& p : release) Respond(p.fd, p.header, nullptr, 0);
+    }
+  }
+
+  // --- PULL: reply current weights (src/main.cc:85-95) ---
+  void HandlePull(int fd, const MsgHeader& h, const std::vector<Key>& keys) {
+    std::vector<Val> out(keys.size());
+    {
+      std::lock_guard<std::mutex> lock(mu_);
+      if (!keys.empty()) EnsureCapacity(keys.back());
+      for (size_t i = 0; i < keys.size(); ++i) out[i] = weights_[keys[i]];
+    }
+    Respond(fd, h, out.data(), out.size());
+  }
+
+  // --- BARRIER: Postoffice::Barrier equivalent (src/main.cc:150) ---
+  void HandleBarrier(int fd, const MsgHeader& h) {
+    std::vector<PendingPush> release;
+    {
+      std::lock_guard<std::mutex> lock(mu_);
+      barrier_.push_back({fd, h});
+      if (static_cast<int>(barrier_.size()) < num_workers_) return;
+      release.swap(barrier_);
+    }
+    for (auto& p : release) Respond(p.fd, p.header, nullptr, 0);
+  }
+
+  int port_;
+  int num_workers_;
+  float lr_;
+  bool sync_;
+  bool last_gradient_;
+  int listen_fd_ = -1;
+  std::atomic<bool> shutdown_{false};
+
+  std::mutex mu_;
+  bool initialized_ = false;
+  std::vector<Val> weights_;
+  std::vector<Val> merge_;
+  std::vector<Key> last_push_keys_;
+  std::vector<Val> last_push_vals_;
+  std::vector<PendingPush> pending_;
+  std::vector<PendingPush> barrier_;
+};
+
+}  // namespace distlr
+
+static long Arg(int argc, char** argv, const char* name, long dflt) {
+  const std::string prefix = std::string("--") + name + "=";
+  for (int i = 1; i < argc; ++i) {
+    if (std::string(argv[i]).rfind(prefix, 0) == 0)
+      return std::atol(argv[i] + prefix.size());
+  }
+  return dflt;
+}
+
+static double ArgF(int argc, char** argv, const char* name, double dflt) {
+  const std::string prefix = std::string("--") + name + "=";
+  for (int i = 1; i < argc; ++i) {
+    if (std::string(argv[i]).rfind(prefix, 0) == 0)
+      return std::atof(argv[i] + prefix.size());
+  }
+  return dflt;
+}
+
+int main(int argc, char** argv) {
+  const int port = static_cast<int>(Arg(argc, argv, "port", 8001));
+  const int num_workers = static_cast<int>(Arg(argc, argv, "num_workers", 1));
+  const long dim = Arg(argc, argv, "dim", 0);
+  const double lr = ArgF(argc, argv, "lr", 0.2);
+  const bool sync = Arg(argc, argv, "sync", 1) != 0;
+  const bool last_gradient = Arg(argc, argv, "last_gradient", 0) != 0;
+  distlr::KVServer server(port, num_workers, static_cast<uint64_t>(dim),
+                          static_cast<float>(lr), sync, last_gradient);
+  return server.Run();
+}

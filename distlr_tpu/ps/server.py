@@ -1,0 +1,105 @@
+"""Server-group lifecycle management.
+
+Replaces the reference launcher's server-spawning half
+(``examples/local.sh:36-41``: S ``distlr`` processes with
+``DMLC_ROLE=server``) with a context-managed group of native
+``distlr_kv_server`` processes, one per key range.
+"""
+
+from __future__ import annotations
+
+import socket
+import subprocess
+import time
+
+from distlr_tpu.ps.build import build_native, server_binary
+from distlr_tpu.utils.logging import get_logger
+
+log = get_logger(__name__)
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+class ServerGroup:
+    """Spawn and manage S native KV server processes on localhost.
+
+    Server rank ``r`` owns global keys ``[r*D/S, (r+1)*D/S)`` — the
+    ps-lite range partition (reference ``src/main.cc:98-101``); the
+    client library slices requests to match.
+    """
+
+    def __init__(
+        self,
+        num_servers: int,
+        num_workers: int,
+        dim: int,
+        *,
+        learning_rate: float = 0.2,
+        sync: bool = True,
+        last_gradient: bool = False,
+        ports: list[int] | None = None,
+    ):
+        build_native()
+        self.num_servers = num_servers
+        self.num_workers = num_workers
+        self.dim = dim
+        self.ports = ports or [free_port() for _ in range(num_servers)]
+        self.procs: list[subprocess.Popen] = []
+        self._args = dict(lr=learning_rate, sync=int(sync), last_gradient=int(last_gradient))
+
+    @property
+    def hosts(self) -> str:
+        """Client connection spec, server-rank order."""
+        return ",".join(f"127.0.0.1:{p}" for p in self.ports)
+
+    def start(self) -> "ServerGroup":
+        for rank, port in enumerate(self.ports):
+            lo = self.dim * rank // self.num_servers
+            hi = self.dim * (rank + 1) // self.num_servers
+            cmd = [
+                server_binary(),
+                f"--port={port}",
+                f"--num_workers={self.num_workers}",
+                f"--dim={hi - lo}",
+                f"--lr={self._args['lr']}",
+                f"--sync={self._args['sync']}",
+                f"--last_gradient={self._args['last_gradient']}",
+            ]
+            self.procs.append(subprocess.Popen(cmd))
+        self._wait_ready()
+        return self
+
+    def _wait_ready(self, timeout: float = 10.0) -> None:
+        deadline = time.monotonic() + timeout
+        for port in self.ports:
+            while True:
+                try:
+                    with socket.create_connection(("127.0.0.1", port), timeout=0.2):
+                        break
+                except OSError:
+                    if time.monotonic() > deadline:
+                        self.stop()
+                        raise TimeoutError(f"KV server on port {port} did not come up")
+                    time.sleep(0.05)
+
+    def stop(self) -> None:
+        for p in self.procs:
+            if p.poll() is None:
+                p.terminate()
+        for p in self.procs:
+            try:
+                p.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                p.kill()
+                p.wait()
+        self.procs.clear()
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()

@@ -1,0 +1,168 @@
+"""Parameter-server training mode (sync-BSP or async/Hogwild over the
+native KV server group).
+
+This is the reference-faithful alternative to the SPMD fast path: the
+control flow is a line-for-line behavioral mirror of the reference worker
+(``RunWorker``, ``src/main.cc:124-170`` + ``LR::Train``, ``src/lr.cc:28-45``)
+— pull weights, compute the minibatch gradient, push, repeat — except the
+gradient math is a jitted JAX step on the accelerator instead of the
+O(B*D^2) scalar loop.  Use this mode to reproduce the reference's
+*asynchronous* convergence behavior (stale gradients are real here: each
+worker pulls whatever the servers have now) and for PS-style deployments
+where workers and servers are separate hosts over DCN.
+
+Worker lifecycle parity:
+  * every worker computes the identical init (Q2 — reference ``srand(0)``),
+    rank 0 pushes it as the first push (server init branch), others wait
+    at the group barrier (``src/main.cc:141-150``)
+  * sync mode: the blocking push IS the BSP barrier (deferred replies)
+  * rank 0 evaluates every ``test_interval`` epochs and prints the
+    reference-format line
+  * each worker text-exports its final *pulled* weights to
+    ``models/part-00{rank+1}`` (Q8: per-worker files, ``src/main.cc:168-169``)
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+import jax
+import numpy as np
+
+from distlr_tpu.config import Config
+from distlr_tpu.data import DataIter
+from distlr_tpu.data.sharding import part_name
+from distlr_tpu.models import get_model
+from distlr_tpu.ps import KVWorker, ServerGroup
+from distlr_tpu.train.export import save_model_text
+from distlr_tpu.train.metrics import MetricsLogger
+from distlr_tpu.utils.logging import get_logger, log_eval_line
+
+log = get_logger(__name__)
+
+
+class PSWorker:
+    """One worker's training loop against a KV server group."""
+
+    def __init__(self, cfg: Config, rank: int, hosts: str, *, train_iter=None, test_iter=None):
+        self.cfg = cfg
+        self.rank = rank
+        self.model = get_model(cfg)
+        self.kv = KVWorker(hosts, self._param_dim(), client_id=rank)
+        self._train_iter = train_iter
+        self._test_iter = test_iter
+        self._grad_fn = jax.jit(lambda w, X, y, mask: self.model.grad(w, (X, y, mask), cfg))
+        self._acc_fn = jax.jit(lambda w, X, y, mask: self.model.accuracy(w, (X, y, mask)))
+        self.metrics = MetricsLogger()
+        self.final_weights: np.ndarray | None = None
+
+    def _param_dim(self) -> int:
+        d = self.cfg.num_feature_dim
+        return d * self.cfg.num_classes if self.cfg.model == "softmax" else d
+
+    def _load_train_iter(self) -> DataIter:
+        # Reference re-reads its shard every epoch (src/main.cc:158-159);
+        # we parse once and reset (same samples, no quirk).
+        path = os.path.join(self.cfg.data_dir, "train", part_name(self.rank))
+        return DataIter.from_file(path, self.cfg.num_feature_dim, self.cfg.batch_size,
+                                  multiclass=self.cfg.model == "softmax")
+
+    def _load_test_iter(self) -> DataIter:
+        path = os.path.join(self.cfg.data_dir, "test", part_name(0))
+        return DataIter.from_file(path, self.cfg.num_feature_dim, -1,
+                                  multiclass=self.cfg.model == "softmax")
+
+    def run(self, *, eval_fn=None, save=True) -> np.ndarray:
+        cfg = self.cfg
+        train = self._train_iter if self._train_iter is not None else self._load_train_iter()
+        test = self._test_iter if self._test_iter is not None else (
+            self._load_test_iter() if self.rank == 0 else None
+        )
+
+        # Identical deterministic init on every worker (Q2); only rank 0
+        # pushes — the server's first-push branch stores it verbatim.
+        w0 = np.asarray(self.model.init(cfg)).reshape(-1)
+        if self.rank == 0:
+            self.kv.wait(self.kv.push(w0))
+        self.kv.barrier()
+
+        w = w0
+        for epoch in range(cfg.num_iteration):
+            train.reset()
+            for X, y, mask in train:
+                w = self.kv.pull()
+                g = self._grad_fn(self._shape_params(w), X, y, mask)
+                self.kv.wait(self.kv.push(np.asarray(g).reshape(-1)))
+            if (
+                self.rank == 0
+                and test is not None
+                and cfg.test_interval > 0
+                and (epoch + 1) % cfg.test_interval == 0
+            ):
+                w = self.kv.pull()
+                test.reset()
+                Xt, yt, mt = test.next_batch()
+                acc = float(self._acc_fn(self._shape_params(w), Xt, yt, mt))
+                self.metrics.log(epoch=epoch + 1, accuracy=acc)
+                if eval_fn is not None:
+                    eval_fn(epoch + 1, acc)
+                else:
+                    log_eval_line(epoch + 1, acc)
+
+        self.final_weights = self.kv.pull()
+        if save:
+            path = os.path.join(cfg.data_dir, "models", part_name(self.rank))
+            os.makedirs(os.path.dirname(path), exist_ok=True)
+            save_model_text(path, self.final_weights)
+        return self.final_weights
+
+    def _shape_params(self, flat: np.ndarray):
+        if self.cfg.model == "softmax":
+            return flat.reshape(self.cfg.num_feature_dim, self.cfg.num_classes)
+        return flat
+
+    def close(self):
+        self.kv.close()
+
+
+def run_ps_local(cfg: Config, *, eval_fn=None, save=False):
+    """Single-host PS run: native server subprocesses + threaded workers.
+
+    The local-mode successor of ``examples/local.sh`` for the PS path
+    (the scheduler role is gone — rendezvous is just TCP connect).
+    Worker threads share one JAX backend/jit cache; each blocks
+    independently in the native client (the GIL is released during
+    ctypes calls), so async staleness is real.  Multi-host deployments
+    run one ``PSWorker`` per host against remote servers instead.
+    """
+    dim = cfg.num_feature_dim * (cfg.num_classes if cfg.model == "softmax" else 1)
+    group = ServerGroup(
+        cfg.num_servers,
+        cfg.num_workers,
+        dim,
+        learning_rate=cfg.learning_rate,
+        sync=cfg.sync_mode,
+        last_gradient=bool(cfg.sync_last_gradient),
+    )
+    results: list[np.ndarray | None] = [None] * cfg.num_workers
+    errors: list[Exception] = []
+    with group:
+        workers = [PSWorker(cfg, r, group.hosts) for r in range(cfg.num_workers)]
+
+        def run_one(r):
+            try:
+                results[r] = workers[r].run(eval_fn=eval_fn if r == 0 else None, save=save)
+            except Exception as e:  # surface worker failures to the caller
+                errors.append(e)
+
+        threads = [threading.Thread(target=run_one, args=(r,), daemon=True) for r in range(cfg.num_workers)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        for wk in workers:
+            wk.close()
+    if errors:
+        raise errors[0]
+    return results

@@ -58,6 +58,8 @@ class GlobalShardedData:
         self.num_shards = len(shards)
         self.shard_sizes = [len(y) for _, y in shards]
         n_pad = max(self.shard_sizes)
+        if n_pad == 0:
+            raise ValueError("all shards are empty — no training data")
         feat_shape = shards[0][0].shape[1:]
         W = self.num_shards
         self.X = np.zeros((W, n_pad) + feat_shape, dtype=shards[0][0].dtype)
@@ -138,7 +140,14 @@ class Trainer:
                 "sparse_lr via distlr_tpu.models.SparseBinaryLR directly"
             )
         self.cfg = cfg
-        self.mesh = mesh if mesh is not None else make_mesh(cfg.mesh_shape)
+        if mesh is None:
+            # honor a local.sh-style DMLC_NUM_WORKER > 1 as the data-axis
+            # size; otherwise default to all devices
+            shape = cfg.mesh_shape
+            if shape is None and cfg.num_workers > 1:
+                shape = {"data": cfg.num_workers}
+            mesh = make_mesh(shape)
+        self.mesh = mesh
         self.model = get_model(cfg)
         self.metrics = metrics or MetricsLogger()
         self.train_step = make_sync_train_step(self.model, cfg, self.mesh)

@@ -81,6 +81,14 @@ class TestDataIter:
         np.testing.assert_array_equal(bx[2], X[0])  # head duplicated
         np.testing.assert_array_equal(bx[3], X[1])
 
+    def test_wrap_compat_cycles_small_shard(self):
+        X = np.arange(6, dtype=np.float32).reshape(3, 2)
+        y = np.zeros(3, np.int32)
+        it = DataIter(X, y, batch_size=8, wrap_compat=True)
+        bx, by, mask = it.next_batch()
+        assert mask.all()  # all real rows: reference cycles modulo the shard
+        np.testing.assert_array_equal(bx, X[[0, 1, 2, 0, 1, 2, 0, 1]])
+
     def test_drop_remainder(self):
         X, y = self._data(10)
         it = DataIter(X, y, batch_size=4, drop_remainder=True)

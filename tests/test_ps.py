@@ -1,0 +1,163 @@
+"""Tests for the native parameter-server stack (C++ server + ctypes client).
+
+These run real server subprocesses on localhost ports — the same
+multi-process-on-one-machine strategy the reference uses for cluster
+testing (SURVEY.md §4), minus the env-var role faking.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from distlr_tpu.config import Config
+from distlr_tpu.ps import KVWorker, ServerGroup
+from distlr_tpu.train.ps_trainer import run_ps_local
+from distlr_tpu.data.synthetic import write_synthetic_shards
+
+
+@pytest.fixture(scope="module")
+def ps_data_dir(tmp_path_factory):
+    d = tmp_path_factory.mktemp("psdata")
+    write_synthetic_shards(str(d), 1200, 16, num_parts=2, seed=4, sparsity=0.0)
+    return str(d)
+
+
+class TestKVBasics:
+    def test_init_pull_roundtrip(self):
+        with ServerGroup(1, 1, dim=8) as sg, KVWorker(sg.hosts, 8) as kv:
+            init = np.arange(8, dtype=np.float32)
+            kv.wait(kv.push(init))
+            np.testing.assert_array_equal(kv.pull(), init)
+
+    def test_range_sharding_uneven(self):
+        # dim=10 over 3 servers -> ranges [0,3) [3,6) [6,10)
+        with ServerGroup(3, 1, dim=10) as sg, KVWorker(sg.hosts, 10) as kv:
+            init = np.linspace(0, 9, 10).astype(np.float32)
+            kv.push(init)
+            np.testing.assert_allclose(kv.pull(), init)
+            # partial pull crossing a range boundary
+            keys = np.array([2, 3, 4, 7], dtype=np.uint64)
+            np.testing.assert_allclose(kv.pull(keys), init[[2, 3, 4, 7]])
+
+    def test_async_applies_immediately(self):
+        with ServerGroup(1, 2, dim=4, sync=False, learning_rate=1.0) as sg:
+            kv = KVWorker(sg.hosts, 4)
+            kv.push(np.zeros(4, np.float32))  # init
+            kv.push(np.ones(4, np.float32))   # w -= 1*g
+            np.testing.assert_allclose(kv.pull(), -np.ones(4))
+            kv.close()
+
+    def test_sync_push_blocks_until_all_workers(self):
+        """The deferred reply is the BSP barrier: one worker's push must
+        not return until the other worker pushes too."""
+        with ServerGroup(1, 2, dim=4, sync=True, learning_rate=0.5) as sg:
+            kv0 = KVWorker(sg.hosts, 4, client_id=0)
+            kv1 = KVWorker(sg.hosts, 4, client_id=1)
+            kv0.push(np.zeros(4, np.float32))  # init (responds immediately)
+
+            t_done = []
+
+            def push0():
+                kv0.push(np.full(4, 2.0, np.float32))
+                t_done.append(time.monotonic())
+
+            th = threading.Thread(target=push0)
+            th.start()
+            time.sleep(0.3)
+            assert not t_done, "sync push returned before all workers pushed"
+            t_release = time.monotonic()
+            kv1.push(np.full(4, 4.0, np.float32))
+            th.join(timeout=5)
+            assert t_done and t_done[0] >= t_release - 0.05
+            # correct-mean update: w -= lr * (g0+g1)/2 = -0.5*3
+            np.testing.assert_allclose(kv0.pull(), np.full(4, -1.5))
+            kv0.close()
+            kv1.close()
+
+    def test_q1_last_gradient_mode(self):
+        with ServerGroup(1, 2, dim=4, sync=True, learning_rate=1.0, last_gradient=True) as sg:
+            kv0 = KVWorker(sg.hosts, 4, client_id=0)
+            kv1 = KVWorker(sg.hosts, 4, client_id=1)
+            kv0.push(np.zeros(4, np.float32))
+            th = threading.Thread(target=lambda: kv0.push(np.full(4, 2.0, np.float32)))
+            th.start()
+            time.sleep(0.2)  # ensure kv0's push arrives first
+            kv1.push(np.full(4, 4.0, np.float32))  # last arrival
+            th.join(timeout=5)
+            # Q1: w -= lr * g_last / W = -4/2 = -2 (NOT the mean -3)
+            np.testing.assert_allclose(kv0.pull(), np.full(4, -2.0))
+            kv0.close()
+            kv1.close()
+
+    def test_worker_group_barrier(self):
+        with ServerGroup(1, 2, dim=2) as sg:
+            kv0 = KVWorker(sg.hosts, 2, client_id=0)
+            kv1 = KVWorker(sg.hosts, 2, client_id=1)
+            released = []
+
+            def b0():
+                kv0.barrier()
+                released.append(0)
+
+            th = threading.Thread(target=b0)
+            th.start()
+            time.sleep(0.2)
+            assert not released
+            kv1.barrier()
+            th.join(timeout=5)
+            assert released == [0]
+            kv0.close()
+            kv1.close()
+
+    def test_connect_failure_raises(self):
+        with pytest.raises(ConnectionError):
+            KVWorker("127.0.0.1:1", 4)
+
+
+class TestPSTraining:
+    def test_sync_ps_converges(self, ps_data_dir):
+        cfg = Config(
+            data_dir=ps_data_dir, num_feature_dim=16, num_workers=2, num_servers=2,
+            num_iteration=40, learning_rate=0.5, l2_c=0.0, batch_size=-1,
+            test_interval=20, sync_mode=True,
+        )
+        evals = []
+        results = run_ps_local(cfg, eval_fn=lambda ep, acc: evals.append((ep, acc)))
+        assert all(r is not None for r in results)
+        # sync: every worker ends with identical weights
+        np.testing.assert_allclose(results[0], results[1], atol=1e-5)
+        assert evals[-1][1] > 0.8, f"sync PS accuracy {evals}"
+
+    def test_async_ps_converges(self, ps_data_dir):
+        cfg = Config(
+            data_dir=ps_data_dir, num_feature_dim=16, num_workers=2, num_servers=1,
+            num_iteration=40, learning_rate=0.2, l2_c=0.0, batch_size=100,
+            test_interval=40, sync_mode=False,
+        )
+        evals = []
+        results = run_ps_local(cfg, eval_fn=lambda ep, acc: evals.append((ep, acc)))
+        assert all(r is not None for r in results)
+        assert evals[-1][1] > 0.8, f"async PS accuracy {evals}"
+
+    def test_ps_matches_spmd_sync_result(self, ps_data_dir):
+        """PS sync mode and the SPMD psum path implement the same math:
+        full-batch runs from the same init must track each other."""
+        from distlr_tpu.parallel import make_mesh
+        from distlr_tpu.train import Trainer
+
+        common = dict(
+            data_dir=ps_data_dir, num_feature_dim=16, num_iteration=10,
+            learning_rate=0.3, l2_c=0.0, batch_size=-1, test_interval=0,
+            compat_mode="reference",  # identical deterministic init (Q2)
+        )
+        # correct-mean sync in both paths
+        cfg_ps = Config(num_workers=2, num_servers=1, sync_mode=True,
+                        sync_last_gradient=False, **common)
+        ps_w = run_ps_local(cfg_ps)[0]
+
+        cfg_spmd = Config(sync_last_gradient=False, **common)
+        tr = Trainer(cfg_spmd, mesh=make_mesh({"data": 2})).load_data()
+        spmd_w = np.asarray(tr.fit())
+        np.testing.assert_allclose(ps_w, spmd_w, atol=5e-2)

@@ -33,6 +33,10 @@ class TestGlobalShardedData:
         last_mask = batches[-1][2].reshape(3, -1)
         assert last_mask[2].sum() == 0  # short shard's padding is masked
 
+    def test_empty_shards_rejected(self):
+        with pytest.raises(ValueError, match="no training data"):
+            GlobalShardedData([(np.zeros((0, 2), np.float32), np.zeros(0, np.int32))])
+
     def test_full_shard_batch(self):
         shards = [(np.zeros((4, 2), np.float32), np.zeros(4, np.int32))] * 2
         g = GlobalShardedData(shards)
