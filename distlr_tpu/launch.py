@@ -1,0 +1,188 @@
+"""Launcher CLI — the successor of ``examples/local.sh`` + ``gen_data.py``.
+
+The reference launches a cluster as 1 scheduler + S servers + W workers,
+all the same binary parameterized by env vars (``examples/local.sh:30-49``).
+Here the sync path needs exactly ONE process (the roles collapsed into an
+SPMD program over the mesh), and the PS path needs server processes that
+:func:`distlr_tpu.train.ps_trainer.run_ps_local` spawns itself — so the
+"launcher" is a small CLI:
+
+    python -m distlr_tpu.launch gen-data --data-dir D --num-samples N ...
+    python -m distlr_tpu.launch sync     [--data-dir D ...]
+    python -m distlr_tpu.launch ps       [--async] [--num-workers W ...]
+
+Every algorithm knob also honors the reference's env-var contract
+(``SYNC_MODE``, ``LEARNING_RATE``, ``NUM_FEATURE_DIM``, ... — see
+:meth:`distlr_tpu.config.Config.from_env`), so ``local.sh``-style
+invocation by exported env still works; CLI flags override env.
+
+Multi-host: ``--coordinator host:port --num-processes N --process-id i``
+bootstraps ``jax.distributed`` before building the mesh, putting all
+hosts' devices into one global mesh (ICI within host, DCN across).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from distlr_tpu.config import Config
+from distlr_tpu.utils.logging import get_logger
+
+log = get_logger(__name__)
+
+
+def _add_config_flags(p: argparse.ArgumentParser) -> None:
+    p.add_argument("--data-dir", dest="data_dir")
+    p.add_argument("--num-feature-dim", dest="num_feature_dim", type=int)
+    p.add_argument("--num-iteration", dest="num_iteration", type=int)
+    p.add_argument("--batch-size", dest="batch_size", type=int)
+    p.add_argument("--learning-rate", dest="learning_rate", type=float)
+    p.add_argument("--l2-c", dest="l2_c", type=float)
+    p.add_argument("--test-interval", dest="test_interval", type=int)
+    p.add_argument("--model", choices=["binary_lr", "softmax"])
+    p.add_argument("--num-classes", dest="num_classes", type=int)
+    p.add_argument("--compat-mode", dest="compat_mode", choices=["correct", "reference"])
+    p.add_argument("--checkpoint-dir", dest="checkpoint_dir")
+    p.add_argument("--checkpoint-interval", dest="checkpoint_interval", type=int)
+    p.add_argument("--profile-dir", dest="profile_dir")
+    p.add_argument("--resume", action="store_true")
+    p.add_argument("--num-workers", dest="num_workers", type=int)
+    p.add_argument("--num-servers", dest="num_servers", type=int)
+    p.add_argument("--feature-shards", dest="feature_shards", type=int,
+                   help="model-axis size; >1 selects the 2D feature-sharded path")
+    # multi-host bootstrap
+    p.add_argument("--coordinator", help="host:port of process 0 for jax.distributed")
+    p.add_argument("--num-processes", dest="num_processes", type=int)
+    p.add_argument("--process-id", dest="process_id", type=int)
+    p.add_argument(
+        "--cpu-devices", dest="cpu_devices", type=int,
+        help="simulate an N-device CPU mesh (no accelerator needed); "
+        "environments that pre-import jax ignore a plain XLA_FLAGS env var, "
+        "so use this flag rather than exporting it yourself",
+    )
+
+
+def _config_from_args(args: argparse.Namespace) -> Config:
+    overrides = {
+        k: v
+        for k, v in vars(args).items()
+        if v is not None
+        and k
+        in {
+            "data_dir", "num_feature_dim", "num_iteration", "batch_size",
+            "learning_rate", "l2_c", "test_interval", "model", "num_classes",
+            "compat_mode", "checkpoint_dir", "checkpoint_interval",
+            "profile_dir", "num_workers", "num_servers",
+        }
+    }
+    cfg = Config.from_env(**overrides)
+    if getattr(args, "feature_shards", None):
+        cfg = cfg.replace(
+            mesh_shape={"data": cfg.num_workers, "model": args.feature_shards},
+            feature_shards=args.feature_shards,
+        )
+    return cfg
+
+
+def _maybe_force_cpu_devices(args: argparse.Namespace) -> None:
+    if getattr(args, "cpu_devices", None):
+        import os  # noqa: PLC0415
+
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + f" --xla_force_host_platform_device_count={args.cpu_devices}"
+            ).strip()
+        import jax  # noqa: PLC0415
+
+        jax.config.update("jax_platforms", "cpu")
+
+
+def _maybe_init_distributed(args: argparse.Namespace) -> None:
+    if args.coordinator:
+        import jax  # noqa: PLC0415
+
+        jax.distributed.initialize(
+            coordinator_address=args.coordinator,
+            num_processes=args.num_processes,
+            process_id=args.process_id,
+        )
+        log.info(
+            "joined distributed run: process %s of %s", args.process_id, args.num_processes
+        )
+
+
+def cmd_gen_data(args: argparse.Namespace) -> int:
+    from distlr_tpu.data.synthetic import write_synthetic_shards  # noqa: PLC0415
+
+    manifest = write_synthetic_shards(
+        args.data_dir,
+        args.num_samples,
+        args.num_feature_dim,
+        args.num_parts,
+        seed=args.seed,
+        num_classes=args.num_classes,
+        sparsity=args.sparsity,
+    )
+    log.info("wrote %d train shards + test to %s", len(manifest["train_parts"]), args.data_dir)
+    return 0
+
+
+def cmd_sync(args: argparse.Namespace) -> int:
+    _maybe_force_cpu_devices(args)
+    from distlr_tpu.train import Trainer  # noqa: PLC0415
+
+    _maybe_init_distributed(args)
+    cfg = _config_from_args(args)
+    trainer = Trainer(cfg).load_data()
+    trainer.fit(resume=args.resume)
+    path = trainer.save_model()
+    log.info(
+        "final accuracy %.4f, %.0f samples/sec, model -> %s",
+        trainer.evaluate(), trainer.timer.samples_per_sec, path,
+    )
+    return 0
+
+
+def cmd_ps(args: argparse.Namespace) -> int:
+    _maybe_force_cpu_devices(args)
+    from distlr_tpu.train.ps_trainer import run_ps_local  # noqa: PLC0415
+
+    cfg = _config_from_args(args)
+    if args.asynchronous:
+        cfg = cfg.replace(sync_mode=False)
+    run_ps_local(cfg, save=True)
+    return 0
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="distlr_tpu.launch", description=__doc__)
+    sub = parser.add_subparsers(dest="cmd", required=True)
+
+    g = sub.add_parser("gen-data", help="write seeded synthetic libsvm shards")
+    g.add_argument("--data-dir", required=True)
+    g.add_argument("--num-samples", type=int, default=10000)
+    g.add_argument("--num-feature-dim", type=int, default=123)
+    g.add_argument("--num-parts", type=int, default=4)
+    g.add_argument("--seed", type=int, default=0)
+    g.add_argument("--num-classes", type=int, default=2)
+    g.add_argument("--sparsity", type=float, default=0.5)
+    g.set_defaults(fn=cmd_gen_data)
+
+    s = sub.add_parser("sync", help="synchronous SPMD training (one process)")
+    _add_config_flags(s)
+    s.set_defaults(fn=cmd_sync)
+
+    p = sub.add_parser("ps", help="parameter-server training (native KV servers)")
+    _add_config_flags(p)
+    p.add_argument("--async", dest="asynchronous", action="store_true",
+                   help="Hogwild mode (SYNC_MODE=0 equivalent)")
+    p.set_defaults(fn=cmd_ps)
+
+    args = parser.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -60,11 +60,23 @@ class KVWorker:
             raise IOError(f"KV {what} failed: {err}")
         return ts
 
+    def _validate_keys(self, keys: np.ndarray) -> np.ndarray:
+        """The native range-slicer requires strictly ascending in-range
+        keys (it binary-searches range boundaries); reject violations
+        here rather than returning silently-wrong slices."""
+        keys = np.ascontiguousarray(keys, dtype=np.uint64)
+        if keys.size:
+            if keys[-1] >= self.dim:
+                raise ValueError(f"key {int(keys[-1])} out of range (dim={self.dim})")
+            if keys.size > 1 and not (np.diff(keys.view(np.int64)) > 0).all():
+                raise ValueError("keys must be strictly ascending")
+        return keys
+
     def push(self, vals: np.ndarray, keys: np.ndarray | None = None) -> int:
         """Blocking push; in sync mode returns only after ALL workers
         pushed (the server's deferred reply = BSP barrier)."""
         vals = np.ascontiguousarray(vals, dtype=np.float32)
-        keys = self._all_keys if keys is None else np.ascontiguousarray(keys, dtype=np.uint64)
+        keys = self._all_keys if keys is None else self._validate_keys(keys)
         if vals.shape[0] != keys.shape[0]:
             raise ValueError(f"{vals.shape[0]} vals vs {keys.shape[0]} keys")
         ts = self._lib.kv_push(
@@ -76,7 +88,7 @@ class KVWorker:
         return self._check(ts, "push")
 
     def pull(self, keys: np.ndarray | None = None) -> np.ndarray:
-        keys = self._all_keys if keys is None else np.ascontiguousarray(keys, dtype=np.uint64)
+        keys = self._all_keys if keys is None else self._validate_keys(keys)
         out = np.empty(keys.shape[0], dtype=np.float32)
         ts = self._lib.kv_pull(
             self._h,

@@ -25,7 +25,12 @@
 //
 // Usage:
 //   distlr_kv_server --port=P --num_workers=W --dim=D [--lr=0.2]
-//                    [--sync=1] [--last_gradient=0] [--key_offset=0]
+//                    [--sync=1] [--last_gradient=0] [--bind_any=0]
+//
+// --port=0 binds an ephemeral port; the chosen port is announced as
+// "PORT <n>" on stdout so a supervisor can read it race-free.
+// --bind_any=1 listens on 0.0.0.0 for multi-host (DCN) deployments;
+// the default stays loopback-only.
 //
 // The server is dimension-elastic: --dim pre-sizes the slice, but any
 // key seen in a PUSH grows storage (keys are server-local after the
@@ -61,9 +66,9 @@ struct PendingPush {
 class KVServer {
  public:
   KVServer(int port, int num_workers, uint64_t dim, float lr, bool sync,
-           bool last_gradient)
+           bool last_gradient, bool bind_any)
       : port_(port), num_workers_(num_workers), lr_(lr), sync_(sync),
-        last_gradient_(last_gradient) {
+        last_gradient_(last_gradient), bind_any_(bind_any) {
     weights_.resize(dim, 0.0f);
   }
 
@@ -74,16 +79,27 @@ class KVServer {
     setsockopt(listen_fd_, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
     sockaddr_in addr{};
     addr.sin_family = AF_INET;
-    addr.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+    addr.sin_addr.s_addr = htonl(bind_any_ ? INADDR_ANY : INADDR_LOOPBACK);
     addr.sin_port = htons(static_cast<uint16_t>(port_));
     if (bind(listen_fd_, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) < 0) {
       perror("bind");
       return 1;
     }
+    if (port_ == 0) {  // ephemeral: report the kernel-chosen port
+      sockaddr_in bound{};
+      socklen_t len = sizeof(bound);
+      getsockname(listen_fd_, reinterpret_cast<sockaddr*>(&bound), &len);
+      port_ = ntohs(bound.sin_port);
+    }
     if (listen(listen_fd_, 128) < 0) { perror("listen"); return 1; }
-    fprintf(stderr, "[distlr_kv_server] listening on 127.0.0.1:%d "
+    // Machine-readable announcement (supervisors parse this; race-free
+    // alternative to picking a "free" port up front).
+    printf("PORT %d\n", port_);
+    fflush(stdout);
+    fprintf(stderr, "[distlr_kv_server] listening on %s:%d "
             "(workers=%d dim=%zu sync=%d lr=%g)\n",
-            port_, num_workers_, weights_.size(), sync_ ? 1 : 0, lr_);
+            bind_any_ ? "0.0.0.0" : "127.0.0.1", port_, num_workers_,
+            weights_.size(), sync_ ? 1 : 0, lr_);
     fflush(stderr);
 
     std::vector<std::thread> conns;
@@ -94,6 +110,10 @@ class KVServer {
         continue;
       }
       setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+      {
+        std::lock_guard<std::mutex> lock(mu_);
+        active_fds_.push_back(fd);
+      }
       conns.emplace_back(&KVServer::Serve, this, fd);
     }
     for (auto& t : conns) t.join();
@@ -146,9 +166,23 @@ class KVServer {
       } else if (op == Op::kShutdown) {
         Respond(fd, h, nullptr, 0);
         shutdown_.store(true);
-        // unblock accept()
+        // Unblock accept() AND every connection thread parked in
+        // ReadFull for another worker — otherwise Run()'s join would
+        // deadlock whenever more than one worker is connected.
         ::shutdown(listen_fd_, SHUT_RDWR);
+        {
+          std::lock_guard<std::mutex> lock(mu_);
+          for (int other : active_fds_) {
+            if (other != fd) ::shutdown(other, SHUT_RDWR);
+          }
+        }
         break;
+      }
+    }
+    {
+      std::lock_guard<std::mutex> lock(mu_);
+      for (auto it = active_fds_.begin(); it != active_fds_.end(); ++it) {
+        if (*it == fd) { active_fds_.erase(it); break; }
       }
     }
     close(fd);
@@ -249,8 +283,10 @@ class KVServer {
   float lr_;
   bool sync_;
   bool last_gradient_;
+  bool bind_any_;
   int listen_fd_ = -1;
   std::atomic<bool> shutdown_{false};
+  std::vector<int> active_fds_;
 
   std::mutex mu_;
   bool initialized_ = false;
@@ -289,7 +325,9 @@ int main(int argc, char** argv) {
   const double lr = ArgF(argc, argv, "lr", 0.2);
   const bool sync = Arg(argc, argv, "sync", 1) != 0;
   const bool last_gradient = Arg(argc, argv, "last_gradient", 0) != 0;
+  const bool bind_any = Arg(argc, argv, "bind_any", 0) != 0;
   distlr::KVServer server(port, num_workers, static_cast<uint64_t>(dim),
-                          static_cast<float>(lr), sync, last_gradient);
+                          static_cast<float>(lr), sync, last_gradient,
+                          bind_any);
   return server.Run();
 }

@@ -8,20 +8,12 @@ Replaces the reference launcher's server-spawning half
 
 from __future__ import annotations
 
-import socket
 import subprocess
-import time
 
 from distlr_tpu.ps.build import build_native, server_binary
 from distlr_tpu.utils.logging import get_logger
 
 log = get_logger(__name__)
-
-
-def free_port() -> int:
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        return s.getsockname()[1]
 
 
 class ServerGroup:
@@ -30,6 +22,11 @@ class ServerGroup:
     Server rank ``r`` owns global keys ``[r*D/S, (r+1)*D/S)`` — the
     ps-lite range partition (reference ``src/main.cc:98-101``); the
     client library slices requests to match.
+
+    Ports are ephemeral: each server binds port 0 and announces the
+    kernel-chosen port as ``PORT <n>`` on stdout, which is read here —
+    no pick-then-rebind race.  ``bind_any=True`` listens on 0.0.0.0 for
+    multi-host (DCN) deployments.
     """
 
     def __init__(
@@ -42,14 +39,20 @@ class ServerGroup:
         sync: bool = True,
         last_gradient: bool = False,
         ports: list[int] | None = None,
+        bind_any: bool = False,
     ):
         build_native()
         self.num_servers = num_servers
         self.num_workers = num_workers
         self.dim = dim
-        self.ports = ports or [free_port() for _ in range(num_servers)]
+        self.ports: list[int] = ports or []
         self.procs: list[subprocess.Popen] = []
-        self._args = dict(lr=learning_rate, sync=int(sync), last_gradient=int(last_gradient))
+        self._args = dict(
+            lr=learning_rate,
+            sync=int(sync),
+            last_gradient=int(last_gradient),
+            bind_any=int(bind_any),
+        )
 
     @property
     def hosts(self) -> str:
@@ -57,9 +60,12 @@ class ServerGroup:
         return ",".join(f"127.0.0.1:{p}" for p in self.ports)
 
     def start(self) -> "ServerGroup":
-        for rank, port in enumerate(self.ports):
+        fixed_ports = list(self.ports)
+        self.ports = []
+        for rank in range(self.num_servers):
             lo = self.dim * rank // self.num_servers
             hi = self.dim * (rank + 1) // self.num_servers
+            port = fixed_ports[rank] if fixed_ports else 0
             cmd = [
                 server_binary(),
                 f"--port={port}",
@@ -68,23 +74,20 @@ class ServerGroup:
                 f"--lr={self._args['lr']}",
                 f"--sync={self._args['sync']}",
                 f"--last_gradient={self._args['last_gradient']}",
+                f"--bind_any={self._args['bind_any']}",
             ]
-            self.procs.append(subprocess.Popen(cmd))
-        self._wait_ready()
+            proc = subprocess.Popen(cmd, stdout=subprocess.PIPE, text=True)
+            self.procs.append(proc)
+            # The server prints "PORT <n>" once listening; blocking on that
+            # line doubles as the readiness wait.
+            line = proc.stdout.readline().strip()
+            if not line.startswith("PORT "):
+                self.stop()
+                raise RuntimeError(
+                    f"KV server rank {rank} failed to start (got {line!r})"
+                )
+            self.ports.append(int(line.split()[1]))
         return self
-
-    def _wait_ready(self, timeout: float = 10.0) -> None:
-        deadline = time.monotonic() + timeout
-        for port in self.ports:
-            while True:
-                try:
-                    with socket.create_connection(("127.0.0.1", port), timeout=0.2):
-                        break
-                except OSError:
-                    if time.monotonic() > deadline:
-                        self.stop()
-                        raise TimeoutError(f"KV server on port {port} did not come up")
-                    time.sleep(0.05)
 
     def stop(self) -> None:
         for p in self.procs:
@@ -96,6 +99,8 @@ class ServerGroup:
             except subprocess.TimeoutExpired:
                 p.kill()
                 p.wait()
+            if p.stdout:
+                p.stdout.close()
         self.procs.clear()
 
     def __enter__(self):

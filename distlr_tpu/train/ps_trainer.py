@@ -155,6 +155,11 @@ def run_ps_local(cfg: Config, *, eval_fn=None, save=False):
                 results[r] = workers[r].run(eval_fn=eval_fn if r == 0 else None, save=save)
             except Exception as e:  # surface worker failures to the caller
                 errors.append(e)
+                # A dead worker would deadlock every peer blocked on the
+                # sync barrier (the reference's named straggler failure,
+                # SURVEY.md §5.3) — tear the servers down so the peers'
+                # blocking RPCs fail fast instead of hanging forever.
+                group.stop()
 
         threads = [threading.Thread(target=run_one, args=(r,), daemon=True) for r in range(cfg.num_workers)]
         for t in threads:

@@ -18,6 +18,7 @@ worker's full shard, exactly one reference "iteration".
 
 from __future__ import annotations
 
+import contextlib
 import os
 
 import jax
@@ -33,7 +34,7 @@ from distlr_tpu.parallel import (
     make_sync_train_step,
 )
 from distlr_tpu.parallel.data_parallel import shard_batch
-from distlr_tpu.parallel.mesh import num_data_shards
+from distlr_tpu.parallel.mesh import MODEL_AXIS, num_data_shards
 from distlr_tpu.train.export import save_model_text
 from distlr_tpu.train.metrics import MetricsLogger, StepTimer
 from distlr_tpu.utils.logging import get_logger, log_eval_line
@@ -150,8 +151,28 @@ class Trainer:
         self.mesh = mesh
         self.model = get_model(cfg)
         self.metrics = metrics or MetricsLogger()
-        self.train_step = make_sync_train_step(self.model, cfg, self.mesh)
-        self.eval_step = make_eval_step(self.model, self.mesh)
+        # A mesh with a 'model' axis selects the 2D data x feature-sharded
+        # path (weights partitioned like ps-lite's server key ranges).
+        self.feature_sharded = MODEL_AXIS in mesh.axis_names
+        if self.feature_sharded:
+            from distlr_tpu.parallel.feature_parallel import (  # noqa: PLC0415
+                make_feature_sharded_eval_step,
+                make_feature_sharded_train_step,
+                shard_batch_2d,
+                shard_weights,
+            )
+
+            self.train_step = make_feature_sharded_train_step(self.model, cfg, self.mesh)
+            self.eval_step = make_feature_sharded_eval_step(self.model, self.mesh)
+            self._shard_batch = lambda b: shard_batch_2d(b, self.mesh)
+            self._shard_weights = lambda w: shard_weights(w, self.mesh)
+        else:
+            self.train_step = make_sync_train_step(self.model, cfg, self.mesh)
+            self.eval_step = make_eval_step(self.model, self.mesh)
+            self._shard_batch = lambda b: shard_batch(b, self.mesh)
+            self._shard_weights = lambda w: jax.device_put(
+                w, jax.sharding.NamedSharding(self.mesh, jax.sharding.PartitionSpec())
+            )
         self.timer = StepTimer()
         self.weights = None
         self._train_data: GlobalShardedData | None = None
@@ -171,50 +192,84 @@ class Trainer:
 
     # -- training -----------------------------------------------------------
     def init_weights(self):
-        self.weights = jax.device_put(
-            self.model.init(self.cfg),
-            jax.sharding.NamedSharding(self.mesh, jax.sharding.PartitionSpec()),
-        )
+        self.weights = self._shard_weights(self.model.init(self.cfg))
         return self.weights
 
-    def fit(self, *, epochs: int | None = None, eval_fn=None):
+    def fit(self, *, epochs: int | None = None, eval_fn=None, resume: bool = False):
         """Run the full training loop; returns final weights.
 
         ``eval_fn(epoch, accuracy)`` is called at each test interval
-        (default: print the reference-format line)."""
+        (default: print the reference-format line).  With ``resume=True``
+        and a configured ``checkpoint_dir``, training restarts from the
+        latest saved epoch (the load path the reference never had).
+        """
         cfg = self.cfg
         if self._train_data is None:
             self.load_data()
+
+        ckpt = None
+        start_epoch = 0
+        if cfg.checkpoint_dir:
+            from distlr_tpu.train.checkpoint import Checkpointer  # noqa: PLC0415
+
+            ckpt = Checkpointer(cfg.checkpoint_dir)
+            if resume:
+                state = ckpt.restore()
+                if state is not None:
+                    self.weights = self._shard_weights(
+                        np.asarray(state["weights"]).reshape(
+                            np.asarray(self.model.init(cfg)).shape
+                        )
+                    )
+                    start_epoch = int(state["epoch"])
+                    log.info("resumed from checkpoint at epoch %d", start_epoch)
         if self.weights is None:
             self.init_weights()
         epochs = cfg.num_iteration if epochs is None else epochs
         test_batch = None
         if self._test_data is not None:
-            test_batch = shard_batch(self._test_data.full_batch(), self.mesh)
+            test_batch = self._shard_batch(self._test_data.full_batch())
 
-        for epoch in range(epochs):
-            for host_batch in self._train_data.batches(cfg.batch_size):
-                batch = shard_batch(host_batch, self.mesh)
-                self.timer.start()
-                self.weights, step_metrics = self.train_step(self.weights, batch)
-                jax.block_until_ready(self.weights)
-                self.timer.stop(int(host_batch[2].sum()))
-            if test_batch is not None and cfg.test_interval > 0 and (epoch + 1) % cfg.test_interval == 0:
-                acc = float(self.eval_step(self.weights, test_batch))
-                self.metrics.log(
-                    epoch=epoch + 1,
-                    accuracy=acc,
-                    loss=float(step_metrics["loss"]),
-                    samples_per_sec=self.timer.samples_per_sec,
-                )
-                if eval_fn is not None:
-                    eval_fn(epoch + 1, acc)
-                else:
-                    log_eval_line(epoch + 1, acc)
+        # exceptions mid-training must not leak the profiler trace or the
+        # checkpoint manager (pending async saves)
+        with contextlib.ExitStack() as stack:
+            if cfg.profile_dir:
+                stack.enter_context(jax.profiler.trace(cfg.profile_dir))
+            if ckpt is not None:
+                stack.callback(ckpt.close)
+
+            for epoch in range(start_epoch, epochs):
+                for host_batch in self._train_data.batches(cfg.batch_size):
+                    batch = self._shard_batch(host_batch)
+                    self.timer.start()
+                    self.weights, step_metrics = self.train_step(self.weights, batch)
+                    jax.block_until_ready(self.weights)
+                    self.timer.stop(int(host_batch[2].sum()))
+                if test_batch is not None and cfg.test_interval > 0 and (epoch + 1) % cfg.test_interval == 0:
+                    acc = float(self.eval_step(self.weights, test_batch))
+                    self.metrics.log(
+                        epoch=epoch + 1,
+                        accuracy=acc,
+                        loss=float(step_metrics["loss"]),
+                        samples_per_sec=self.timer.samples_per_sec,
+                    )
+                    if eval_fn is not None:
+                        eval_fn(epoch + 1, acc)
+                    else:
+                        log_eval_line(epoch + 1, acc)
+                if (
+                    ckpt is not None
+                    and cfg.checkpoint_interval > 0
+                    and (epoch + 1) % cfg.checkpoint_interval == 0
+                ):
+                    ckpt.save(epoch + 1, self.weights, extra={"epoch": epoch + 1})
+
+            if ckpt is not None and epochs > start_epoch and ckpt.latest_step() != epochs:
+                ckpt.save(epochs, self.weights, extra={"epoch": epochs})
         return self.weights
 
     def evaluate(self) -> float:
-        test_batch = shard_batch(self._test_data.full_batch(), self.mesh)
+        test_batch = self._shard_batch(self._test_data.full_batch())
         return float(self.eval_step(self.weights, test_batch))
 
     def save_model(self, path: str | None = None) -> str:

@@ -50,6 +50,46 @@ class TestLibsvmParse:
         np.testing.assert_array_equal(y, y2)
 
 
+class TestNativeParser:
+    def test_native_is_available(self):
+        from distlr_tpu.data.libsvm import native_available
+        assert native_available(), "native libsvm parser should build in this env"
+
+    def test_native_matches_python(self):
+        from distlr_tpu.data import _native
+        from distlr_tpu.data.libsvm import _parse_python
+
+        rng = np.random.default_rng(1)
+        lines = []
+        for i in range(500):
+            idx = np.sort(rng.choice(100, 8, replace=False)) + 1
+            feats = " ".join(f"{j}:{rng.standard_normal():.5g}" for j in idx)
+            lines.append(f"{'+1' if i % 3 else '-1'} {feats}")
+        blob = "\n".join(lines) + "\n"
+        for mc in (False, True):
+            native = _native.parse_libsvm_bytes(blob.encode(), mc)
+            python = _parse_python(blob.splitlines(), mc)
+            for a, b in zip(native, python):
+                np.testing.assert_array_equal(a, b)
+
+    def test_native_malformed_raises(self):
+        from distlr_tpu.data import _native
+
+        with pytest.raises(ValueError, match="malformed"):
+            _native.parse_libsvm_bytes(b"1 notafeature\n", False)
+
+    def test_file_parse_uses_same_semantics(self, tmp_path):
+        # end-to-end through parse_libsvm_file (which routes via native)
+        p = tmp_path / "f"
+        p.write_text("1 1:2.5 3:-1e2\n-1 2:4\n")
+        X, y = parse_libsvm_lines(p.read_text(), num_features=4)
+        from distlr_tpu.data.libsvm import parse_libsvm_file
+        X2, y2 = parse_libsvm_file(str(p), num_features=4)
+        np.testing.assert_array_equal(X, X2)
+        np.testing.assert_array_equal(y, y2)
+        assert X2[0, 2] == -100.0
+
+
 class TestDataIter:
     def _data(self, n=10, d=3):
         X = np.arange(n * d, dtype=np.float32).reshape(n, d)

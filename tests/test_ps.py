@@ -115,6 +115,42 @@ class TestKVBasics:
         with pytest.raises(ConnectionError):
             KVWorker("127.0.0.1:1", 4)
 
+    def test_invalid_keys_rejected(self):
+        with ServerGroup(2, 1, dim=8) as sg, KVWorker(sg.hosts, 8) as kv:
+            kv.push(np.zeros(8, np.float32))
+            with pytest.raises(ValueError, match="ascending"):
+                kv.pull(np.array([5, 2], dtype=np.uint64))
+            with pytest.raises(ValueError, match="out of range"):
+                kv.pull(np.array([3, 8], dtype=np.uint64))
+
+    def test_shutdown_with_multiple_workers_connected(self):
+        """Shutdown must terminate the server even while other workers
+        hold open connections (their reads are unblocked)."""
+        with ServerGroup(1, 2, dim=4) as sg:
+            kv0 = KVWorker(sg.hosts, 4, client_id=0)
+            kv1 = KVWorker(sg.hosts, 4, client_id=1)  # idle second connection
+            kv0.push(np.zeros(4, np.float32))
+            kv0.shutdown_servers()
+            sg.procs[0].wait(timeout=5)  # server process actually exits
+            assert sg.procs[0].returncode == 0
+            kv0.close()
+            kv1.close()
+
+    def test_worker_failure_does_not_hang_peers(self, ps_data_dir, tmp_path):
+        """A worker that dies (missing shard) must fail the run, not
+        deadlock the surviving workers at the sync barrier."""
+        import shutil
+
+        broken = tmp_path / "broken"
+        shutil.copytree(ps_data_dir, broken)
+        (broken / "train" / "part-002").unlink()  # worker 1's shard gone
+        cfg = Config(
+            data_dir=str(broken), num_feature_dim=16, num_workers=2,
+            num_servers=1, num_iteration=5, sync_mode=True, test_interval=0,
+        )
+        with pytest.raises(Exception):
+            run_ps_local(cfg)
+
 
 class TestPSTraining:
     def test_sync_ps_converges(self, ps_data_dir):

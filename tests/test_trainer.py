@@ -106,6 +106,24 @@ class TestTrainerEndToEnd:
         assert tr.timer.samples > 0 and tr.timer.samples_per_sec > 0
 
 
+class TestFeatureShardedTrainer:
+    def test_2d_mesh_end_to_end(self, data_dir):
+        cfg = Config(
+            data_dir=data_dir, num_feature_dim=24, num_iteration=40,
+            learning_rate=0.5, l2_c=0.0, test_interval=40,
+            mesh_shape={"data": 4, "model": 2},
+        )
+        tr = Trainer(cfg).load_data()
+        assert tr.feature_sharded
+        tr.fit()
+        acc = tr.evaluate()
+        assert acc > 0.8, f"2D-sharded accuracy {acc}"
+        # weights stay model-sharded on device but export flattens fine
+        path = tr.save_model()
+        w = load_model_text(path)
+        assert w.shape == (24,)
+
+
 class TestExport:
     def test_text_roundtrip(self, tmp_path):
         w = np.random.default_rng(0).standard_normal(17).astype(np.float32)
