@@ -1,0 +1,128 @@
+// Measured stand-in for the reference's CPU throughput.
+//
+// The reference itself cannot be built from this snapshot (its ps-lite
+// submodule is empty — see SURVEY.md §2.2 E1), so BASELINE.md's
+// "measure, don't quote" requirement is met by timing two single-process
+// reimplementations of the worker's gradient math on this machine:
+//
+//  1. "faithful": the reference's computational shape — an O(B*D^2)
+//     per-feature loop that recomputes the full dot product w.x for
+//     every feature j and copies the feature vector per access, matching
+//     the cost profile of LR::Train's hot loop (src/lr.cc:35-41 and the
+//     Sigmoid_/GetFeature call pattern).  Written from the survey's
+//     description of the algorithm, not from the source.
+//  2. "vectorized": the same gradient computed the sane O(B*D) way
+//     (one z pass, one accumulation pass) — the strongest plain-C++
+//     single-thread CPU baseline.
+//
+// Output: one JSON line per mode with samples/sec.
+//
+// Usage: reference_baseline [--dim=123] [--batch=1000] [--steps=5]
+
+#include <chrono>
+#include <cmath>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <random>
+#include <string>
+#include <vector>
+
+namespace {
+
+long Arg(int argc, char** argv, const char* name, long dflt) {
+  const std::string prefix = std::string("--") + name + "=";
+  for (int i = 1; i < argc; ++i) {
+    if (std::string(argv[i]).rfind(prefix, 0) == 0)
+      return std::atol(argv[i] + prefix.size());
+  }
+  return dflt;
+}
+
+struct Workload {
+  std::vector<std::vector<float>> rows;  // B x D dense features
+  std::vector<int> labels;
+  std::vector<float> weights;
+};
+
+Workload MakeWorkload(int batch, int dim) {
+  std::mt19937 gen(42);
+  std::normal_distribution<float> dist(0.0f, 1.0f);
+  Workload w;
+  w.rows.assign(batch, std::vector<float>(dim));
+  w.labels.resize(batch);
+  w.weights.resize(dim);
+  for (auto& row : w.rows)
+    for (auto& v : row) v = dist(gen);
+  for (int i = 0; i < batch; ++i) w.labels[i] = gen() & 1;
+  for (auto& v : w.weights) v = dist(gen) * 0.1f;
+  return w;
+}
+
+float DotCopied(const std::vector<float>& weights, std::vector<float> row) {
+  // deliberate by-value copy of the row, like the reference's
+  // GetFeature() accessor returning the whole vector per call
+  float z = 0.0f;
+  for (size_t j = 0; j < weights.size(); ++j) z += weights[j] * row[j];
+  return 1.0f / (1.0f + std::exp(-z));
+}
+
+// O(B*D^2): per-feature loop recomputing sigma(w.x) for every j.
+double StepFaithful(Workload& w, float lr, float c) {
+  const int dim = static_cast<int>(w.weights.size());
+  const int batch = static_cast<int>(w.rows.size());
+  std::vector<float> grad(dim);
+  for (int j = 0; j < dim; ++j) {
+    float gj = 0.0f;
+    for (int i = 0; i < batch; ++i) {
+      gj += (DotCopied(w.weights, w.rows[i]) - w.labels[i]) * w.rows[i][j];
+    }
+    grad[j] = gj / batch + c * w.weights[j] / batch;
+  }
+  for (int j = 0; j < dim; ++j) w.weights[j] -= lr * grad[j];
+  return grad[0];
+}
+
+// O(B*D): one forward pass, one accumulation pass.
+double StepVectorized(Workload& w, float lr, float c) {
+  const int dim = static_cast<int>(w.weights.size());
+  const int batch = static_cast<int>(w.rows.size());
+  std::vector<float> grad(dim, 0.0f);
+  for (int i = 0; i < batch; ++i) {
+    const auto& row = w.rows[i];
+    float z = 0.0f;
+    for (int j = 0; j < dim; ++j) z += w.weights[j] * row[j];
+    const float r = 1.0f / (1.0f + std::exp(-z)) - w.labels[i];
+    for (int j = 0; j < dim; ++j) grad[j] += r * row[j];
+  }
+  for (int j = 0; j < dim; ++j) {
+    grad[j] = grad[j] / batch + c * w.weights[j] / batch;
+    w.weights[j] -= lr * grad[j];
+  }
+  return grad[0];
+}
+
+template <typename StepFn>
+void Bench(const char* name, StepFn step, int batch, int dim, int steps) {
+  Workload w = MakeWorkload(batch, dim);
+  volatile double sink = step(w, 0.2f, 1.0f);  // warmup
+  auto t0 = std::chrono::steady_clock::now();
+  for (int s = 0; s < steps; ++s) sink += step(w, 0.2f, 1.0f);
+  auto t1 = std::chrono::steady_clock::now();
+  const double sec = std::chrono::duration<double>(t1 - t0).count();
+  (void)sink;
+  printf("{\"mode\": \"%s\", \"dim\": %d, \"batch\": %d, "
+         "\"samples_per_sec\": %.1f}\n",
+         name, dim, batch, batch * steps / sec);
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  const int dim = static_cast<int>(Arg(argc, argv, "dim", 123));
+  const int batch = static_cast<int>(Arg(argc, argv, "batch", 1000));
+  const int steps = static_cast<int>(Arg(argc, argv, "steps", 5));
+  Bench("faithful_obd2", StepFaithful, batch, dim, steps);
+  Bench("vectorized_obd", StepVectorized, batch, dim, steps);
+  return 0;
+}

@@ -74,14 +74,29 @@ _NATIVE = _try_native()
 
 
 def native_available() -> bool:
-    return _NATIVE is not None
+    """True iff the native parser is importable AND its .so builds/loads."""
+    if _NATIVE is None:
+        return False
+    try:
+        _NATIVE._load()
+        return True
+    except Exception:
+        return False
 
 
 def _parse_csr(text_or_lines, multiclass: bool):
+    global _NATIVE
     if isinstance(text_or_lines, (bytes, str)):
         if _NATIVE is not None:
             data = text_or_lines.encode() if isinstance(text_or_lines, str) else text_or_lines
-            return _NATIVE.parse_libsvm_bytes(data, multiclass)
+            try:
+                return _NATIVE.parse_libsvm_bytes(data, multiclass)
+            except ValueError:
+                raise  # malformed input is a real error, not a fallback case
+            except Exception:
+                # build/load failure (no toolchain, bad .so): fall back to
+                # the pure-Python tokenizer permanently for this process
+                _NATIVE = None
         lines = (text_or_lines.decode() if isinstance(text_or_lines, bytes) else text_or_lines).splitlines()
         return _parse_python(lines, multiclass)
     return _parse_python(text_or_lines, multiclass)

@@ -1,0 +1,1 @@
+from distlr_tpu.ops.pallas_lr import fused_lr_grad, fused_lr_supported  # noqa: F401

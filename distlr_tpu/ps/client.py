@@ -66,9 +66,10 @@ class KVWorker:
         here rather than returning silently-wrong slices."""
         keys = np.ascontiguousarray(keys, dtype=np.uint64)
         if keys.size:
-            if keys[-1] >= self.dim:
-                raise ValueError(f"key {int(keys[-1])} out of range (dim={self.dim})")
-            if keys.size > 1 and not (np.diff(keys.view(np.int64)) > 0).all():
+            kmax = int(keys.max())  # unsigned max, not last element
+            if kmax >= self.dim:
+                raise ValueError(f"key {kmax} out of range (dim={self.dim})")
+            if keys.size > 1 and not (keys[1:] > keys[:-1]).all():
                 raise ValueError("keys must be strictly ascending")
         return keys
 
