@@ -74,6 +74,7 @@ class Config:
     # ---- model ----
     model: str = "binary_lr"          # binary_lr | softmax | sparse_lr
     num_classes: int = 2              # softmax only
+    nnz_max: int | None = None        # sparse_lr: cap per-row nonzeros (pad width)
     dtype: str = "float32"            # accumulation dtype
     compute_dtype: str = "bfloat16"   # matmul dtype on TPU (MXU-friendly)
 

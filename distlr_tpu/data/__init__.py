@@ -2,3 +2,10 @@ from distlr_tpu.data.libsvm import parse_libsvm_file, parse_libsvm_lines, write_
 from distlr_tpu.data.iterator import DataIter  # noqa: F401
 from distlr_tpu.data.synthetic import make_synthetic_dataset, write_synthetic_shards  # noqa: F401
 from distlr_tpu.data.sharding import shard_libsvm_file, prepare_data_dir  # noqa: F401
+from distlr_tpu.data.hashing import (  # noqa: F401
+    HashedFeatureEncoder,
+    csr_to_padded_coo,
+    hash_buckets,
+    make_ctr_dataset,
+    write_ctr_shards,
+)

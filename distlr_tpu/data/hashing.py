@@ -1,0 +1,244 @@
+"""Feature hashing (the "hashing trick") for CTR-scale workloads.
+
+The reference caps out at dense feature vectors whose dimension is fixed by
+``NUM_FEATURE_DIM`` (``examples/local.sh:14``) — its north-star scaling
+path, per BASELINE.json configs 3-4 (Criteo hashed-to-dense 1M features,
+Avazu sparse one-hot), needs categorical features of unbounded vocabulary
+hashed into a fixed bucket space.  This module provides:
+
+* a vectorized 64-bit mixer (splitmix64) — deterministic, seed-parameterized,
+  numpy-only, no Python-object hashing (``hash()`` is salted per process);
+* CSR -> hashed padded-COO / hashed dense conversion, feeding either the
+  ``SparseBinaryLR`` segment_sum path or the dense MXU path;
+* a deterministic synthetic CTR generator (fields x vocab -> one active
+  value per field) with ground-truth weights *in bucket space*, so
+  convergence tests can assert signal recovery after hashing collisions;
+* a reference-layout shard writer (one-hot libsvm rows over bucket ids),
+  so the whole existing libsvm pipeline (native parser, sharding,
+  trainer) runs unchanged on hashed CTR data.
+
+Sign hashing (Weinberger et al.'s +/-1 trick) is supported to de-bias
+collision noise: ``val = sign(h') * raw_val``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+
+import numpy as np
+
+__all__ = [
+    "splitmix64",
+    "hash_buckets",
+    "HashedFeatureEncoder",
+    "csr_to_padded_coo",
+    "make_ctr_dataset",
+    "write_ctr_shards",
+]
+
+_U64 = np.uint64
+
+
+def splitmix64(x: np.ndarray) -> np.ndarray:
+    """Vectorized splitmix64 finalizer: uint64 array -> uint64 array.
+
+    Full-avalanche integer mixer (each input bit flips ~half the output
+    bits) — the standard seed-expander of the xoshiro family.
+    """
+    x = x.astype(_U64, copy=True)
+    with np.errstate(over="ignore"):
+        x += _U64(0x9E3779B97F4A7C15)
+        z = x
+        z = (z ^ (z >> _U64(30))) * _U64(0xBF58476D1CE4E5B9)
+        z = (z ^ (z >> _U64(27))) * _U64(0x94D049BB133111EB)
+        z = z ^ (z >> _U64(31))
+    return z
+
+
+def hash_buckets(ids: np.ndarray, num_buckets: int, *, seed: int = 0, field_ids=None):
+    """Hash integer feature ids into ``[0, num_buckets)``.
+
+    ``field_ids`` (same shape or broadcastable) namespaces ids per
+    categorical field so value 7 in field 0 and value 7 in field 1 land in
+    independent buckets.  Returns ``(buckets, signs)`` where ``signs`` is
+    the +/-1 sign-hash (float32) derived from an independent bit of the
+    same mix.
+    """
+    h = np.asarray(ids, dtype=np.int64).astype(_U64)
+    if field_ids is not None:
+        with np.errstate(over="ignore"):
+            h = h + splitmix64(np.asarray(field_ids, dtype=np.int64).astype(_U64) + _U64(0x51))
+    with np.errstate(over="ignore"):
+        h = splitmix64(h + splitmix64(np.full_like(h, _U64(seed))))
+    buckets = (h % _U64(num_buckets)).astype(np.int64)
+    # bit 63 is independent of the modulus for num_buckets << 2^63
+    signs = np.where((h >> _U64(63)).astype(bool), np.float32(1.0), np.float32(-1.0))
+    return buckets, signs
+
+
+@dataclasses.dataclass(frozen=True)
+class HashedFeatureEncoder:
+    """Stateless encoder from raw (field, id, value) features to a fixed
+    ``num_buckets``-dimensional space.
+
+    The TPU-native successor of the reference's fixed ``NUM_FEATURE_DIM``
+    contract (``src/main.cc:130-131``): instead of requiring the data to
+    already live in ``[0, D)``, any 64-bit id space is folded into
+    ``[0, num_buckets)`` deterministically.
+    """
+
+    num_buckets: int
+    seed: int = 0
+    signed: bool = False
+
+    def encode_coo(self, field_ids, raw_ids, raw_vals=None):
+        """(..., F) raw ids -> (cols, vals) in bucket space, same shape."""
+        cols, signs = hash_buckets(
+            raw_ids, self.num_buckets, seed=self.seed, field_ids=field_ids
+        )
+        vals = np.ones(cols.shape, np.float32) if raw_vals is None else np.asarray(
+            raw_vals, np.float32
+        )
+        if self.signed:
+            vals = vals * signs
+        return cols, vals
+
+    def encode_dense(self, field_ids, raw_ids, raw_vals=None):
+        """(B, F) raw ids -> dense (B, num_buckets) float32 (scatter-add)."""
+        cols, vals = self.encode_coo(field_ids, raw_ids, raw_vals)
+        B = cols.shape[0]
+        X = np.zeros((B, self.num_buckets), np.float32)
+        rows = np.repeat(np.arange(B), cols.shape[1])
+        np.add.at(X, (rows, cols.reshape(-1)), vals.reshape(-1))
+        return X
+
+    def encode_csr(self, row_ptr, cols, vals):
+        """Rehash CSR column ids (no field namespacing) into bucket space;
+        returns CSR with the same row_ptr."""
+        new_cols, signs = hash_buckets(cols, self.num_buckets, seed=self.seed)
+        new_vals = np.asarray(vals, np.float32)
+        if self.signed:
+            new_vals = new_vals * signs
+        return row_ptr, new_cols, new_vals
+
+
+def csr_to_padded_coo(row_ptr, cols, vals, *, nnz_max: int | None = None):
+    """CSR arrays -> static-shape padded COO ``(cols, vals)`` of shape
+    ``(B, nnz_max)`` (pad col = 0, pad val = 0) — the ``SparseBinaryLR``
+    batch layout (static shapes; XLA compiles one program per NNZ_MAX).
+
+    Rows longer than ``nnz_max`` are truncated (keeping the first entries);
+    callers wanting losslessness pass ``nnz_max=None`` (= longest row).
+    """
+    row_ptr = np.asarray(row_ptr)
+    n = len(row_ptr) - 1
+    lengths = np.diff(row_ptr)
+    if nnz_max is None:
+        nnz_max = int(lengths.max()) if n else 0
+    nnz_max = max(int(nnz_max), 1)
+    out_cols = np.zeros((n, nnz_max), np.int32)
+    out_vals = np.zeros((n, nnz_max), np.float32)
+    # vectorized gather: entry (i, j) reads CSR slot row_ptr[i] + j while
+    # j < min(len_i, nnz_max) (startup-path hot loop for CTR-scale shards)
+    j = np.arange(nnz_max)[None, :]
+    valid = j < np.minimum(lengths, nnz_max)[:, None]
+    src = row_ptr[:-1, None] + j
+    out_cols[valid] = cols[src[valid]]
+    out_vals[valid] = vals[src[valid]]
+    return out_cols, out_vals
+
+
+def make_ctr_dataset(
+    num_samples: int,
+    num_fields: int,
+    vocab_size: int,
+    num_buckets: int,
+    *,
+    seed: int = 0,
+    signed: bool = False,
+    noise: float = 0.0,
+):
+    """Deterministic synthetic CTR data: ``num_fields`` categorical fields,
+    each drawing one value from ``vocab_size``, labels from a logistic
+    model over the *hashed* one-hot encoding.
+
+    Ground truth lives in bucket space (``w_true`` shape
+    ``(num_buckets,)``), so the learnable signal survives hash collisions
+    by construction and convergence tests can assert recovery.
+
+    Returns ``(raw_ids, cols, vals, y, w_true)`` where ``raw_ids`` is the
+    ``(N, F)`` categorical draw, ``(cols, vals)`` its ``(N, F)`` hashed
+    padded-COO encoding, and ``y`` in {0,1}.
+    """
+    rng = np.random.default_rng(seed)
+    raw_ids = rng.integers(0, vocab_size, size=(num_samples, num_fields))
+    field_ids = np.broadcast_to(np.arange(num_fields), raw_ids.shape)
+    enc = HashedFeatureEncoder(num_buckets, seed=seed, signed=signed)
+    cols, vals = enc.encode_coo(field_ids, raw_ids)
+    w_true = (rng.standard_normal(num_buckets) * (3.0 / np.sqrt(num_fields))).astype(
+        np.float32
+    )
+    logits = np.sum(w_true[cols] * vals, axis=-1)
+    if noise > 0.0:
+        logits += noise * rng.standard_normal(num_samples)
+    p = 1.0 / (1.0 + np.exp(-logits))
+    y = (rng.random(num_samples) < p).astype(np.int32)
+    return raw_ids, cols.astype(np.int32), vals, y, w_true
+
+
+def write_ctr_shards(
+    data_dir: str,
+    num_samples: int,
+    num_fields: int,
+    vocab_size: int,
+    num_buckets: int,
+    num_parts: int,
+    *,
+    seed: int = 0,
+    test_fraction: float = 0.2,
+) -> dict:
+    """Write hashed one-hot CTR data as reference-layout libsvm shards
+    (``train/part-001..``, ``test/part-001``, ``models/``), rows being
+    ``label idx:1 idx:1 ...`` over 1-based bucket ids — byte-compatible
+    with the reference's data contract (``include/data_iter.h:19-34``) at
+    ``NUM_FEATURE_DIM = num_buckets``."""
+    from distlr_tpu.data.sharding import part_name  # noqa: PLC0415
+
+    _, cols, vals, y, w_true = make_ctr_dataset(
+        num_samples, num_fields, vocab_size, num_buckets, seed=seed
+    )
+    n_test = int(num_samples * test_fraction)
+    os.makedirs(os.path.join(data_dir, "train"), exist_ok=True)
+    os.makedirs(os.path.join(data_dir, "test"), exist_ok=True)
+    os.makedirs(os.path.join(data_dir, "models"), exist_ok=True)
+
+    def _write(path, c, v, labels):
+        with open(path, "w") as f:
+            for i in range(len(labels)):
+                toks = [str(2 * int(labels[i]) - 1)]  # +/-1 labels like a9a
+                # merge intra-row hash collisions (sum values per bucket) —
+                # libsvm indices must be unique & ascending, and the dense
+                # parse path assigns rather than accumulates duplicates
+                uniq, inv = np.unique(c[i], return_inverse=True)
+                summed = np.zeros(len(uniq), np.float32)
+                np.add.at(summed, inv, v[i])
+                toks += [
+                    f"{int(uc) + 1}:{sv:g}" for uc, sv in zip(uniq, summed) if sv != 0
+                ]
+                f.write(" ".join(toks) + "\n")
+
+    ctr, cte = cols[n_test:], cols[:n_test]
+    vtr, vte = vals[n_test:], vals[:n_test]
+    ytr, yte = y[n_test:], y[:n_test]
+    parts = []
+    for i in range(num_parts):
+        sl = slice(i * len(ytr) // num_parts, (i + 1) * len(ytr) // num_parts)
+        path = os.path.join(data_dir, "train", part_name(i))
+        _write(path, ctr[sl], vtr[sl], ytr[sl])
+        parts.append(path)
+    test_path = os.path.join(data_dir, "test", part_name(0))
+    _write(test_path, cte, vte, yte)
+    w_path = os.path.join(data_dir, "w_true.npy")
+    np.save(w_path, w_true)
+    return {"train_parts": parts, "test_path": test_path, "w_true_path": w_path}

@@ -40,8 +40,10 @@ def _add_config_flags(p: argparse.ArgumentParser) -> None:
     p.add_argument("--learning-rate", dest="learning_rate", type=float)
     p.add_argument("--l2-c", dest="l2_c", type=float)
     p.add_argument("--test-interval", dest="test_interval", type=int)
-    p.add_argument("--model", choices=["binary_lr", "softmax"])
+    p.add_argument("--model", choices=["binary_lr", "softmax", "sparse_lr"])
     p.add_argument("--num-classes", dest="num_classes", type=int)
+    p.add_argument("--nnz-max", dest="nnz_max", type=int,
+                   help="sparse_lr: cap per-row nonzeros (pad width)")
     p.add_argument("--compat-mode", dest="compat_mode", choices=["correct", "reference"])
     p.add_argument("--checkpoint-dir", dest="checkpoint_dir")
     p.add_argument("--checkpoint-interval", dest="checkpoint_interval", type=int)
@@ -72,7 +74,7 @@ def _config_from_args(args: argparse.Namespace) -> Config:
         in {
             "data_dir", "num_feature_dim", "num_iteration", "batch_size",
             "learning_rate", "l2_c", "test_interval", "model", "num_classes",
-            "compat_mode", "checkpoint_dir", "checkpoint_interval",
+            "nnz_max", "compat_mode", "checkpoint_dir", "checkpoint_interval",
             "profile_dir", "num_workers", "num_servers",
         }
     }

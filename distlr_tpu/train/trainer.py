@@ -53,30 +53,69 @@ class GlobalShardedData:
     own mesh slot.
     """
 
-    def __init__(self, shards: list[tuple[np.ndarray, np.ndarray]]):
+    def __init__(self, shards: list[tuple[np.ndarray, ...]]):
+        """Each shard is ``(*feature_leaves, y)`` — dense ``(X, y)`` or
+        padded-COO sparse ``(cols, vals, y)``; all leaves share the sample
+        (leading) axis."""
         if not shards:
             raise ValueError("need at least one shard")
         self.num_shards = len(shards)
-        self.shard_sizes = [len(y) for _, y in shards]
+        self.shard_sizes = [len(s[-1]) for s in shards]
         n_pad = max(self.shard_sizes)
         if n_pad == 0:
             raise ValueError("all shards are empty — no training data")
-        feat_shape = shards[0][0].shape[1:]
         W = self.num_shards
-        self.X = np.zeros((W, n_pad) + feat_shape, dtype=shards[0][0].dtype)
-        self.y = np.zeros((W, n_pad), dtype=shards[0][1].dtype)
+        n_feat_leaves = len(shards[0]) - 1
+        # sparse shards may disagree on NNZ_MAX; pad trailing dims to match
+        trail = [
+            tuple(
+                max(s[k].shape[j] for s in shards)
+                for j in range(1, shards[0][k].ndim)
+            )
+            for k in range(n_feat_leaves)
+        ]
+        self._feats = [
+            np.zeros((W, n_pad) + trail[k], dtype=shards[0][k].dtype)
+            for k in range(n_feat_leaves)
+        ]
+        self.y = np.zeros((W, n_pad), dtype=shards[0][-1].dtype)
         self.mask = np.zeros((W, n_pad), dtype=np.float32)
-        for i, (Xi, yi) in enumerate(shards):
-            self.X[i, : len(yi)] = Xi
-            self.y[i, : len(yi)] = yi
-            self.mask[i, : len(yi)] = 1.0
+        for i, shard in enumerate(shards):
+            n = len(shard[-1])
+            for k in range(n_feat_leaves):
+                leaf = shard[k]
+                sl = (i, slice(0, n)) + tuple(slice(0, d) for d in leaf.shape[1:])
+                self._feats[k][sl] = leaf
+            self.y[i, :n] = shard[-1]
+            self.mask[i, :n] = 1.0
         self.n_pad = n_pad
 
+    @property
+    def X(self) -> np.ndarray:
+        """The single dense feature matrix (dense datasets only)."""
+        if len(self._feats) != 1:
+            raise AttributeError("X is only defined for dense (single-leaf) data")
+        return self._feats[0]
+
     @classmethod
-    def from_data_dir(cls, data_dir: str, split: str, num_shards: int, num_features: int, *, multiclass=False):
+    def from_data_dir(
+        cls,
+        data_dir: str,
+        split: str,
+        num_shards: int,
+        num_features: int,
+        *,
+        multiclass=False,
+        sparse: bool = False,
+        nnz_max: int | None = None,
+    ):
         """Load ``data_dir/{split}/part-001..W`` (reference layout,
         ``src/main.cc:158-159``). If fewer parts exist than mesh shards,
-        parts are round-robined; if more, they are concatenated down."""
+        parts are round-robined; if more, they are concatenated down.
+
+        ``sparse=True`` keeps rows as padded-COO ``(cols, vals)`` for the
+        ``segment_sum`` path instead of densifying (CTR-style data where
+        ``(N, D)`` dense would not fit host RAM)."""
         paths = []
         i = 0
         while True:
@@ -87,12 +126,34 @@ class GlobalShardedData:
             i += 1
         if not paths:
             raise FileNotFoundError(f"no shards under {data_dir}/{split}")
-        parts = [parse_libsvm_file(p, num_features, multiclass=multiclass) for p in paths]
+        parts = []
+        for p in paths:
+            if sparse:
+                from distlr_tpu.data.hashing import csr_to_padded_coo  # noqa: PLC0415
+
+                (row_ptr, cols, vals), y = parse_libsvm_file(
+                    p, num_features, dense=False, multiclass=multiclass
+                )
+                pc, pv = csr_to_padded_coo(row_ptr, cols, vals, nnz_max=nnz_max)
+                parts.append((pc, pv, y))
+            else:
+                parts.append(parse_libsvm_file(p, num_features, multiclass=multiclass))
         if len(parts) != num_shards:
-            X = np.concatenate([p[0] for p in parts])
-            y = np.concatenate([p[1] for p in parts])
+
+            def _concat(arrs):
+                # parts may disagree on trailing dims (per-part NNZ_MAX)
+                trail = tuple(
+                    max(a.shape[j] for a in arrs) for j in range(1, arrs[0].ndim)
+                )
+                padded = [
+                    np.pad(a, [(0, 0)] + [(0, t - s) for t, s in zip(trail, a.shape[1:])])
+                    for a in arrs
+                ]
+                return np.concatenate(padded)
+
+            leaves = [_concat([p[k] for p in parts]) for k in range(len(parts[0]))]
             shards = [
-                (X[i::num_shards], y[i::num_shards]) for i in range(num_shards)
+                tuple(leaf[i::num_shards] for leaf in leaves) for i in range(num_shards)
             ]
         else:
             shards = parts
@@ -103,43 +164,35 @@ class GlobalShardedData:
         return int(sum(self.shard_sizes))
 
     def batches(self, per_worker_batch: int):
-        """One epoch of lockstep global batches ``(X, y, mask)`` shaped
-        ``(W*b, ...)``. ``-1`` = full shard per worker (one step/epoch)."""
+        """One epoch of lockstep global batches ``(*feats, y, mask)``
+        shaped ``(W*b, ...)``. ``-1`` = full shard per worker (one
+        step/epoch)."""
         b = self.n_pad if per_worker_batch == -1 else min(per_worker_batch, self.n_pad)
+
+        def _slice(arr, sl, bw):
+            out = arr[:, sl]
+            if bw < b:  # pad the short final batch to static shape
+                pad = [(0, 0), (0, b - bw)] + [(0, 0)] * (arr.ndim - 2)
+                out = np.pad(out, pad)
+            return out.reshape((-1,) + arr.shape[2:])
+
         for k in range(-(-self.n_pad // b)):
             sl = slice(k * b, min((k + 1) * b, self.n_pad))
             bw = sl.stop - sl.start
-            X = self.X[:, sl].reshape((-1,) + self.X.shape[2:])
-            y = self.y[:, sl].reshape(-1)
-            mask = self.mask[:, sl].reshape(-1)
-            if bw < b:  # pad the short final batch to static shape
-                pad = b - bw
-                W = self.num_shards
-                X = np.concatenate(
-                    [X.reshape(W, bw, -1), np.zeros((W, pad, X.shape[-1]), X.dtype)], axis=1
-                ).reshape(W * b, -1)
-                y = np.concatenate([y.reshape(W, bw), np.zeros((W, pad), y.dtype)], axis=1).reshape(-1)
-                mask = np.concatenate(
-                    [mask.reshape(W, bw), np.zeros((W, pad), mask.dtype)], axis=1
-                ).reshape(-1)
-            yield X, y, mask
+            yield tuple(
+                _slice(a, sl, bw) for a in (*self._feats, self.y, self.mask)
+            )
 
     def full_batch(self):
-        X = self.X.reshape((-1,) + self.X.shape[2:])
-        return X, self.y.reshape(-1), self.mask.reshape(-1)
+        return tuple(
+            a.reshape((-1,) + a.shape[2:]) for a in (*self._feats, self.y, self.mask)
+        )
 
 
 class Trainer:
     """End-to-end sync training: data -> mesh -> SPMD steps -> eval -> export."""
 
     def __init__(self, cfg: Config, *, mesh=None, metrics: MetricsLogger | None = None):
-        if cfg.model == "sparse_lr":
-            # The padded-COO data path is served by SparseBinaryLR directly;
-            # Trainer's shard loader is dense-only for now.
-            raise NotImplementedError(
-                "Trainer supports dense models (binary_lr, softmax); drive "
-                "sparse_lr via distlr_tpu.models.SparseBinaryLR directly"
-            )
         self.cfg = cfg
         if mesh is None:
             # honor a local.sh-style DMLC_NUM_WORKER > 1 as the data-axis
@@ -154,6 +207,13 @@ class Trainer:
         # A mesh with a 'model' axis selects the 2D data x feature-sharded
         # path (weights partitioned like ps-lite's server key ranges).
         self.feature_sharded = MODEL_AXIS in mesh.axis_names
+        if self.feature_sharded and cfg.model == "sparse_lr":
+            # w[cols] gathers arbitrary buckets; a partitioned w would turn
+            # every gather into a cross-shard collective. Shard the data
+            # axis instead (sparse batches are small by construction).
+            raise NotImplementedError(
+                "sparse_lr supports data-parallel meshes only (no 'model' axis)"
+            )
         if self.feature_sharded:
             from distlr_tpu.parallel.feature_parallel import (  # noqa: PLC0415
                 make_feature_sharded_eval_step,
@@ -182,11 +242,14 @@ class Trainer:
     def load_data(self, train: GlobalShardedData | None = None, test: GlobalShardedData | None = None):
         W = num_data_shards(self.mesh)
         multiclass = self.cfg.model == "softmax"
+        sparse = self.cfg.model == "sparse_lr"
         self._train_data = train or GlobalShardedData.from_data_dir(
-            self.cfg.data_dir, "train", W, self.cfg.num_feature_dim, multiclass=multiclass
+            self.cfg.data_dir, "train", W, self.cfg.num_feature_dim,
+            multiclass=multiclass, sparse=sparse, nnz_max=self.cfg.nnz_max,
         )
         self._test_data = test or GlobalShardedData.from_data_dir(
-            self.cfg.data_dir, "test", W, self.cfg.num_feature_dim, multiclass=multiclass
+            self.cfg.data_dir, "test", W, self.cfg.num_feature_dim,
+            multiclass=multiclass, sparse=sparse, nnz_max=self.cfg.nnz_max,
         )
         return self
 
@@ -244,7 +307,7 @@ class Trainer:
                     self.timer.start()
                     self.weights, step_metrics = self.train_step(self.weights, batch)
                     jax.block_until_ready(self.weights)
-                    self.timer.stop(int(host_batch[2].sum()))
+                    self.timer.stop(int(host_batch[-1].sum()))
                 if test_batch is not None and cfg.test_interval > 0 and (epoch + 1) % cfg.test_interval == 0:
                     acc = float(self.eval_step(self.weights, test_batch))
                     self.metrics.log(
