@@ -1,0 +1,260 @@
+"""Per-config benchmarks for the five BASELINE.json workloads.
+
+BASELINE.json names five parity configs (none with published numbers —
+SURVEY.md §6); this script measures this framework on each and writes
+``BENCH_CONFIGS.json``:
+
+1. dense binary LR, synthetic gen-data layout, 1 worker / 1 server
+2. 4-worker async-SGD dense LR (native C++ PS servers, Hogwild)
+3. Criteo-style CTR hashed-to-dense (north-star D, MXU dense path)
+4. sparse one-hot LR (Avazu-style, segment_sum gradients)
+5. multinomial softmax regression (MNIST-shaped: D=784, K=10)
+
+Each row reports steady-state training ``samples_per_sec`` and a
+convergence metric (final accuracy, plus logloss where meaningful) so
+perf claims stay tied to statistical quality.  ``--quick`` shrinks every
+workload for CPU / smoke runs (this is what CI exercises); the full sizes
+are TPU-scale.
+
+Run: ``python benchmarks/bench_configs.py [--quick] [--configs 1,3,5]``
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+sys.path.insert(0, REPO)
+
+
+def _steady_state_sps(step, w, batch, steps: int, batch_samples: int) -> float:
+    """samples/sec of ``w = step(w, batch)`` iterated ``steps`` times.
+
+    One warmup call compiles; timing ends on a device->host readback (on
+    the axon platform ``block_until_ready`` returns at dispatch time)."""
+    import jax
+    import jax.numpy as jnp
+
+    w = step(w, batch)
+    _ = float(jnp.sum(jax.tree.leaves(w)[0]))  # sync
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        w = step(w, batch)
+    _ = float(jnp.sum(jax.tree.leaves(w)[0]))  # sync
+    dt = time.perf_counter() - t0
+    return batch_samples * steps / dt
+
+
+def _scan_step(model, cfg):
+    """Plain SGD step (no mesh): the 1-chip hot path."""
+    import jax
+
+    @jax.jit
+    def step(w, batch):
+        g = model.grad(w, batch, cfg)
+        return jax.tree.map(lambda p, t: p - cfg.learning_rate * t, w, g)
+
+    return step
+
+
+def bench_config_1(quick: bool) -> dict:
+    """Dense binary LR on gen-data-layout synthetic shards, single chip
+    (the reference's ``local.sh 1 1`` workload, ``examples/local.sh:6-9``)."""
+    import tempfile
+
+    from distlr_tpu import Config
+    from distlr_tpu.data import write_synthetic_shards
+    from distlr_tpu.train import Trainer
+
+    n, d, epochs = (4000, 123, 40) if quick else (100_000, 123, 100)
+    with tempfile.TemporaryDirectory() as tmp:
+        write_synthetic_shards(tmp, n, d, num_parts=1, seed=42)
+        cfg = Config(
+            data_dir=tmp, num_feature_dim=d, num_iteration=epochs,
+            learning_rate=0.5, l2_c=0.0, test_interval=epochs,
+        )
+        tr = Trainer(cfg).load_data()
+        tr.fit(eval_fn=lambda *_: None)
+        acc = float(tr.evaluate())
+        sps = tr.timer.samples_per_sec
+    return {
+        "config": 1,
+        "name": "dense binary LR, synthetic gen-data, 1W/1S sync",
+        "samples_per_sec": round(sps, 1),
+        "accuracy": round(acc, 4),
+    }
+
+
+def bench_config_2(quick: bool) -> dict:
+    """4-worker asynchronous (Hogwild) dense LR against native C++ KV
+    servers — the reference's ``SYNC_MODE=0`` path (``src/main.cc:79-84``)."""
+    import tempfile
+
+    from distlr_tpu import Config
+    from distlr_tpu.data import write_synthetic_shards
+    from distlr_tpu.train.ps_trainer import run_ps_local
+
+    n, d, epochs = (4000, 123, 15) if quick else (100_000, 123, 60)
+    t0 = time.perf_counter()
+    with tempfile.TemporaryDirectory() as tmp:
+        write_synthetic_shards(tmp, n, d, num_parts=4, seed=42)
+        cfg = Config(
+            data_dir=tmp, num_feature_dim=d, num_iteration=epochs,
+            learning_rate=0.1, l2_c=0.0, test_interval=epochs,
+            sync_mode=False, num_workers=4, num_servers=2, batch_size=256,
+        )
+        accs: list[float] = []
+        run_ps_local(cfg, eval_fn=lambda _epoch, a: accs.append(a))
+    dt = time.perf_counter() - t0
+    n_train = int(n * 0.8)
+    return {
+        "config": 2,
+        "name": "4-worker async-SGD dense LR (native PS, Hogwild)",
+        "samples_per_sec": round(n_train * epochs / dt, 1),
+        "accuracy": round(accs[-1], 4) if accs else None,
+    }
+
+
+def bench_config_3(quick: bool) -> dict:
+    """Criteo-style hashed-to-dense CTR at north-star width: dense MXU
+    path, device-resident one-hot-ish features (BASELINE.json config 3)."""
+    import jax
+    import jax.numpy as jnp
+
+    from distlr_tpu import Config
+    from distlr_tpu.models import BinaryLR
+
+    d, b, steps = (1 << 14, 512, 6) if quick else (1_000_000, 2048, 20)
+    cfg = Config(num_feature_dim=d, learning_rate=0.2, l2_c=0.0)
+    model = BinaryLR(d)
+
+    @jax.jit
+    def make(key):
+        # hashed-to-dense CTR: F active buckets per row; dense bf16 layout
+        kcols, ky = jax.random.split(key)
+        cols = jax.random.randint(kcols, (b, 39), 0, d)
+        X = jnp.zeros((b, d), jnp.bfloat16)
+        X = jax.vmap(lambda row, c: row.at[c].set(1))(X, cols)
+        y = jax.random.bernoulli(ky, 0.5, (b,)).astype(jnp.int32)
+        return X, y, jnp.ones((b,), jnp.float32)
+
+    batch = jax.block_until_ready(make(jax.random.PRNGKey(0)))
+    step = _scan_step(model, cfg)
+    w = jnp.zeros(d, jnp.float32)
+    sps = _steady_state_sps(step, w, batch, steps, b)
+    return {
+        "config": 3,
+        "name": f"Criteo-style hashed-to-dense CTR, D={d}, dense MXU path",
+        "samples_per_sec": round(sps, 1),
+    }
+
+
+def bench_config_4(quick: bool) -> dict:
+    """Avazu-style sparse one-hot LR: padded-COO batches, gather forward,
+    segment_sum gradient (BASELINE.json config 4).  Also reports
+    convergence on a small hashed-CTR problem."""
+    import jax.numpy as jnp
+
+    from distlr_tpu import Config
+    from distlr_tpu.data.hashing import make_ctr_dataset
+    from distlr_tpu.models import SparseBinaryLR
+
+    # throughput at scale: D=1M buckets, 21 fields (Avazu's feature count)
+    d, b, fields, steps = (1 << 14, 2048, 21, 8) if quick else (1_000_000, 65536, 21, 20)
+    cfg = Config(num_feature_dim=d, learning_rate=0.5, l2_c=0.0, model="sparse_lr")
+    model = SparseBinaryLR(d)
+    _, cols, vals, y, _w = make_ctr_dataset(b, fields, 10_000_000, d, seed=0)
+    batch = (jnp.asarray(cols), jnp.asarray(vals), jnp.asarray(y), jnp.ones(b, jnp.float32))
+    step = _scan_step(model, cfg)
+    sps = _steady_state_sps(step, jnp.zeros(d, jnp.float32), batch, steps, b)
+
+    # convergence (small): recover hashed signal to near-oracle accuracy
+    dc, nc = 512, 6000
+    _, ccols, cvals, cy, w_true = make_ctr_dataset(nc, 8, 5000, dc, seed=1)
+    oracle = float(((np.sum(w_true[ccols] * cvals, -1) > 0).astype(int) == cy).mean())
+    ccfg = Config(num_feature_dim=dc, learning_rate=1.0, l2_c=0.0, model="sparse_lr")
+    cmodel = SparseBinaryLR(dc)
+    cstep = _scan_step(cmodel, ccfg)
+    cbatch = (jnp.asarray(ccols), jnp.asarray(cvals), jnp.asarray(cy), jnp.ones(nc, jnp.float32))
+    w = jnp.zeros(dc, jnp.float32)
+    for _ in range(120):
+        w = cstep(w, cbatch)
+    acc = float(cmodel.accuracy(w, cbatch))
+    return {
+        "config": 4,
+        "name": f"sparse one-hot LR (Avazu-style), D={d}, {fields} fields, segment_sum",
+        "samples_per_sec": round(sps, 1),
+        "accuracy": round(acc, 4),
+        "oracle_accuracy": round(oracle, 4),
+    }
+
+
+def bench_config_5(quick: bool) -> dict:
+    """Multinomial softmax regression, MNIST-shaped (D=784, K=10), on
+    synthetic 10-class data (zero-egress environment: no MNIST download;
+    same shapes and math as BASELINE.json config 5)."""
+    import jax.numpy as jnp
+
+    from distlr_tpu import Config
+    from distlr_tpu.data import make_synthetic_dataset
+    from distlr_tpu.models import SoftmaxRegression
+
+    d, k, n = 784, 10, (4096 if quick else 60_000)
+    steps = 10 if quick else 30
+    X, y, _ = make_synthetic_dataset(n, d, seed=0, num_classes=k)
+    cfg = Config(num_feature_dim=d, num_classes=k, model="softmax",
+                 learning_rate=0.3, l2_c=0.0)
+    model = SoftmaxRegression(d, k)
+    batch = (jnp.asarray(X), jnp.asarray(y), jnp.ones(n, jnp.float32))
+    step = _scan_step(model, cfg)
+    W = jnp.zeros((d, k), jnp.float32)
+    sps = _steady_state_sps(step, W, batch, steps, n)
+    for _ in range(60):
+        W = step(W, batch)
+    acc = float(model.accuracy(W, batch))
+    return {
+        "config": 5,
+        "name": "multinomial softmax regression, D=784 K=10 (MNIST-shaped)",
+        "samples_per_sec": round(sps, 1),
+        "accuracy": round(acc, 4),
+    }
+
+
+BENCHES = {1: bench_config_1, 2: bench_config_2, 3: bench_config_3,
+           4: bench_config_4, 5: bench_config_5}
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true", help="small shapes (CPU/CI)")
+    ap.add_argument("--configs", default="1,2,3,4,5",
+                    help="comma-separated subset, e.g. 1,3,5")
+    ap.add_argument("--out", default=os.path.join(REPO, "BENCH_CONFIGS.json"))
+    args = ap.parse_args(argv)
+
+    import jax
+
+    rows = []
+    for i in (int(s) for s in args.configs.split(",")):
+        row = BENCHES[i](args.quick)
+        rows.append(row)
+        print(json.dumps(row))
+    payload = {
+        "backend": jax.default_backend(),
+        "quick": args.quick,
+        "rows": rows,
+    }
+    with open(args.out, "w") as f:
+        json.dump(payload, f, indent=2)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -99,6 +99,9 @@ class Config:
     # ---- PS / async mode ----
     ps_host: str = "127.0.0.1"        # DMLC_PS_ROOT_URI
     ps_port: int = 8001               # DMLC_PS_ROOT_PORT
+    # Per-op receive timeout. The reference blocks forever (a dead worker
+    # deadlocks the sync barrier, SURVEY.md §5.3); 0 reproduces that.
+    ps_timeout_ms: int = 60_000
 
     # ---- checkpoint / obs ----
     checkpoint_dir: str | None = None

@@ -152,6 +152,10 @@ def cmd_ps(args: argparse.Namespace) -> int:
     from distlr_tpu.train.ps_trainer import run_ps_local  # noqa: PLC0415
 
     cfg = _config_from_args(args)
+    if cfg.model == "sparse_lr":  # fail before any server process spawns
+        print("error: ps mode supports dense models (binary_lr, softmax); "
+              "use the sync trainer for sparse_lr", file=sys.stderr)
+        return 2
     if args.asynchronous:
         cfg = cfg.replace(sync_mode=False)
     run_ps_local(cfg, save=True)

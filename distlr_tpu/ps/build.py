@@ -7,6 +7,7 @@ demand with ``make`` — no external deps beyond a C++17 toolchain.
 
 from __future__ import annotations
 
+import contextlib
 import os
 import subprocess
 import threading
@@ -26,17 +27,51 @@ def client_lib() -> str:
     return os.path.join(native_dir(), "libdistlr_kv.so")
 
 
+def _artifacts_fresh() -> bool:
+    """True when both outputs exist and are newer than every source —
+    lets prebuilt deployment images run without a make/C++ toolchain."""
+    outs = [server_binary(), client_lib()]
+    if not all(os.path.exists(o) for o in outs):
+        return False
+    srcs = [
+        os.path.join(native_dir(), f)
+        for f in os.listdir(native_dir())
+        if f.endswith((".cc", ".h")) or f == "Makefile"
+    ]
+    newest_src = max(os.path.getmtime(s) for s in srcs)
+    return min(os.path.getmtime(o) for o in outs) >= newest_src
+
+
+@contextlib.contextmanager
+def _file_lock():
+    """Serialize concurrent builds across processes (fcntl advisory lock;
+    worker processes on one host may race the same .so outputs)."""
+    import fcntl  # noqa: PLC0415  (POSIX-only, like the native build itself)
+
+    path = os.path.join(native_dir(), ".build.lock")
+    with open(path, "w") as f:
+        fcntl.flock(f, fcntl.LOCK_EX)
+        try:
+            yield
+        finally:
+            fcntl.flock(f, fcntl.LOCK_UN)
+
+
 def build_native(force: bool = False) -> None:
-    """Idempotently ``make`` the native components."""
+    """Idempotently ``make`` the native components; no-op (and toolchain-
+    free) when the built artifacts are already newer than the sources."""
     with _lock:
-        if not force and os.path.exists(server_binary()) and os.path.exists(client_lib()):
+        if not force and _artifacts_fresh():
             return
-        proc = subprocess.run(
-            ["make", "-C", native_dir()] + (["clean", "all"] if force else ["all"]),
-            capture_output=True,
-            text=True,
-        )
-        if proc.returncode != 0:
-            raise RuntimeError(
-                f"native PS build failed:\n{proc.stdout}\n{proc.stderr}"
+        with _file_lock():
+            if not force and _artifacts_fresh():  # built while we waited
+                return
+            proc = subprocess.run(
+                ["make", "-C", native_dir()] + (["clean", "all"] if force else ["all"]),
+                capture_output=True,
+                text=True,
             )
+            if proc.returncode != 0:
+                raise RuntimeError(
+                    f"native PS build failed:\n{proc.stdout}\n{proc.stderr}"
+                )

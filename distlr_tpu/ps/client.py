@@ -16,6 +16,22 @@ from distlr_tpu.ps.build import build_native, client_lib
 
 _lib = None
 
+#: Order of the counters a server stats probe returns (kv_protocol.h).
+STATS_FIELDS = (
+    "dim",
+    "initialized",
+    "pending_sync_pushes",
+    "barrier_waiters",
+    "total_pushes",
+    "total_pulls",
+)
+
+
+class PSTimeoutError(TimeoutError):
+    """A KV op hit the receive timeout — in sync mode, the named
+    straggler failure: a dead/slow worker holding the BSP barrier
+    (SURVEY.md §5.3; the reference deadlocks forever here)."""
+
 
 def _load():
     global _lib
@@ -34,6 +50,14 @@ def _load():
         lib.kv_wait.argtypes = [ctypes.c_void_p, ctypes.c_int]
         lib.kv_shutdown_servers.restype = ctypes.c_int
         lib.kv_shutdown_servers.argtypes = [ctypes.c_void_p]
+        lib.kv_set_timeout_ms.restype = ctypes.c_int
+        lib.kv_set_timeout_ms.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.kv_timed_out.restype = ctypes.c_int
+        lib.kv_timed_out.argtypes = [ctypes.c_void_p]
+        lib.kv_stats.restype = ctypes.c_int
+        lib.kv_stats.argtypes = [  # out buffer is float64 (see kv_protocol.h)
+            ctypes.c_void_p, ctypes.c_uint32, ctypes.c_void_p, ctypes.c_uint64,
+        ]
         lib.kv_last_error.restype = ctypes.c_char_p
         lib.kv_last_error.argtypes = [ctypes.c_void_p]
         lib.kv_close.argtypes = [ctypes.c_void_p]
@@ -44,7 +68,7 @@ def _load():
 class KVWorker:
     """Blocking Push/Pull/Wait client over a range-sharded server group."""
 
-    def __init__(self, hosts: str, dim: int, client_id: int = 0):
+    def __init__(self, hosts: str, dim: int, client_id: int = 0, *, timeout_ms: int = 0):
         lib = _load()
         self._lib = lib
         self.dim = dim
@@ -53,10 +77,21 @@ class KVWorker:
             raise ConnectionError(f"could not connect to KV servers at {hosts}")
         # dense default key set 0..D-1, like the reference app (src/lr.cc:117-121)
         self._all_keys = np.arange(dim, dtype=np.uint64)
+        if timeout_ms:
+            self.set_timeout(timeout_ms)
+
+    def set_timeout(self, timeout_ms: int) -> None:
+        """Receive timeout for every op; 0 = block forever (reference
+        semantics — a sync-mode straggler then deadlocks the job exactly
+        like ps-lite, SURVEY.md §5.3)."""
+        if self._lib.kv_set_timeout_ms(self._h, int(timeout_ms)) != 0:
+            raise OSError("failed to set KV socket timeout")
 
     def _check(self, ts: int, what: str) -> int:
         if ts < 0:
             err = self._lib.kv_last_error(self._h).decode()
+            if self._lib.kv_timed_out(self._h):
+                raise PSTimeoutError(f"KV {what} timed out: {err}")
             raise IOError(f"KV {what} failed: {err}")
         return ts
 
@@ -109,6 +144,18 @@ class KVWorker:
         """Worker-group barrier via server 0 (Postoffice::Barrier
         equivalent, reference src/main.cc:150)."""
         self._check(self._lib.kv_barrier(self._h), "barrier")
+
+    def stats(self, server: int = 0) -> dict:
+        """Health/progress counters of one server (never deferred, so it
+        works mid-barrier — the supervisor's straggler detector).  Use a
+        dedicated KVWorker for probing: ops on this connection must not
+        be in flight concurrently."""
+        out = np.zeros(len(STATS_FIELDS), dtype=np.float64)
+        n = self._lib.kv_stats(
+            self._h, server, out.ctypes.data_as(ctypes.c_void_p), out.shape[0]
+        )
+        self._check(n, "stats")
+        return dict(zip(STATS_FIELDS, (int(v) for v in out[:n])))
 
     def shutdown_servers(self) -> None:
         self._lib.kv_shutdown_servers(self._h)

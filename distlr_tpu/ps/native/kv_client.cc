@@ -21,9 +21,11 @@
 #include <netinet/in.h>
 #include <netinet/tcp.h>
 #include <sys/socket.h>
+#include <sys/time.h>
 #include <unistd.h>
 
 #include <algorithm>
+#include <cerrno>
 #include <cstring>
 #include <cstdio>
 #include <cstdlib>
@@ -46,6 +48,11 @@ struct Client {
   uint64_t dim = 0;
   uint32_t client_id = 0;
   uint32_t next_ts = 0;
+  bool timed_out = false;  // last failure was a receive timeout
+  // After any receive failure the stream may still hold a late/partial
+  // reply, so every subsequent frame would be misparsed.  The handle is
+  // poisoned: ops fail fast until the caller reconnects.
+  bool poisoned = false;
   char err[256] = {0};
 };
 
@@ -107,6 +114,13 @@ std::vector<std::pair<uint64_t, uint64_t>> SliceByRange(
 
 int RoundTrip(Client* c, Op op, const Key* keys, const float* vals,
               float* out_vals, uint64_t n) {
+  c->timed_out = false;
+  if (c->poisoned) {
+    snprintf(c->err, sizeof(c->err),
+             "connection poisoned by an earlier receive failure; "
+             "reconnect (kv_connect) before issuing more ops");
+    return -1;
+  }
   const uint32_t ts = c->next_ts++;
   auto slices = SliceByRange(*c, keys, n);
 
@@ -126,6 +140,7 @@ int RoundTrip(Client* c, Op op, const Key* keys, const float* vals,
         (h.num_keys && !WriteFull(fd, lk.data(), lk.size() * sizeof(Key))) ||
         (op == Op::kPush && h.num_keys &&
          !WriteFull(fd, vals + b, (e - b) * sizeof(Val)))) {
+      c->poisoned = true;  // peers already received slices of this ts
       snprintf(c->err, sizeof(c->err), "send to server %zu failed", s);
       return -1;
     }
@@ -137,19 +152,38 @@ int RoundTrip(Client* c, Op op, const Key* keys, const float* vals,
     const auto [b, e] = slices[s];
     if (b == e && !(op == Op::kBarrier && s == 0)) continue;
     MsgHeader rh{};
-    if (!ReadFull(c->servers[s].fd, &rh, sizeof(rh)) || rh.magic != kMagic ||
-        !(rh.flags & kResponse) || rh.timestamp != ts) {
+    errno = 0;
+    if (!ReadFull(c->servers[s].fd, &rh, sizeof(rh))) {
+      c->poisoned = true;  // a late reply may still arrive on this stream
+      if (errno == EAGAIN || errno == EWOULDBLOCK) {
+        // SO_RCVTIMEO fired. In sync mode the classic cause is the
+        // reference's named failure mode: a dead/slow peer wedging the
+        // deferred-reply BSP barrier forever (SURVEY.md §5.3).
+        c->timed_out = true;
+        snprintf(c->err, sizeof(c->err),
+                 "timed out waiting for server %zu (op %d); in sync mode "
+                 "this usually means a straggler/dead worker is holding "
+                 "the BSP barrier", s, static_cast<int>(op));
+      } else {
+        snprintf(c->err, sizeof(c->err), "connection to server %zu lost", s);
+      }
+      return -1;
+    }
+    if (rh.magic != kMagic || !(rh.flags & kResponse) || rh.timestamp != ts) {
+      c->poisoned = true;
       snprintf(c->err, sizeof(c->err), "bad response from server %zu", s);
       return -1;
     }
     if (rh.num_keys) {
       std::vector<Val> buf(rh.num_keys);
       if (!ReadFull(c->servers[s].fd, buf.data(), rh.num_keys * sizeof(Val))) {
+        c->poisoned = true;
         snprintf(c->err, sizeof(c->err), "short response from server %zu", s);
         return -1;
       }
       if (op == Op::kPull && out_vals != nullptr) {
         if (rh.num_keys != e - b) {
+          c->poisoned = true;
           snprintf(c->err, sizeof(c->err),
                    "pull size mismatch from server %zu", s);
           return -1;
@@ -211,6 +245,88 @@ int kv_push(void* handle, const uint64_t* keys, const float* vals, uint64_t n) {
 int kv_pull(void* handle, const uint64_t* keys, float* out_vals, uint64_t n) {
   auto* c = static_cast<distlr::Client*>(handle);
   return distlr::RoundTrip(c, distlr::Op::kPull, keys, nullptr, out_vals, n);
+}
+
+// Receive timeout for every pending/future op, in milliseconds; 0
+// restores the reference's semantics (block forever — and deadlock on a
+// sync-mode straggler exactly like ps-lite, SURVEY.md §5.3).
+int kv_set_timeout_ms(void* handle, int ms) {
+  auto* c = static_cast<distlr::Client*>(handle);
+  timeval tv{};
+  tv.tv_sec = ms / 1000;
+  tv.tv_usec = (ms % 1000) * 1000;
+  int rc = 0;
+  for (auto& sc : c->servers) {
+    if (setsockopt(sc.fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv)) < 0)
+      rc = -1;
+  }
+  return rc;
+}
+
+// 1 if the most recent failed op failed on a receive timeout (vs a dead
+// connection / protocol error).
+int kv_timed_out(void* handle) {
+  return static_cast<distlr::Client*>(handle)->timed_out ? 1 : 0;
+}
+
+// Health probe of one server: fills out[0..n) with the kStats counters
+// (dim, initialized, pending_sync_pushes, barrier_waiters, pushes,
+// pulls) as float64 (the wire ships doubles — f32 would freeze counters
+// at 2^24).  Safe while the sync barrier is wedged — the server never
+// defers a stats reply.  Use a dedicated connection for supervision:
+// like every op, a probe on a poisoned/busy handle fails.
+int kv_stats(void* handle, uint32_t server, double* out, uint64_t n) {
+  auto* c = static_cast<distlr::Client*>(handle);
+  c->timed_out = false;
+  if (c->poisoned) {
+    snprintf(c->err, sizeof(c->err),
+             "connection poisoned by an earlier receive failure; "
+             "reconnect (kv_connect) before issuing more ops");
+    return -1;
+  }
+  if (server >= c->servers.size()) {
+    snprintf(c->err, sizeof(c->err), "no such server %u", server);
+    return -1;
+  }
+  const uint32_t ts = c->next_ts++;
+  distlr::MsgHeader h{distlr::kMagic, static_cast<uint8_t>(distlr::Op::kStats),
+                      distlr::kNone, 0, c->client_id, ts, 0};
+  const int fd = c->servers[server].fd;
+  if (!distlr::WriteFull(fd, &h, sizeof(h))) {
+    c->poisoned = true;
+    snprintf(c->err, sizeof(c->err), "send to server %u failed", server);
+    return -1;
+  }
+  distlr::MsgHeader rh{};
+  errno = 0;
+  if (!distlr::ReadFull(fd, &rh, sizeof(rh))) {
+    c->poisoned = true;
+    if (errno == EAGAIN || errno == EWOULDBLOCK) {
+      c->timed_out = true;
+      snprintf(c->err, sizeof(c->err),
+               "stats probe timed out waiting for server %u", server);
+    } else {
+      snprintf(c->err, sizeof(c->err), "connection to server %u lost", server);
+    }
+    return -1;
+  }
+  if (rh.magic != distlr::kMagic || !(rh.flags & distlr::kResponse) ||
+      rh.timestamp != ts || rh.num_keys != 2 * distlr::kStatsVals) {
+    c->poisoned = true;
+    snprintf(c->err, sizeof(c->err), "bad stats response from server %u", server);
+    return -1;
+  }
+  double stats[distlr::kStatsVals];
+  static_assert(sizeof(stats) == 2 * distlr::kStatsVals * sizeof(distlr::Val),
+                "stats payload layout");
+  if (!distlr::ReadFull(fd, stats, sizeof(stats))) {
+    c->poisoned = true;
+    snprintf(c->err, sizeof(c->err), "short stats response from server %u", server);
+    return -1;
+  }
+  const uint64_t k = std::min<uint64_t>(n, distlr::kStatsVals);
+  for (uint64_t i = 0; i < k; ++i) out[i] = stats[i];
+  return static_cast<int>(k);
 }
 
 // Group barrier via server 0 (Postoffice::Barrier equivalent).

@@ -38,7 +38,19 @@ enum class Op : uint8_t {
   kBarrier = 3,
   kShutdown = 4,
   kHello = 5,   // worker registration: client_id announces itself
+  kStats = 6,   // health probe: response vals = server counters (see below)
 };
+
+// kStats response payload, in order: dim, initialized,
+// pending_sync_pushes, barrier_waiters, total_pushes, total_pulls.
+// Each counter is a float64 (f32 would silently freeze counters at
+// 2^24), transmitted as 2 Val slots via memcpy — so the response header
+// carries num_keys == 2 * kStatsVals.
+// The failure-detection hook the reference lacks entirely (SURVEY.md
+// §5.3: a dead worker deadlocks the sync barrier forever with no
+// diagnostic) — a supervisor polling kStats sees pending_sync_pushes
+// stuck below num_workers and can name the straggler condition.
+constexpr uint64_t kStatsVals = 6;
 
 enum Flags : uint8_t {
   kNone = 0,

@@ -61,6 +61,11 @@ namespace distlr {
 struct PendingPush {
   int fd;
   MsgHeader header;       // echoed back (with kResponse) on release
+  // The pushed gradient is kept so a disconnecting worker's contribution
+  // can be rolled back out of the merge buffer (worker-restart recovery;
+  // the reference has no such path — SURVEY.md §5.3).
+  std::vector<Key> keys;
+  std::vector<Val> vals;
 };
 
 class KVServer {
@@ -161,6 +166,8 @@ class KVServer {
         HandlePull(fd, h, keys);
       } else if (op == Op::kBarrier) {
         HandleBarrier(fd, h);
+      } else if (op == Op::kStats) {
+        HandleStats(fd, h);
       } else if (op == Op::kHello) {
         Respond(fd, h, nullptr, 0);
       } else if (op == Op::kShutdown) {
@@ -179,6 +186,7 @@ class KVServer {
         break;
       }
     }
+    DropConnection(fd);
     {
       std::lock_guard<std::mutex> lock(mu_);
       for (auto it = active_fds_.begin(); it != active_fds_.end(); ++it) {
@@ -207,6 +215,7 @@ class KVServer {
   void HandlePush(int fd, const MsgHeader& h, const std::vector<Key>& keys,
                   const std::vector<Val>& vals) {
     std::unique_lock<std::mutex> lock(mu_);
+    ++n_push_;
     if (!keys.empty()) EnsureCapacity(keys.back());
 
     if (!initialized_) {
@@ -230,17 +239,16 @@ class KVServer {
     // Sync/BSP: merge and defer the response (src/main.cc:57-78).
     if (merge_.size() < weights_.size()) merge_.resize(weights_.size(), 0.0f);
     for (size_t i = 0; i < keys.size(); ++i) merge_[keys[i]] += vals[i];
-    last_push_keys_ = keys;
-    last_push_vals_ = vals;
-    pending_.push_back({fd, h});
+    pending_.push_back({fd, h, keys, vals});
 
     if (static_cast<int>(pending_.size()) == num_workers_) {
       const float w = static_cast<float>(num_workers_);
       if (last_gradient_) {
         // Q1 compat: apply only the last-arriving gradient / W
         // (the reference reads req_data.vals, src/main.cc:70-72).
-        for (size_t i = 0; i < last_push_keys_.size(); ++i)
-          weights_[last_push_keys_[i]] -= lr_ * last_push_vals_[i] / w;
+        const PendingPush& last = pending_.back();
+        for (size_t i = 0; i < last.keys.size(); ++i)
+          weights_[last.keys[i]] -= lr_ * last.vals[i] / w;
       } else {
         // Correct BSP: mean of the merged gradients.
         for (size_t i = 0; i < merge_.size(); ++i)
@@ -255,15 +263,59 @@ class KVServer {
     }
   }
 
+  // A connection died (worker crash, or client-side timeout followed by
+  // reconnect).  Undo its effect on BSP accounting: its deferred pushes
+  // can never be replied to, and leaving them would (a) let the barrier
+  // release with a duplicate gradient once the worker re-pushes, or
+  // (b) send a reply to a recycled fd owned by a different worker.
+  void DropConnection(int fd) {
+    std::lock_guard<std::mutex> lock(mu_);
+    for (auto it = pending_.begin(); it != pending_.end();) {
+      if (it->fd == fd) {
+        for (size_t i = 0; i < it->keys.size(); ++i)
+          merge_[it->keys[i]] -= it->vals[i];  // roll back the merge
+        it = pending_.erase(it);
+      } else {
+        ++it;
+      }
+    }
+    for (auto it = barrier_.begin(); it != barrier_.end();) {
+      if (it->fd == fd) it = barrier_.erase(it);
+      else ++it;
+    }
+  }
+
   // --- PULL: reply current weights (src/main.cc:85-95) ---
   void HandlePull(int fd, const MsgHeader& h, const std::vector<Key>& keys) {
     std::vector<Val> out(keys.size());
     {
       std::lock_guard<std::mutex> lock(mu_);
+      ++n_pull_;
       if (!keys.empty()) EnsureCapacity(keys.back());
       for (size_t i = 0; i < keys.size(); ++i) out[i] = weights_[keys[i]];
     }
     Respond(fd, h, out.data(), out.size());
+  }
+
+  // --- STATS: liveness/progress probe (no reference equivalent — the
+  // failure-detection gap SURVEY.md §5.3 documents).  Never deferred, so
+  // it works even while the sync barrier is wedged by a straggler. ---
+  void HandleStats(int fd, const MsgHeader& h) {
+    // float64 counters (f32 freezes at 2^24 pushes), shipped as 2 Val
+    // slots each — see kv_protocol.h.
+    double stats[kStatsVals];
+    {
+      std::lock_guard<std::mutex> lock(mu_);
+      stats[0] = static_cast<double>(weights_.size());
+      stats[1] = initialized_ ? 1.0 : 0.0;
+      stats[2] = static_cast<double>(pending_.size());
+      stats[3] = static_cast<double>(barrier_.size());
+      stats[4] = static_cast<double>(n_push_);
+      stats[5] = static_cast<double>(n_pull_);
+    }
+    Val out[2 * kStatsVals];
+    std::memcpy(out, stats, sizeof(stats));
+    Respond(fd, h, out, 2 * kStatsVals);
   }
 
   // --- BARRIER: Postoffice::Barrier equivalent (src/main.cc:150) ---
@@ -271,7 +323,7 @@ class KVServer {
     std::vector<PendingPush> release;
     {
       std::lock_guard<std::mutex> lock(mu_);
-      barrier_.push_back({fd, h});
+      barrier_.push_back({fd, h, {}, {}});
       if (static_cast<int>(barrier_.size()) < num_workers_) return;
       release.swap(barrier_);
     }
@@ -290,10 +342,10 @@ class KVServer {
 
   std::mutex mu_;
   bool initialized_ = false;
+  uint64_t n_push_ = 0;
+  uint64_t n_pull_ = 0;
   std::vector<Val> weights_;
   std::vector<Val> merge_;
-  std::vector<Key> last_push_keys_;
-  std::vector<Val> last_push_vals_;
   std::vector<PendingPush> pending_;
   std::vector<PendingPush> barrier_;
 };

@@ -89,6 +89,21 @@ class ServerGroup:
             self.ports.append(int(line.split()[1]))
         return self
 
+    def alive(self) -> list[bool]:
+        """Process-level liveness, one flag per server rank."""
+        return [p.poll() is None for p in self.procs]
+
+    def health(self, *, timeout_ms: int = 2000) -> list[dict]:
+        """Protocol-level health: per-server kStats counters, probed over
+        a dedicated short-lived connection (safe while the sync barrier
+        is wedged — stats replies are never deferred).  This is the
+        failure-detection hook the reference lacks (SURVEY.md §5.3: its
+        only outcome for a dead worker is an eternal deadlock)."""
+        from distlr_tpu.ps.client import KVWorker  # noqa: PLC0415  (cycle)
+
+        with KVWorker(self.hosts, self.dim, client_id=0xFFFF, timeout_ms=timeout_ms) as probe:
+            return [probe.stats(rank) for rank in range(self.num_servers)]
+
     def stop(self) -> None:
         for p in self.procs:
             if p.poll() is None:

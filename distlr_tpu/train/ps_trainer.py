@@ -46,10 +46,20 @@ class PSWorker:
     """One worker's training loop against a KV server group."""
 
     def __init__(self, cfg: Config, rank: int, hosts: str, *, train_iter=None, test_iter=None):
+        if cfg.model == "sparse_lr":
+            # The PS data path serves dense (X, y, mask) batches; padded-COO
+            # sparse batches are a Trainer/SPMD-mode feature.
+            raise NotImplementedError(
+                "PS mode supports dense models (binary_lr, softmax); use the "
+                "sync Trainer for sparse_lr"
+            )
         self.cfg = cfg
         self.rank = rank
         self.model = get_model(cfg)
-        self.kv = KVWorker(hosts, self._param_dim(), client_id=rank)
+        self.kv = KVWorker(
+            hosts, self._param_dim(), client_id=rank,
+            timeout_ms=cfg.ps_timeout_ms,
+        )
         self._train_iter = train_iter
         self._test_iter = test_iter
         self._grad_fn = jax.jit(lambda w, X, y, mask: self.model.grad(w, (X, y, mask), cfg))

@@ -1,0 +1,68 @@
+"""Statistical sync-vs-async convergence parity (SURVEY.md §7 hard part a).
+
+Async/Hogwild staleness is timing-dependent, so parity with the sync BSP
+path is defined *statistically*: over repeated runs, async final logloss
+must land in a band around the sync result — not bitwise-equal to it
+(the reference's async mode has the same property by construction:
+``src/main.cc:79-84`` applies gradients whenever they arrive).
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from distlr_tpu import Config
+from distlr_tpu.data import parse_libsvm_file, write_synthetic_shards
+from distlr_tpu.models import BinaryLR
+from distlr_tpu.train.ps_trainer import run_ps_local
+
+D, N, EPOCHS, WORKERS = 64, 3000, 30, 4
+
+_MODEL = BinaryLR(D)
+_CFG0 = Config(num_feature_dim=D, l2_c=0.0)
+
+
+@pytest.fixture(scope="module")
+def data_dir(tmp_path_factory):
+    d = str(tmp_path_factory.mktemp("parity"))
+    write_synthetic_shards(d, N, D, num_parts=WORKERS, seed=7)
+    return d
+
+
+def _logloss(data_dir: str, w) -> float:
+    # evaluate on the WRITTEN test shard — write_synthetic_shards
+    # sparsifies features, so the on-disk problem is not the in-memory one
+    X, y = parse_libsvm_file(os.path.join(data_dir, "test", "part-001"), D)
+    z = X @ np.asarray(w, np.float64)
+    return float(np.mean(np.logaddexp(0.0, z) - y * z))
+
+
+def _run(data_dir: str, sync: bool) -> float:
+    cfg = Config(
+        data_dir=data_dir, num_feature_dim=D, num_iteration=EPOCHS,
+        learning_rate=0.5, l2_c=0.0, test_interval=0, batch_size=128,
+        sync_mode=sync, num_workers=WORKERS, num_servers=2,
+        ps_timeout_ms=30_000,
+    )
+    weights = run_ps_local(cfg)
+    return _logloss(data_dir, weights[0])
+
+
+def test_async_logloss_lands_in_sync_band(data_dir):
+    # anchor at the loss of the ACTUAL initial weights every worker
+    # computes (uniform [0,1) — far from the optimum by construction)
+    init_ll = _logloss(data_dir, np.asarray(_MODEL.init(_CFG0)).reshape(-1))
+    sync_ll = _run(data_dir, sync=True)
+    async_lls = [_run(data_dir, sync=False) for _ in range(3)]
+
+    # both modes make real progress from the shared init
+    # (measured: init ~1.56, sync ~0.49, async ~0.53 on this fixture)
+    assert sync_ll < 0.5 * init_ll, f"sync failed to converge: {sync_ll} vs {init_ll}"
+    for a in async_lls:
+        assert a < 0.5 * init_ll, f"async run failed to converge: {a} vs {init_ll}"
+
+    # statistical parity band: async may drift either way (staleness can
+    # help or hurt), but must stay comparable to sync
+    for a in async_lls:
+        assert a < 1.35 * sync_ll + 0.02, f"async logloss {a} vs sync {sync_ll}"

@@ -1,0 +1,125 @@
+"""Failure detection for the PS mode (SURVEY.md §5.3).
+
+The reference has no failure handling at all: a dead worker in sync mode
+deadlocks the BSP barrier forever (``src/main.cc:67-78`` waits for
+exactly ``NumWorkers()`` pushes).  These tests pin the framework's
+answer: client-side op timeouts that raise a *named* straggler error,
+and a stats probe that stays answerable while the barrier is wedged.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from distlr_tpu.ps import KVWorker, PSTimeoutError, ServerGroup
+
+
+@pytest.fixture()
+def sync_group_of_two():
+    """Sync server expecting 2 workers — one never shows up."""
+    with ServerGroup(1, 2, dim=8, sync=True, learning_rate=0.5) as group:
+        yield group
+
+
+class TestStragglerTimeout:
+    def test_sync_push_times_out_with_named_straggler_error(self, sync_group_of_two):
+        with KVWorker(sync_group_of_two.hosts, 8, client_id=0, timeout_ms=300) as kv:
+            kv.push(np.zeros(8, np.float32))  # first push = init, replies at once
+            t0 = time.monotonic()
+            with pytest.raises(PSTimeoutError, match="straggler|BSP barrier"):
+                kv.push(np.ones(8, np.float32))  # deferred: needs 2 workers
+            assert time.monotonic() - t0 < 5.0  # timed out, not deadlocked
+
+    def test_barrier_times_out_when_peer_missing(self, sync_group_of_two):
+        with KVWorker(sync_group_of_two.hosts, 8, client_id=0, timeout_ms=300) as kv:
+            with pytest.raises(PSTimeoutError):
+                kv.barrier()
+
+    def test_zero_timeout_means_blocking(self, sync_group_of_two):
+        # timeout_ms=0 must not set a timeout: a pull (never deferred)
+        # still completes after an arbitrary client-side pause.
+        with KVWorker(sync_group_of_two.hosts, 8, client_id=0, timeout_ms=0) as kv:
+            kv.push(np.zeros(8, np.float32))
+            time.sleep(0.4)
+            assert kv.pull().shape == (8,)
+
+
+class TestStatsProbe:
+    def test_stats_reflect_progress_and_survive_wedged_barrier(self):
+        with ServerGroup(2, 2, dim=10, sync=True) as group:
+            with KVWorker(group.hosts, 10, client_id=0, timeout_ms=500) as kv:
+                kv.push(np.zeros(10, np.float32))  # init both servers
+                kv.pull()
+                with pytest.raises(PSTimeoutError):
+                    kv.push(np.ones(10, np.float32))  # wedges the barrier
+                # probe on a FRESH connection while the wedged push is
+                # still pending (the timed-out client is alive, just
+                # poisoned client-side)
+                health = group.health(timeout_ms=1000)
+                assert len(health) == 2
+                for h, dim in zip(health, (5, 5)):
+                    assert h["dim"] == dim
+                    assert h["initialized"] == 1
+                    assert h["pending_sync_pushes"] == 1  # the wedged push
+                    assert h["total_pushes"] == 2
+                    assert h["total_pulls"] == 1
+            # once the wedged client disconnects, its deferred push is
+            # rolled back (see TestWorkerRestartRecovery)
+            assert group.health()[0]["pending_sync_pushes"] == 0
+
+    def test_alive_tracks_processes(self):
+        group = ServerGroup(1, 1, dim=4, sync=False).start()
+        assert group.alive() == [True]
+        group.stop()
+        assert group.alive() == []
+
+
+class TestWorkerRestartRecovery:
+    def test_reconnected_worker_is_not_double_counted(self, sync_group_of_two):
+        """A worker that times out, reconnects, and re-pushes must count
+        once: the server rolls the dead connection's deferred push out of
+        the merge buffer (no rollback -> the barrier would release early
+        with a duplicated gradient)."""
+        hosts = sync_group_of_two.hosts
+        with KVWorker(hosts, 8, client_id=0, timeout_ms=300) as kv:
+            kv.push(np.zeros(8, np.float32))  # init
+            with pytest.raises(PSTimeoutError):
+                kv.push(np.ones(8, np.float32))  # deferred, then timeout
+        # old connection closed -> server must have rolled its push back
+        assert sync_group_of_two.health()[0]["pending_sync_pushes"] == 0
+
+        # restart: reconnect and train with BOTH workers present
+        kv0 = KVWorker(hosts, 8, client_id=0, timeout_ms=3000)
+        kv1 = KVWorker(hosts, 8, client_id=1, timeout_ms=3000)
+        import threading
+
+        g0 = np.full(8, 1.0, np.float32)
+        g1 = np.full(8, 3.0, np.float32)
+        t = threading.Thread(target=lambda: kv1.push(g1))
+        t.start()
+        kv0.push(g0)  # releases once both arrive
+        t.join()
+        w = kv0.pull()
+        kv0.close()
+        kv1.close()
+        # exactly one mean update: -lr * (1+3)/2 = -0.5 * 2 = -1
+        np.testing.assert_allclose(w, -1.0 * np.ones(8), rtol=1e-6)
+
+    def test_poisoned_connection_fails_fast_after_timeout(self, sync_group_of_two):
+        with KVWorker(sync_group_of_two.hosts, 8, client_id=0, timeout_ms=300) as kv:
+            kv.push(np.zeros(8, np.float32))
+            with pytest.raises(PSTimeoutError):
+                kv.push(np.ones(8, np.float32))
+            with pytest.raises(IOError, match="poisoned"):
+                kv.pull()
+
+
+class TestAsyncUnaffected:
+    def test_async_single_worker_never_needs_peers(self):
+        with ServerGroup(1, 4, dim=6, sync=False) as group:
+            with KVWorker(group.hosts, 6, client_id=0, timeout_ms=1000) as kv:
+                kv.push(np.zeros(6, np.float32))  # init
+                kv.push(np.full(6, 2.0, np.float32))  # applied immediately
+                w = kv.pull()
+                np.testing.assert_allclose(w, -0.2 * 2.0 * np.ones(6), rtol=1e-6)
