@@ -99,9 +99,12 @@ class Config:
     # ---- PS / async mode ----
     ps_host: str = "127.0.0.1"        # DMLC_PS_ROOT_URI
     ps_port: int = 8001               # DMLC_PS_ROOT_PORT
-    # Per-op receive timeout. The reference blocks forever (a dead worker
-    # deadlocks the sync barrier, SURVEY.md §5.3); 0 reproduces that.
-    ps_timeout_ms: int = 60_000
+    # Per-op receive timeout (0 = block forever, the reference's
+    # semantics: a dead worker then deadlocks the sync barrier,
+    # SURVEY.md §5.3). Opt-in because any legitimate inter-push gap
+    # longer than the timeout — e.g. rank 0 evaluating between epochs
+    # while peers wait at the BSP barrier — would kill a healthy job.
+    ps_timeout_ms: int = 0
 
     # ---- checkpoint / obs ----
     checkpoint_dir: str | None = None

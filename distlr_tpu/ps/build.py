@@ -38,6 +38,8 @@ def _artifacts_fresh() -> bool:
         for f in os.listdir(native_dir())
         if f.endswith((".cc", ".h")) or f == "Makefile"
     ]
+    if not srcs:  # sources stripped from the image: artifacts are all there is
+        return True
     newest_src = max(os.path.getmtime(s) for s in srcs)
     return min(os.path.getmtime(o) for o in outs) >= newest_src
 

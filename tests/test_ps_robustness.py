@@ -15,6 +15,19 @@ import pytest
 from distlr_tpu.ps import KVWorker, PSTimeoutError, ServerGroup
 
 
+def _wait_pending_zero(group, *, deadline_s: float = 5.0) -> int:
+    """Poll server 0 until its deferred-push count drops to 0 (the
+    disconnect rollback runs on the server's reader thread, which races
+    a freshly-connected stats probe)."""
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < deadline_s:
+        pending = group.health()[0]["pending_sync_pushes"]
+        if pending == 0:
+            return 0
+        time.sleep(0.02)
+    return pending
+
+
 @pytest.fixture()
 def sync_group_of_two():
     """Sync server expecting 2 workers — one never shows up."""
@@ -66,7 +79,7 @@ class TestStatsProbe:
                     assert h["total_pulls"] == 1
             # once the wedged client disconnects, its deferred push is
             # rolled back (see TestWorkerRestartRecovery)
-            assert group.health()[0]["pending_sync_pushes"] == 0
+            assert _wait_pending_zero(group) == 0
 
     def test_alive_tracks_processes(self):
         group = ServerGroup(1, 1, dim=4, sync=False).start()
@@ -87,7 +100,7 @@ class TestWorkerRestartRecovery:
             with pytest.raises(PSTimeoutError):
                 kv.push(np.ones(8, np.float32))  # deferred, then timeout
         # old connection closed -> server must have rolled its push back
-        assert sync_group_of_two.health()[0]["pending_sync_pushes"] == 0
+        assert _wait_pending_zero(sync_group_of_two) == 0
 
         # restart: reconnect and train with BOTH workers present
         kv0 = KVWorker(hosts, 8, client_id=0, timeout_ms=3000)
