@@ -1,0 +1,150 @@
+"""Roofline experiment 2: isolate the slow part of on-device generation.
+
+Variants of the same (BT, DT)-tile kernel, all VMEM-only (no HBM
+streaming of X):
+
+  D. iota-hash generation (mul/xor/shift of broadcasted_iota) + fwd shape
+  E. no generation at all: reuse a constant VMEM tile + fwd shape
+     (pure VPU mul+reduce ceiling)
+  F. same as E but via MXU: x_tile @ w_rep matmul accumulation
+     (degenerate-N ceiling)
+"""
+
+from __future__ import annotations
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+BT = 256
+DT = 8192
+REPS = 64
+
+
+def _time(fn, *args):
+    np.asarray(jax.tree_util.tree_leaves(jax.block_until_ready(fn(*args)))[0])
+    t0 = time.perf_counter()
+    np.asarray(jax.tree_util.tree_leaves(fn(*args))[0])
+    return time.perf_counter() - t0
+
+
+def _report(name, elems, dt):
+    print(f"{name}: {elems/dt/1e9:10.2f} G elem/s")
+
+
+# --- D: iota-hash generator + fwd ------------------------------------------
+def _kern_hash(w_ref, out_ref, z_ref):
+    t = pl.program_id(0)
+
+    @pl.when(t == 0)
+    def _():
+        z_ref[:] = jnp.zeros_like(z_ref)
+
+    row = jax.lax.broadcasted_iota(jnp.int32, (BT, DT), 0)
+    col = jax.lax.broadcasted_iota(jnp.int32, (BT, DT), 1)
+    h = row * jnp.int32(-1640531527) + col * jnp.int32(-2048144777) + t
+    h = h ^ jax.lax.shift_right_logical(h, 15)
+    h = h * jnp.int32(739993453)
+    h = h ^ jax.lax.shift_right_logical(h, 12)
+    x = h.astype(jnp.float32) * (2.0 ** -31)
+    z_ref[:] += jnp.sum(x * w_ref[:], axis=1, keepdims=True)
+
+    @pl.when(t == pl.num_programs(0) - 1)
+    def _():
+        out_ref[:] = z_ref[:]
+
+
+def bench_hash():
+    f = pl.pallas_call(
+        _kern_hash,
+        grid=(REPS,),
+        in_specs=[pl.BlockSpec((1, DT), lambda t: (0, 0), memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec((BT, 1), lambda t: (0, 0), memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((BT, 1), jnp.float32),
+        scratch_shapes=[pltpu.VMEM((BT, 1), jnp.float32)],
+    )
+    g = jax.jit(lambda w: f(w))
+    dt = _time(g, jnp.ones((1, DT), jnp.float32))
+    _report("D iota-hash + fwd ", REPS * BT * DT, dt)
+
+
+# --- E: constant tile + fwd (pure VPU ceiling) ------------------------------
+def _kern_const(x_ref, w_ref, out_ref, z_ref):
+    t = pl.program_id(0)
+
+    @pl.when(t == 0)
+    def _():
+        z_ref[:] = jnp.zeros_like(z_ref)
+
+    z_ref[:] += jnp.sum(x_ref[:] * w_ref[:], axis=1, keepdims=True)
+
+    @pl.when(t == pl.num_programs(0) - 1)
+    def _():
+        out_ref[:] = z_ref[:]
+
+
+def bench_const():
+    f = pl.pallas_call(
+        _kern_const,
+        grid=(REPS,),
+        in_specs=[
+            pl.BlockSpec((BT, DT), lambda t: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, DT), lambda t: (0, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((BT, 1), lambda t: (0, 0), memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((BT, 1), jnp.float32),
+        scratch_shapes=[pltpu.VMEM((BT, 1), jnp.float32)],
+    )
+    g = jax.jit(lambda x, w: f(x, w))
+    x = jnp.ones((BT, DT), jnp.float32)
+    dt = _time(g, x, jnp.ones((1, DT), jnp.float32))
+    _report("E const tile + fwd", REPS * BT * DT, dt)
+
+
+# --- F: constant tile, MXU matmul path --------------------------------------
+def _kern_mxu(x_ref, w_ref, out_ref, z_ref):
+    t = pl.program_id(0)
+
+    @pl.when(t == 0)
+    def _():
+        z_ref[:] = jnp.zeros_like(z_ref)
+
+    # (BT, DT) @ (DT, 128): all 128 output cols equal -> keep col block
+    z_ref[:] += jax.lax.dot_general(
+        x_ref[:].astype(jnp.bfloat16),
+        w_ref[:].astype(jnp.bfloat16),
+        (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+
+    @pl.when(t == pl.num_programs(0) - 1)
+    def _():
+        out_ref[:] = z_ref[:]
+
+
+def bench_mxu():
+    f = pl.pallas_call(
+        _kern_mxu,
+        grid=(REPS,),
+        in_specs=[
+            pl.BlockSpec((BT, DT), lambda t: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((DT, 128), lambda t: (0, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((BT, 128), lambda t: (0, 0), memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((BT, 128), jnp.float32),
+        scratch_shapes=[pltpu.VMEM((BT, 128), jnp.float32)],
+    )
+    g = jax.jit(lambda x, w: f(x, w))
+    x = jnp.ones((BT, DT), jnp.float32)
+    dt = _time(g, x, jnp.ones((DT, 128), jnp.float32))
+    _report("F const tile + MXU", REPS * BT * DT, dt)
+
+
+if __name__ == "__main__":
+    bench_hash()
+    bench_const()
+    bench_mxu()

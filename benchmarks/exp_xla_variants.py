@@ -1,0 +1,133 @@
+"""Roofline experiment 3: XLA-path variants of the D=1M LR step.
+
+Pallas is ~100x slower than XLA on this platform (exp_gen_roofline*.py),
+so the only perf levers are (a) fewer HBM bytes per sample and (b) XLA-
+fused on-device generation.  Measures samples/sec for:
+
+  1. bf16 X, matmul formulation        (current bench.py path)
+  2. bf16 X, reduce formulation        (checks reduce vs dot codegen)
+  3. int8 X, reduce formulation        (half the HBM bytes)
+  4. int8 X, matmul formulation        (MXU native int8?)
+  5. on-device iota-hash gen, fused    (zero HBM bytes for X)
+  6. on-device threefry bits gen       (jax.random.bits fused?)
+"""
+
+from __future__ import annotations
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+B, D, STEPS = 2048, 1_000_000, 10
+LR = 0.2
+
+
+def _time_steps(run, w, *args):
+    w2 = run(w, *args)
+    assert np.isfinite(float(jnp.sum(w2)))
+    t0 = time.perf_counter()
+    w2 = run(w, *args)
+    float(jnp.sum(w2))
+    return time.perf_counter() - t0
+
+
+def _report(name, dt):
+    print(f"{name}: {B*STEPS/dt:12,.0f} samples/s")
+
+
+def scan_steps(step):
+    @jax.jit
+    def run(w, *args):
+        def body(w, _):
+            return step(w, *args), None
+        w, _ = jax.lax.scan(body, w, None, length=STEPS)
+        return w
+    return run
+
+
+def data(dtype):
+    k = jax.random.PRNGKey(0)
+    kx, ky = jax.random.split(k)
+    if dtype == jnp.int8:
+        X = jax.random.randint(kx, (B, D), -127, 128, dtype=jnp.int8)
+    else:
+        X = jax.random.normal(kx, (B, D), dtype=dtype)
+    y = jax.random.bernoulli(ky, 0.5, (B,)).astype(jnp.float32)
+    return jax.block_until_ready(X), jax.block_until_ready(y)
+
+
+def main():
+    Xb, y = data(jnp.bfloat16)
+
+    # 1. matmul formulation, bf16
+    def step1(w, X, y):
+        z = (X @ w.astype(jnp.bfloat16)).astype(jnp.float32)
+        r = jax.nn.sigmoid(z) - y
+        g = (r.astype(jnp.bfloat16) @ X).astype(jnp.float32) / B
+        return w - LR * g
+    _report("1 bf16 matmul ", _time_steps(scan_steps(step1), jnp.zeros(D), Xb, y))
+
+    # 2. reduce formulation, bf16
+    def step2(w, X, y):
+        z = jnp.sum(X.astype(jnp.float32) * w, axis=1)
+        r = jax.nn.sigmoid(z) - y
+        g = jnp.sum(X.astype(jnp.float32) * r[:, None], axis=0) / B
+        return w - LR * g
+    _report("2 bf16 reduce ", _time_steps(scan_steps(step2), jnp.zeros(D), Xb, y))
+
+    del Xb
+    Xi, y = data(jnp.int8)
+
+    # 3. reduce formulation, int8
+    def step3(w, X, y):
+        z = jnp.sum(X.astype(jnp.float32) * w, axis=1) * (1.0 / 127.0)
+        r = jax.nn.sigmoid(z) - y
+        g = jnp.sum(X.astype(jnp.float32) * r[:, None], axis=0) / B
+        return w - LR * g
+    _report("3 int8 reduce ", _time_steps(scan_steps(step3), jnp.zeros(D), Xi, y))
+
+    # 4. matmul formulation, int8 -> bf16 operand
+    def step4(w, X, y):
+        z = (X.astype(jnp.bfloat16) @ w.astype(jnp.bfloat16)).astype(jnp.float32)
+        r = jax.nn.sigmoid(z) - y
+        g = (r.astype(jnp.bfloat16) @ X.astype(jnp.bfloat16)).astype(jnp.float32) / B
+        return w - LR * g
+    _report("4 int8 matmul ", _time_steps(scan_steps(step4), jnp.zeros(D), Xi, y))
+
+    del Xi
+    yv = y
+
+    # 5. fused iota-hash generation (X never in HBM if XLA fuses)
+    def gen(step_i):
+        row = jax.lax.broadcasted_iota(jnp.int32, (B, D), 0)
+        col = jax.lax.broadcasted_iota(jnp.int32, (B, D), 1)
+        h = row * jnp.int32(-1640531527) + col * jnp.int32(-2048144777) + step_i
+        h = h ^ jax.lax.shift_right_logical(h, 15)
+        h = h * jnp.int32(739993453)
+        h = h ^ jax.lax.shift_right_logical(h, 12)
+        return h.astype(jnp.float32) * (2.0 ** -31)
+
+    def step5(w, y):
+        i = jnp.int32(0)
+        X = gen(i)
+        z = jnp.sum(X * w, axis=1)
+        r = jax.nn.sigmoid(z) - y
+        g = jnp.sum(gen(i) * r[:, None], axis=0) / B
+        return w - LR * g
+    _report("5 hash-gen    ", _time_steps(scan_steps(step5), jnp.zeros(D), yv))
+
+    # 6. threefry-generated bits (jax.random under jit)
+    def step6(w, y):
+        key = jax.random.PRNGKey(1)
+        X = jax.random.normal(key, (B, D), dtype=jnp.bfloat16).astype(jnp.float32)
+        z = jnp.sum(X * w, axis=1)
+        r = jax.nn.sigmoid(z) - y
+        g = jnp.sum(X * r[:, None], axis=0) / B
+        return w - LR * g
+    _report("6 threefry-gen", _time_steps(scan_steps(step6), jnp.zeros(D), yv))
+
+
+if __name__ == "__main__":
+    main()
