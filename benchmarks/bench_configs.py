@@ -111,8 +111,12 @@ def bench_config_2(quick: bool) -> dict:
             learning_rate=0.1, l2_c=0.0, test_interval=epochs,
             sync_mode=False, num_workers=4, num_servers=2, batch_size=256,
         )
-        # warmup run: jit-compiles the gradient/accuracy steps in-process
-        run_ps_local(cfg.replace(num_iteration=1, test_interval=0))
+        # Warmup run compiles the gradient AND accuracy steps; the jit
+        # cache transfers to the timed run (ps_trainer._compiled_fns is
+        # shared across PSWorker instances). test_interval=1 so the
+        # epoch-1 eval actually compiles the accuracy fn.
+        run_ps_local(cfg.replace(num_iteration=1, test_interval=1),
+                     eval_fn=lambda *_: None)
         accs: list[float] = []
         t0 = time.perf_counter()
         run_ps_local(cfg, eval_fn=lambda _epoch, a: accs.append(a))

@@ -1,8 +1,8 @@
 """Roofline experiment: on-device generation throughput ceiling.
 
 The headline bench (bench.py) is HBM-bound: streaming a (B, 1M) bf16
-feature matrix caps the step at ~205k samples/sec no matter how fast the
-math is.  SURVEY.md section 7(d) prescribes generating features on-device
+feature matrix caps the step at ~139k samples/sec measured (two passes at
+~557 GB/s effective) no matter how fast the math is.  SURVEY.md section 7(d) prescribes generating features on-device
 for the north-star throughput config.  This experiment measures the
 ceiling of that approach on the real chip:
 

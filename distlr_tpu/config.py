@@ -99,12 +99,15 @@ class Config:
     # ---- PS / async mode ----
     ps_host: str = "127.0.0.1"        # DMLC_PS_ROOT_URI
     ps_port: int = 8001               # DMLC_PS_ROOT_PORT
-    # Per-op receive timeout (0 = block forever, the reference's
-    # semantics: a dead worker then deadlocks the sync barrier,
-    # SURVEY.md §5.3). Opt-in because any legitimate inter-push gap
-    # longer than the timeout — e.g. rank 0 evaluating between epochs
-    # while peers wait at the BSP barrier — would kill a healthy job.
-    ps_timeout_ms: int = 0
+    # Per-op receive timeout. A dead peer otherwise deadlocks the sync
+    # BSP barrier forever (the reference's named straggler failure,
+    # SURVEY.md §5.3), so detection is ON by default — but with a 10 min
+    # margin, because legitimate blocking gaps can be long: startup
+    # parse skew before the first barrier, or peers waiting at the BSP
+    # push barrier while rank 0 jit-compiles + runs a full-test-set
+    # eval. Set 0 for the reference's block-forever semantics; lower it
+    # for fast failure detection on small steps.
+    ps_timeout_ms: int = 600_000
 
     # ---- checkpoint / obs ----
     checkpoint_dir: str | None = None

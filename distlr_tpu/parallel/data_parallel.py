@@ -26,12 +26,7 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from distlr_tpu.config import Config
-from distlr_tpu.parallel.mesh import DATA_AXIS
-
-try:  # JAX >= 0.4.35 exports shard_map at top level
-    shard_map = jax.shard_map
-except AttributeError:  # pragma: no cover - older JAX
-    from jax.experimental.shard_map import shard_map  # type: ignore
+from distlr_tpu.parallel.mesh import DATA_AXIS, shard_map
 
 
 def _batch_spec(batch) -> tuple:

@@ -35,12 +35,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from distlr_tpu.config import Config
 from distlr_tpu.models import BinaryLR, SoftmaxRegression
-from distlr_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
-
-try:
-    shard_map = jax.shard_map
-except AttributeError:  # pragma: no cover
-    from jax.experimental.shard_map import shard_map  # type: ignore
+from distlr_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS, shard_map
 
 
 def _check_mesh(mesh: Mesh, num_features: int) -> None:

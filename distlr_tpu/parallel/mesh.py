@@ -23,6 +23,11 @@ import numpy as np
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+try:  # single shim point for the whole package (and tests)
+    shard_map = jax.shard_map
+except AttributeError:  # pragma: no cover — older jax
+    from jax.experimental.shard_map import shard_map  # type: ignore
+
 DATA_AXIS = "data"
 MODEL_AXIS = "model"
 

@@ -32,12 +32,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 from distlr_tpu.config import Config
 from distlr_tpu.models import BinaryLR
 from distlr_tpu.parallel.feature_parallel import _check_mesh
-from distlr_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
-
-try:
-    shard_map = jax.shard_map
-except AttributeError:  # pragma: no cover
-    from jax.experimental.shard_map import shard_map  # type: ignore
+from distlr_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS, shard_map
 
 
 def _ring_perm(s: int, reverse: bool = False):

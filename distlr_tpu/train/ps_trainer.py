@@ -24,8 +24,10 @@ Worker lifecycle parity:
 
 from __future__ import annotations
 
+import functools
 import os
 import threading
+import types
 
 import jax
 import numpy as np
@@ -40,6 +42,28 @@ from distlr_tpu.train.metrics import MetricsLogger
 from distlr_tpu.utils.logging import get_logger, log_eval_line
 
 log = get_logger(__name__)
+
+
+@functools.lru_cache(maxsize=None)
+def _compiled_fns(model, l2_c: float, l2_scale_by_batch: bool):
+    """Jitted gradient step shared across PSWorker instances and runs.
+
+    ``jax.jit`` keys its compile cache on function identity, so
+    per-instance lambdas would recompile on every run (models are frozen
+    dataclasses — hashable cache keys).  The gradient math reads exactly
+    ``l2_c`` and ``l2_scale_by_batch`` from the config (models/linear.py
+    ``_l2_grad``), which is why those two are the only cfg-derived keys;
+    a model that grows a new cfg dependency fails loudly here with
+    AttributeError."""
+    gcfg = types.SimpleNamespace(l2_c=l2_c, l2_scale_by_batch=l2_scale_by_batch)
+    return jax.jit(lambda w, X, y, mask: model.grad(w, (X, y, mask), gcfg))
+
+
+@functools.lru_cache(maxsize=None)
+def _compiled_acc(model):
+    """Accuracy takes no cfg, so its cache is keyed on the model alone
+    (an L2 sweep must not recompile the full-test-set eval program)."""
+    return jax.jit(lambda w, X, y, mask: model.accuracy(w, (X, y, mask)))
 
 
 class PSWorker:
@@ -62,8 +86,8 @@ class PSWorker:
         )
         self._train_iter = train_iter
         self._test_iter = test_iter
-        self._grad_fn = jax.jit(lambda w, X, y, mask: self.model.grad(w, (X, y, mask), cfg))
-        self._acc_fn = jax.jit(lambda w, X, y, mask: self.model.accuracy(w, (X, y, mask)))
+        self._grad_fn = _compiled_fns(self.model, cfg.l2_c, bool(cfg.l2_scale_by_batch))
+        self._acc_fn = _compiled_acc(self.model)
         self.metrics = MetricsLogger()
         self.final_weights: np.ndarray | None = None
 

@@ -1,6 +1,5 @@
 """Explicit ppermute ring collectives vs XLA's built-ins."""
 
-import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
@@ -15,12 +14,8 @@ from distlr_tpu.parallel.feature_parallel import (
     shard_batch_2d,
     shard_weights,
 )
+from distlr_tpu.parallel.mesh import shard_map
 from distlr_tpu.parallel.ring import make_ring_train_step, ring_all_gather, ring_psum
-
-try:
-    shard_map = jax.shard_map
-except AttributeError:  # pragma: no cover
-    from jax.experimental.shard_map import shard_map
 
 
 def _mesh1d(s):
