@@ -149,7 +149,7 @@ def cmd_sync(args: argparse.Namespace) -> int:
 
 def cmd_ps(args: argparse.Namespace) -> int:
     _maybe_force_cpu_devices(args)
-    from distlr_tpu.train.ps_trainer import run_ps_local  # noqa: PLC0415
+    from distlr_tpu.train.ps_trainer import run_ps_local, run_ps_workers  # noqa: PLC0415
 
     cfg = _config_from_args(args)
     if cfg.model == "sparse_lr":  # fail before any server process spawns
@@ -158,7 +158,62 @@ def cmd_ps(args: argparse.Namespace) -> int:
         return 2
     if args.asynchronous:
         cfg = cfg.replace(sync_mode=False)
-    run_ps_local(cfg, save=True)
+    if args.hosts:
+        # Multi-host: join an existing server group (launch ps-server on
+        # the server host first), running this host's worker ranks.
+        ranks = (
+            [int(s) for s in args.worker_ranks.split(",")]
+            if args.worker_ranks
+            else range(cfg.num_workers)
+        )
+        run_ps_workers(cfg, args.hosts, ranks, save=True)
+    else:
+        if args.worker_ranks:
+            print("error: --worker-ranks requires --hosts (local mode always "
+                  "runs all ranks)", file=sys.stderr)
+            return 2
+        run_ps_local(cfg, save=True)
+    return 0
+
+
+def cmd_ps_server(args: argparse.Namespace) -> int:
+    """Host a KV server group in the foreground (multi-host PS mode:
+    the reference's ``DMLC_ROLE=server`` processes, ``local.sh:36-41``;
+    rendezvous is just TCP — no scheduler role)."""
+    import signal  # noqa: PLC0415
+
+    from distlr_tpu.ps import ServerGroup  # noqa: PLC0415
+    from distlr_tpu.train.ps_trainer import ps_param_dim  # noqa: PLC0415
+
+    # A terminated foreground group must not orphan its native server
+    # processes: route SIGTERM through SystemExit so the context manager
+    # below runs ServerGroup.stop() (SIGINT already raises KeyboardInterrupt,
+    # which ServerGroup.wait() handles).
+    signal.signal(signal.SIGTERM, lambda *_: sys.exit(143))
+
+    cfg = _config_from_args(args)
+    ports = [int(s) for s in args.ports.split(",")] if args.ports else None
+    if ports and len(ports) != cfg.num_servers:
+        print(f"error: {len(ports)} ports for {cfg.num_servers} servers", file=sys.stderr)
+        return 2
+    group = ServerGroup(
+        cfg.num_servers,
+        cfg.num_workers,
+        ps_param_dim(cfg),
+        learning_rate=cfg.learning_rate,
+        sync=cfg.sync_mode and not args.asynchronous,
+        last_gradient=bool(cfg.sync_last_gradient),
+        ports=ports,
+        bind_any=True,
+    )
+    try:
+        with group:
+            # Workers pass this (with this host's address substituted for
+            # 127.0.0.1) as --hosts.
+            print(f"HOSTS {group.hosts}", flush=True)
+            group.wait()
+    except KeyboardInterrupt:
+        return 130  # interrupted != clean worker-driven shutdown
     return 0
 
 
@@ -184,7 +239,17 @@ def main(argv=None) -> int:
     _add_config_flags(p)
     p.add_argument("--async", dest="asynchronous", action="store_true",
                    help="Hogwild mode (SYNC_MODE=0 equivalent)")
+    p.add_argument("--hosts", help="join existing servers (comma-separated "
+                   "host:port, rank order) instead of spawning local ones")
+    p.add_argument("--worker-ranks", dest="worker_ranks",
+                   help="with --hosts: this host's ranks, e.g. 0,1 (default: all)")
     p.set_defaults(fn=cmd_ps)
+
+    v = sub.add_parser("ps-server", help="host a KV server group (multi-host PS)")
+    _add_config_flags(v)
+    v.add_argument("--async", dest="asynchronous", action="store_true")
+    v.add_argument("--ports", help="fixed ports, comma-separated (default: ephemeral)")
+    v.set_defaults(fn=cmd_ps_server)
 
     args = parser.parse_args(argv)
     return args.fn(args)

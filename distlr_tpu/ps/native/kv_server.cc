@@ -50,6 +50,7 @@
 #include <cstdlib>
 #include <mutex>
 #include <string>
+#include <csignal>
 #include <thread>
 #include <unordered_map>
 #include <vector>
@@ -78,6 +79,10 @@ class KVServer {
   }
 
   int Run() {
+    // A worker dying between its request and our reply must surface as a
+    // failed write on that connection (handled by DropConnection), not
+    // SIGPIPE-kill the whole server group member.
+    signal(SIGPIPE, SIG_IGN);
     listen_fd_ = socket(AF_INET, SOCK_STREAM, 0);
     if (listen_fd_ < 0) { perror("socket"); return 1; }
     int one = 1;
@@ -257,8 +262,10 @@ class KVServer {
       std::fill(merge_.begin(), merge_.end(), 0.0f);
       std::vector<PendingPush> release;
       release.swap(pending_);
-      lock.unlock();
       // Releasing every deferred reply at once IS the BSP barrier.
+      // Written under mu_ (replies are header-only): a racing kShutdown
+      // holds mu_ while severing other connections, so it cannot cut a
+      // release loop midway and strand a peer without its reply.
       for (auto& p : release) Respond(p.fd, p.header, nullptr, 0);
     }
   }
@@ -320,13 +327,15 @@ class KVServer {
 
   // --- BARRIER: Postoffice::Barrier equivalent (src/main.cc:150) ---
   void HandleBarrier(int fd, const MsgHeader& h) {
+    std::lock_guard<std::mutex> lock(mu_);
+    barrier_.push_back({fd, h, {}, {}});
+    if (static_cast<int>(barrier_.size()) < num_workers_) return;
     std::vector<PendingPush> release;
-    {
-      std::lock_guard<std::mutex> lock(mu_);
-      barrier_.push_back({fd, h, {}, {}});
-      if (static_cast<int>(barrier_.size()) < num_workers_) return;
-      release.swap(barrier_);
-    }
+    release.swap(barrier_);
+    // Replies written under mu_ — see HandlePush's release loop: the
+    // exit-barrier reply to rank 0 triggers its kShutdown, whose
+    // connection-severing loop takes mu_ and must not interleave here
+    // (it would strand peers mid-release without their replies).
     for (auto& p : release) Respond(p.fd, p.header, nullptr, 0);
   }
 

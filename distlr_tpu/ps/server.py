@@ -104,6 +104,15 @@ class ServerGroup:
         with KVWorker(self.hosts, self.dim, client_id=0xFFFF, timeout_ms=timeout_ms) as probe:
             return [probe.stats(rank) for rank in range(self.num_servers)]
 
+    def wait(self) -> None:
+        """Block until every server process exits — they do after a
+        client's ``shutdown_servers()``.  This is the foreground mode
+        ``launch ps-server`` uses on a dedicated server host.  A Ctrl-C
+        propagates (the context manager tears the group down) so an
+        interrupted run stays distinguishable from a clean one."""
+        for p in self.procs:
+            p.wait()
+
     def stop(self) -> None:
         for p in self.procs:
             if p.poll() is None:

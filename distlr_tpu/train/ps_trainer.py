@@ -92,8 +92,7 @@ class PSWorker:
         self.final_weights: np.ndarray | None = None
 
     def _param_dim(self) -> int:
-        d = self.cfg.num_feature_dim
-        return d * self.cfg.num_classes if self.cfg.model == "softmax" else d
+        return ps_param_dim(self.cfg)
 
     def _load_train_iter(self) -> DataIter:
         # Reference re-reads its shard every epoch (src/main.cc:158-159);
@@ -149,6 +148,14 @@ class PSWorker:
             path = os.path.join(cfg.data_dir, "models", part_name(self.rank))
             os.makedirs(os.path.dirname(path), exist_ok=True)
             save_model_text(path, self.final_weights)
+        # ps::Finalize(do_barrier=true) parity (reference src/main.cc:179):
+        # a global exit barrier so no server retires while a peer still
+        # trains, then rank 0 retires the group — this is what lets
+        # foreground `launch ps-server` hosts exit when training is done
+        # (local mode: ServerGroup.stop() just finds the procs exited).
+        self.kv.barrier()
+        if self.rank == 0:
+            self.kv.shutdown_servers()
         return self.final_weights
 
     def _shape_params(self, flat: np.ndarray):
@@ -160,48 +167,76 @@ class PSWorker:
         self.kv.close()
 
 
+def run_ps_workers(cfg: Config, hosts: str, ranks, *, eval_fn=None, save=False,
+                   on_error=None):
+    """Run the given worker ranks (threads) against an EXISTING server
+    group at ``hosts`` — the multi-host entry point: each host runs its
+    subset of ranks against remote servers (started via
+    ``python -m distlr_tpu.launch ps-server`` or :class:`ServerGroup`).
+
+    Worker threads share one JAX backend/jit cache; each blocks
+    independently in the native client (the GIL is released during
+    ctypes calls), so async staleness is real.  ``on_error`` runs once
+    if any worker raises (local mode uses it to tear the servers down so
+    peers blocked on the sync barrier fail fast instead of hanging).
+    Returns ``{rank: final_weights}``.
+    """
+    ranks = list(ranks)
+    results: dict[int, np.ndarray | None] = {r: None for r in ranks}
+    errors: list[Exception] = []
+    workers = [PSWorker(cfg, r, hosts) for r in ranks]
+
+    def run_one(i, r):
+        try:
+            results[r] = workers[i].run(eval_fn=eval_fn if r == 0 else None, save=save)
+        except Exception as e:  # surface worker failures to the caller
+            errors.append(e)
+            if on_error is not None:
+                # A dead worker would deadlock every peer blocked on the
+                # sync barrier (the reference's named straggler failure,
+                # SURVEY.md §5.3).
+                on_error()
+
+    threads = [
+        threading.Thread(target=run_one, args=(i, r), daemon=True)
+        for i, r in enumerate(ranks)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    for wk in workers:
+        wk.close()
+    if errors:
+        raise errors[0]
+    return results
+
+
+def ps_param_dim(cfg: Config) -> int:
+    """Flat KV key-space size for a config (must match between servers
+    and workers — softmax flattens its (D, K) weight matrix)."""
+    return cfg.num_feature_dim * (cfg.num_classes if cfg.model == "softmax" else 1)
+
+
 def run_ps_local(cfg: Config, *, eval_fn=None, save=False):
     """Single-host PS run: native server subprocesses + threaded workers.
 
     The local-mode successor of ``examples/local.sh`` for the PS path
     (the scheduler role is gone — rendezvous is just TCP connect).
-    Worker threads share one JAX backend/jit cache; each blocks
-    independently in the native client (the GIL is released during
-    ctypes calls), so async staleness is real.  Multi-host deployments
-    run one ``PSWorker`` per host against remote servers instead.
+    Multi-host deployments start servers with ``launch ps-server`` and
+    per-host workers with :func:`run_ps_workers` instead.
     """
-    dim = cfg.num_feature_dim * (cfg.num_classes if cfg.model == "softmax" else 1)
     group = ServerGroup(
         cfg.num_servers,
         cfg.num_workers,
-        dim,
+        ps_param_dim(cfg),
         learning_rate=cfg.learning_rate,
         sync=cfg.sync_mode,
         last_gradient=bool(cfg.sync_last_gradient),
     )
-    results: list[np.ndarray | None] = [None] * cfg.num_workers
-    errors: list[Exception] = []
     with group:
-        workers = [PSWorker(cfg, r, group.hosts) for r in range(cfg.num_workers)]
-
-        def run_one(r):
-            try:
-                results[r] = workers[r].run(eval_fn=eval_fn if r == 0 else None, save=save)
-            except Exception as e:  # surface worker failures to the caller
-                errors.append(e)
-                # A dead worker would deadlock every peer blocked on the
-                # sync barrier (the reference's named straggler failure,
-                # SURVEY.md §5.3) — tear the servers down so the peers'
-                # blocking RPCs fail fast instead of hanging forever.
-                group.stop()
-
-        threads = [threading.Thread(target=run_one, args=(r,), daemon=True) for r in range(cfg.num_workers)]
-        for t in threads:
-            t.start()
-        for t in threads:
-            t.join()
-        for wk in workers:
-            wk.close()
-    if errors:
-        raise errors[0]
-    return results
+        results = run_ps_workers(
+            cfg, group.hosts, range(cfg.num_workers),
+            eval_fn=eval_fn, save=save, on_error=group.stop,
+        )
+    return [results[r] for r in range(cfg.num_workers)]

@@ -1,0 +1,45 @@
+#!/usr/bin/env bash
+# Successor of the reference launcher (examples/local.sh:1-51): same
+# env-var contract and the same "S servers + W workers" shape — but no
+# scheduler process (TCP connect is the rendezvous), and in sync mode
+# the whole cluster collapses into ONE SPMD process whose device mesh
+# plays the worker/server roles.
+#
+#   ./local.sh <num_servers> <num_workers> [sync|ps|ps-async]
+#
+# Reference invocation for comparison: local.sh <S> <W> bin/distlr
+set -euo pipefail
+
+NUM_SERVERS=${1:-1}
+NUM_WORKERS=${2:-4}
+MODE=${3:-sync}
+
+# The reference's full env contract (examples/local.sh:12-33); every var
+# is honored by Config.from_env and may be overridden from outside.
+export RANDOM_SEED=${RANDOM_SEED:-10}
+export DATA_DIR=${DATA_DIR:-./data}
+export NUM_FEATURE_DIM=${NUM_FEATURE_DIM:-123}
+export LEARNING_RATE=${LEARNING_RATE:-0.2}
+export TEST_INTERVAL=${TEST_INTERVAL:-10}
+export SYNC_MODE=${SYNC_MODE:-1}
+export NUM_ITERATION=${NUM_ITERATION:-100}
+export BATCH_SIZE=${BATCH_SIZE:--1}
+export DMLC_NUM_SERVER=$NUM_SERVERS
+export DMLC_NUM_WORKER=$NUM_WORKERS
+
+# Seeded synthetic data in the reference's directory layout (replaces
+# gen_data.py's unseeded a9a shuffle-and-shard; zero-egress: no download).
+# Regenerate unless every one of this run's W shards already exists.
+LAST_PART=$(printf 'part-%03d' "$NUM_WORKERS")
+if [ ! -f "$DATA_DIR/train/$LAST_PART" ]; then
+  python -m distlr_tpu.launch gen-data \
+    --data-dir "$DATA_DIR" --num-samples 40000 \
+    --num-feature-dim "$NUM_FEATURE_DIM" --num-parts "$NUM_WORKERS"
+fi
+
+case "$MODE" in
+  sync)      exec python -m distlr_tpu.launch sync ;;
+  ps)        exec python -m distlr_tpu.launch ps ;;
+  ps-async)  exec python -m distlr_tpu.launch ps --async ;;
+  *) echo "mode must be sync|ps|ps-async" >&2; exit 1 ;;
+esac

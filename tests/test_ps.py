@@ -197,3 +197,36 @@ class TestPSTraining:
         tr = Trainer(cfg_spmd, mesh=make_mesh({"data": 2})).load_data()
         spmd_w = np.asarray(tr.fit())
         np.testing.assert_allclose(ps_w, spmd_w, atol=5e-2)
+
+
+class TestMultiHostSurface:
+    def test_ps_workers_join_external_group(self, ps_data_dir):
+        """Two `run_ps_workers` calls with disjoint rank subsets (the
+        multi-host deployment shape: each host runs its ranks against a
+        shared `launch ps-server` group) train one model together, and
+        rank 0's Finalize-parity exit retires the server processes."""
+        from distlr_tpu.train.ps_trainer import run_ps_workers
+
+        cfg = Config(
+            data_dir=ps_data_dir, num_feature_dim=16, num_workers=2,
+            num_servers=2, num_iteration=20, learning_rate=0.5, l2_c=0.0,
+            batch_size=-1, test_interval=0, sync_mode=True,
+        )
+        group = ServerGroup(2, 2, dim=16, learning_rate=0.5, sync=True)
+        with group:
+            out = {}
+
+            def host(ranks):
+                out.update(run_ps_workers(cfg, group.hosts, ranks))
+
+            hosts = [threading.Thread(target=host, args=([r],)) for r in (0, 1)]
+            for t in hosts:
+                t.start()
+            for t in hosts:
+                t.join()
+            assert set(out) == {0, 1}
+            np.testing.assert_allclose(out[0], out[1], atol=1e-5)
+            # rank 0 shut the group down at the exit barrier
+            for p in group.procs:
+                p.wait(timeout=5)
+            assert not any(group.alive())
