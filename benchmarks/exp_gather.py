@@ -1,0 +1,74 @@
+"""Probe: random-gather/scatter ceiling on this chip.
+
+exp_sparse.py showed the config-4 step is gather/scatter bound at ~120M
+random accesses/s into a D=1M f32 table.  Questions:
+  - does table size matter (VMEM-resident vs HBM)?
+  - does table dtype matter (f32 vs bf16)?
+  - does index count amortize (N=1.38M vs 8x)?
+  - is jnp.take faster with a 2D (D/8, 8) blocked table when indices are
+    *random* anyway (gather of 8-wide rows, 1/8 the indices, 8x waste)?
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.dirname(HERE))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def timeit(name, fn, *args, iters=20):
+    out = fn(*args)
+    _ = float(jnp.sum(out).astype(jnp.float32))
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    _ = float(jnp.sum(out).astype(jnp.float32))
+    dt = (time.perf_counter() - t0) / iters
+    return name, dt
+
+
+def main():
+    N = 1_376_256  # 65536*21, the config-4 index count
+    rng = np.random.default_rng(0)
+
+    rows = []
+    for D in (65_536, 1_000_000, 8_000_000):
+        idx = jnp.asarray(rng.integers(0, D, N), jnp.int32)
+        for dtype in (jnp.float32, jnp.bfloat16):
+            w = jnp.asarray(rng.standard_normal(D), dtype)
+            gather = jax.jit(lambda w, i: w[i])
+            name, dt = timeit(f"gather  D={D:>9} {w.dtype.name}", gather, w, idx)
+            rows.append((name, dt, N / dt))
+        w = jnp.asarray(rng.standard_normal(D), jnp.float32)
+        upd = jnp.asarray(rng.standard_normal(N), jnp.float32)
+        scat = jax.jit(lambda w, i, u: w.at[i].add(u))
+        name, dt = timeit(f"scatter D={D:>9} f32", scat, w, idx, upd)
+        rows.append((name, dt, N / dt))
+
+    # blocked-row gather: (D/8, 8) table, N/8 row indices, same total bytes
+    D = 1_000_000
+    w2 = jnp.asarray(rng.standard_normal((D // 8, 8)), jnp.float32)
+    idx8 = jnp.asarray(rng.integers(0, D // 8, N // 8), jnp.int32)
+    g2 = jax.jit(lambda w, i: w[i])
+    name, dt = timeit("gather  rows-of-8 (N/8 idx, same bytes)", g2, w2, idx8)
+    rows.append((name, dt, (N // 8) / dt))
+
+    # wider rows: (D/128, 128) — the sublane*lane tile
+    w3 = jnp.asarray(rng.standard_normal((D // 128, 128)), jnp.float32)
+    idx128 = jnp.asarray(rng.integers(0, D // 128, N // 128), jnp.int32)
+    name, dt = timeit("gather  rows-of-128 (N/128 idx)", g2, w3, idx128)
+    rows.append((name, dt, (N // 128) / dt))
+
+    for name, dt, rate in rows:
+        print(f"{name:45s} {dt*1e3:8.2f} ms   {rate/1e6:9.1f} M idx/s")
+
+
+if __name__ == "__main__":
+    main()

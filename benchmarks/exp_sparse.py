@@ -1,0 +1,129 @@
+"""Experiment: scatter-strategy shootout for the sparse one-hot LR gradient.
+
+Config-4 context: D=1M buckets, B=65536, 21 fields -> 1.38M scatter-adds
+per step.  Current path (`SparseBinaryLR.grad`) is `jax.ops.segment_sum`
+over unsorted flattened column ids, measured ~3.2M samples/s.  The
+compute wall on this chip is ~220G elem/s (benchmarks/ROOFLINE.md), so
+scatter lowering is the suspect.  Candidates:
+
+  A. segment_sum, unsorted (status quo)
+  B. sort_key_val(cols, contrib) then segment_sum(indices_are_sorted)
+  C. w.at[flat_cols].add(contrib) applied directly to the SGD update
+  D. one_hot matmul over a bucketed two-level decomposition:
+       hi = cols // 4096 tile, scatter into (4096, D/4096)?  -- skipped,
+       shape gymnastics; only if B wins big.
+  E. K inner steps per dispatch via lax.scan (dispatch-overhead probe)
+
+Run on the real chip: python benchmarks/exp_sparse.py
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.dirname(HERE))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from distlr_tpu.data.hashing import make_ctr_dataset
+
+D, B, FIELDS, STEPS = 1_000_000, 65536, 21, 20
+LR = 0.5
+
+
+def timeit(name, step, w, batch, steps=STEPS, samples_per_step=B):
+    w1 = step(w, batch)
+    _ = float(jnp.sum(w1))  # compile + sync
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        w = step(w, batch)
+    _ = float(jnp.sum(w))
+    dt = time.perf_counter() - t0
+    sps = samples_per_step * steps / dt
+    print(f"{name:55s} {sps/1e6:10.2f} M samples/s   ({dt/steps*1e3:8.2f} ms/step)")
+    return sps
+
+
+def residual(w, cols, vals, y):
+    z = jnp.sum(w[cols] * vals, axis=-1)
+    return jax.nn.sigmoid(z) - y.astype(jnp.float32)
+
+
+def main():
+    print(f"platform: {jax.devices()[0].platform}  D={D} B={B} fields={FIELDS}")
+    _, cols, vals, y, _w = make_ctr_dataset(B, FIELDS, 10_000_000, D, seed=0)
+    cols = jnp.asarray(cols)
+    vals = jnp.asarray(vals)
+    y = jnp.asarray(y)
+    batch = (cols, vals, y)
+    w0 = jnp.zeros(D, jnp.float32)
+
+    @jax.jit
+    def step_a(w, batch):
+        cols, vals, y = batch
+        r = residual(w, cols, vals, y)
+        contrib = (r[:, None] * vals).reshape(-1) / B
+        g = jax.ops.segment_sum(contrib, cols.reshape(-1), num_segments=D)
+        return w - LR * g
+
+    @jax.jit
+    def step_b(w, batch):
+        cols, vals, y = batch
+        r = residual(w, cols, vals, y)
+        contrib = (r[:, None] * vals).reshape(-1) / B
+        sc, scontrib = jax.lax.sort_key_val(cols.reshape(-1), contrib)
+        g = jax.ops.segment_sum(scontrib, sc, num_segments=D, indices_are_sorted=True)
+        return w - LR * g
+
+    @jax.jit
+    def step_c(w, batch):
+        cols, vals, y = batch
+        r = residual(w, cols, vals, y)
+        contrib = (r[:, None] * vals).reshape(-1) * (LR / B)
+        return w.at[cols.reshape(-1)].add(-contrib)
+
+    K = 8
+
+    @jax.jit
+    def step_e(w, batch):
+        cols, vals, y = batch
+
+        def body(w, _):
+            r = residual(w, cols, vals, y)
+            contrib = (r[:, None] * vals).reshape(-1) / B
+            g = jax.ops.segment_sum(contrib, cols.reshape(-1), num_segments=D)
+            return w - LR * g, None
+
+        w, _ = jax.lax.scan(body, w, None, length=K)
+        return w
+
+    # numerical cross-check A vs B vs C on one step
+    wa = step_a(w0, batch)
+    wb = step_b(w0, batch)
+    wc = step_c(w0, batch)
+    print("max|A-B| =", float(jnp.max(jnp.abs(wa - wb))),
+          " max|A-C| =", float(jnp.max(jnp.abs(wa - wc))))
+
+    timeit("A segment_sum unsorted (status quo)", step_a, w0, batch)
+    timeit("B sort + segment_sum(indices_are_sorted)", step_b, w0, batch)
+    timeit("C scatter-add via .at[].add into update", step_c, w0, batch)
+    timeit(f"E scan x{K} inner steps (A formulation)", step_e, w0, batch,
+           steps=max(STEPS // K, 3), samples_per_step=B * K)
+
+    # forward-only probe: how much of the step is the gather side?
+    @jax.jit
+    def fwd_only(w, batch):
+        cols, vals, y = batch
+        r = residual(w, cols, vals, y)
+        return w + 1e-9 * jnp.sum(r)  # keep w-shaped output
+
+    timeit("  (probe) forward gather+logits only", fwd_only, w0, batch)
+
+
+if __name__ == "__main__":
+    main()

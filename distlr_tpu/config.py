@@ -99,6 +99,13 @@ class Config:
     # ---- PS / async mode ----
     ps_host: str = "127.0.0.1"        # DMLC_PS_ROOT_URI
     ps_port: int = 8001               # DMLC_PS_ROOT_PORT
+    # Where PS workers run their gradient/eval steps. "auto" picks the
+    # host CPU backend when the per-batch workload (param_dim x batch
+    # elements) is small enough that accelerator dispatch latency would
+    # dominate the step (tiny reference-scale models: D=123, B=256 is
+    # ~0.1 ms of math but ~1-80 ms of dispatch), and the default backend
+    # otherwise. "cpu" / "default" force the choice.
+    ps_compute_backend: str = "auto"  # auto | cpu | default
     # Per-op receive timeout. A dead peer otherwise deadlocks the sync
     # BSP barrier forever (the reference's named straggler failure,
     # SURVEY.md §5.3), so detection is ON by default — but with a 10 min
@@ -130,6 +137,10 @@ class Config:
             raise ValueError("num_feature_dim must be positive")
         if self.batch_size == 0 or self.batch_size < -1:
             raise ValueError("batch_size must be -1 (full shard) or positive")
+        if self.ps_compute_backend not in ("auto", "cpu", "default"):
+            raise ValueError(
+                f"ps_compute_backend must be auto|cpu|default, got {self.ps_compute_backend!r}"
+            )
 
     # -- reference env-var shim ------------------------------------------------
     @classmethod

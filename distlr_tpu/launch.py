@@ -58,6 +58,13 @@ def _add_config_flags(p: argparse.ArgumentParser) -> None:
     p.add_argument("--num-processes", dest="num_processes", type=int)
     p.add_argument("--process-id", dest="process_id", type=int)
     p.add_argument(
+        "--ps-compute-backend", dest="ps_compute_backend",
+        choices=["auto", "cpu", "default"],
+        help="where PS workers run their jitted steps: auto (host CPU for "
+        "tiny per-batch workloads where dispatch latency dominates, "
+        "accelerator otherwise), or force cpu/default",
+    )
+    p.add_argument(
         "--cpu-devices", dest="cpu_devices", type=int,
         help="simulate an N-device CPU mesh (no accelerator needed); "
         "environments that pre-import jax ignore a plain XLA_FLAGS env var, "
@@ -75,7 +82,7 @@ def _config_from_args(args: argparse.Namespace) -> Config:
             "data_dir", "num_feature_dim", "num_iteration", "batch_size",
             "learning_rate", "l2_c", "test_interval", "model", "num_classes",
             "nnz_max", "compat_mode", "checkpoint_dir", "checkpoint_interval",
-            "profile_dir", "num_workers", "num_servers",
+            "profile_dir", "num_workers", "num_servers", "ps_compute_backend",
         }
     }
     cfg = Config.from_env(**overrides)

@@ -44,6 +44,45 @@ from distlr_tpu.utils.logging import get_logger, log_eval_line
 log = get_logger(__name__)
 
 
+# Below this many per-batch elements (param_dim * batch), the gradient
+# step is cheaper on the host CPU backend than the accelerator's dispatch
+# latency (~0.1 ms of math vs 1-80 ms of round trip for reference-scale
+# D=123 steps; measured in benchmarks/exp_sparse.py context — the config-2
+# PS bench went dispatch-bound without this).  2^25 elements ≈ 5-10 ms of
+# CPU math — the crossover against typical remote-dispatch cost.
+_PS_AUTO_CPU_THRESHOLD = 1 << 25
+def ps_compute_device(cfg: Config, rows: int | None = None):
+    """Device PS workers run their jitted steps on (None = default backend).
+
+    The reference's workers are host-CPU programs (``src/lr.cc:35-41``);
+    our PS mode jits the same math, but for tiny models the accelerator
+    round trip per minibatch dwarfs the math, so "auto" keeps small steps
+    on the host CPU backend and sends big ones to the accelerator.
+
+    ``rows`` is the actual per-step row count (minibatch size, full train
+    shard, or full test set — the train and eval steps each pass their
+    own).  When it is unknown (``None`` with ``batch_size=-1``), the step
+    is assumed big enough to amortize accelerator dispatch.
+    """
+    choice = cfg.ps_compute_backend
+    if choice == "default":
+        return None
+    if choice == "cpu":
+        return jax.devices("cpu")[0]
+    if jax.default_backend() == "cpu":
+        return None
+    if rows is None:
+        rows = cfg.batch_size
+    if rows <= 0 or ps_param_dim(cfg) * rows >= _PS_AUTO_CPU_THRESHOLD:
+        return None
+    try:
+        return jax.devices("cpu")[0]
+    except RuntimeError:
+        # JAX_PLATFORMS=tpu (no cpu backend initialized): degrade to the
+        # default backend rather than abort — "auto" is best-effort.
+        return None
+
+
 @functools.lru_cache(maxsize=None)
 def _compiled_fns(model, l2_c: float, l2_scale_by_batch: bool):
     """Jitted gradient step shared across PSWorker instances and runs.
@@ -120,12 +159,21 @@ class PSWorker:
             self.kv.wait(self.kv.push(w0))
         self.kv.barrier()
 
+        # Committed inputs pin each jitted step to its device; jax.jit
+        # keys its executable cache on input placement, so both backends
+        # can coexist in one process.  Train and eval steps size their
+        # choice independently (a tiny minibatch must not drag a huge
+        # full-test-set eval onto the host CPU).
+        train_rows = cfg.batch_size if cfg.batch_size > 0 else train.num_samples
+        step_dev = ps_compute_device(cfg, train_rows)
+        eval_dev = ps_compute_device(cfg, test.num_samples) if test is not None else None
+
         w = w0
         for epoch in range(cfg.num_iteration):
             train.reset()
             for X, y, mask in train:
                 w = self.kv.pull()
-                g = self._grad_fn(self._shape_params(w), X, y, mask)
+                g = self._grad_fn(*self._place(step_dev, self._shape_params(w), X, y, mask))
                 self.kv.wait(self.kv.push(np.asarray(g).reshape(-1)))
             if (
                 self.rank == 0
@@ -136,7 +184,7 @@ class PSWorker:
                 w = self.kv.pull()
                 test.reset()
                 Xt, yt, mt = test.next_batch()
-                acc = float(self._acc_fn(self._shape_params(w), Xt, yt, mt))
+                acc = float(self._acc_fn(*self._place(eval_dev, self._shape_params(w), Xt, yt, mt)))
                 self.metrics.log(epoch=epoch + 1, accuracy=acc)
                 if eval_fn is not None:
                     eval_fn(epoch + 1, acc)
@@ -157,6 +205,12 @@ class PSWorker:
         if self.rank == 0:
             self.kv.shutdown_servers()
         return self.final_weights
+
+    @staticmethod
+    def _place(device, *arrays):
+        if device is None:
+            return arrays
+        return tuple(jax.device_put(a, device) for a in arrays)
 
     def _shape_params(self, flat: np.ndarray):
         if self.cfg.model == "softmax":

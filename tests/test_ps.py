@@ -230,3 +230,47 @@ class TestMultiHostSurface:
             for p in group.procs:
                 p.wait(timeout=5)
             assert not any(group.alive())
+
+
+class TestPSComputeDevice:
+    """PS workers pick the step device by workload size (dispatch-latency
+    avoidance for tiny reference-scale models)."""
+
+    def test_forced_choices(self):
+        from distlr_tpu.train.ps_trainer import ps_compute_device
+
+        cfg = Config(num_feature_dim=16)
+        assert ps_compute_device(cfg.replace(ps_compute_backend="default")) is None
+        dev = ps_compute_device(cfg.replace(ps_compute_backend="cpu"))
+        assert dev is not None and dev.platform == "cpu"
+
+    def test_auto_thresholds(self, monkeypatch):
+        import jax
+
+        from distlr_tpu.train import ps_trainer
+
+        monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+        # tiny step -> host CPU
+        small = Config(num_feature_dim=123, batch_size=256)
+        assert ps_trainer.ps_compute_device(small).platform == "cpu"
+        # big step -> default (accelerator) backend
+        big = Config(num_feature_dim=1_000_000, batch_size=4096)
+        assert ps_trainer.ps_compute_device(big) is None
+        # full-shard batch (-1) with unknown size assumed big
+        full = Config(num_feature_dim=1_000_000, batch_size=-1)
+        assert ps_trainer.ps_compute_device(full) is None
+        # ...but the actual row count decides when known: a small shard
+        # stays on CPU, a huge eval set goes to the accelerator
+        assert ps_trainer.ps_compute_device(small.replace(batch_size=-1), rows=2000).platform == "cpu"
+        assert ps_trainer.ps_compute_device(small, rows=5_000_000) is None
+
+    def test_auto_on_cpu_platform_is_default(self):
+        # Under the test conftest the default backend IS cpu: auto must
+        # not commit arrays (None = uncommitted default placement).
+        from distlr_tpu.train.ps_trainer import ps_compute_device
+
+        assert ps_compute_device(Config(num_feature_dim=123, batch_size=256)) is None
+
+    def test_invalid_choice_rejected(self):
+        with pytest.raises(ValueError, match="ps_compute_backend"):
+            Config(ps_compute_backend="gpu")
