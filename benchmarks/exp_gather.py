@@ -11,12 +11,7 @@ random accesses/s into a D=1M f32 table.  Questions:
 
 from __future__ import annotations
 
-import os
-import sys
 import time
-
-HERE = os.path.dirname(os.path.abspath(__file__))
-sys.path.insert(0, os.path.dirname(HERE))
 
 import jax
 import jax.numpy as jnp

@@ -40,8 +40,10 @@ class ServerGroup:
         last_gradient: bool = False,
         ports: list[int] | None = None,
         bind_any: bool = False,
+        binary: str | None = None,
     ):
         build_native()
+        self._binary = binary or server_binary()
         self.num_servers = num_servers
         self.num_workers = num_workers
         self.dim = dim
@@ -67,7 +69,7 @@ class ServerGroup:
             hi = self.dim * (rank + 1) // self.num_servers
             port = fixed_ports[rank] if fixed_ports else 0
             cmd = [
-                server_binary(),
+                self._binary,
                 f"--port={port}",
                 f"--num_workers={self.num_workers}",
                 f"--dim={hi - lo}",
