@@ -68,10 +68,11 @@ class DataIter:
             return self._offset + self.batch_size <= self.num_samples
         return self._offset < self.num_samples
 
-    def next_batch(self):
+    def _next_idx(self):
+        """Row indices + validity mask of the next static-shape batch."""
         if not self.has_next():
             raise StopIteration
-        b, n = self.batch_size, self.num_samples
+        b = self.batch_size
         idx = self._order[self._offset : self._offset + b]
         if len(idx) < b and self.wrap_compat:
             # Q5 parity: wrap around and duplicate head samples, cycling as
@@ -86,6 +87,10 @@ class DataIter:
             pad = b - real
             idx = np.concatenate([idx, np.zeros(pad, dtype=idx.dtype)])
             mask[real:] = False
+        return idx, mask
+
+    def next_batch(self):
+        idx, mask = self._next_idx()
         return self.X[idx], self.y[idx], mask
 
     def __iter__(self):
@@ -102,3 +107,42 @@ class DataIter:
         if self.drop_remainder:
             return self.num_samples // self.batch_size
         return -(-self.num_samples // self.batch_size)
+
+
+class SparseDataIter(DataIter):
+    """Padded-COO variant: yields ``(cols, vals, y, mask)`` batches.
+
+    ``cols``/``vals`` are ``(B, NNZ_MAX)`` per-row index/value arrays
+    (pad col = 0, pad val = 0) — the ``SparseBinaryLR`` batch layout.
+    Same epoch/batching semantics as :class:`DataIter` (the row arrays
+    just carry two feature leaves instead of a dense matrix).
+    """
+
+    def __init__(self, cols, vals, y, batch_size: int = -1, **kw):
+        cols = np.asarray(cols)
+        self.vals = np.asarray(vals)
+        if cols.shape != self.vals.shape:
+            raise ValueError(f"cols {cols.shape} vs vals {self.vals.shape}")
+        super().__init__(cols, y, batch_size, **kw)
+
+    @property
+    def cols(self) -> np.ndarray:
+        return self.X
+
+    @classmethod
+    def from_file(cls, path, num_features: int | None = None, batch_size: int = -1,
+                  *, nnz_max: int | None = None, **kw):
+        """Parse a libsvm shard WITHOUT densifying (CTR-scale feature
+        spaces where ``(N, D)`` dense would not fit host RAM)."""
+        from distlr_tpu.data.hashing import csr_to_padded_coo  # noqa: PLC0415
+        from distlr_tpu.data.libsvm import parse_libsvm_file  # noqa: PLC0415
+
+        (row_ptr, csr_cols, csr_vals), y = parse_libsvm_file(
+            path, num_features, dense=False
+        )
+        cols, vals = csr_to_padded_coo(row_ptr, csr_cols, csr_vals, nnz_max=nnz_max)
+        return cls(cols, vals, y, batch_size, **kw)
+
+    def next_batch(self):
+        idx, mask = self._next_idx()
+        return self.X[idx], self.vals[idx], self.y[idx], mask

@@ -183,8 +183,13 @@ class SparseBinaryLR:
     def init(self, cfg: Config) -> jnp.ndarray:
         if cfg.reference_rng_init:
             return jnp.asarray(reference_init_weights(self.num_features, 0))
-        key = jax.random.PRNGKey(cfg.random_seed)
-        return jax.random.uniform(key, (self.num_features,), dtype=jnp.float32)
+        # Zeros, NOT the dense models' uniform-[0,1) reference mirror: with
+        # F active features a positive-mean init biases every logit to
+        # ~F/2, and at CTR scale each weight is touched too rarely for SGD
+        # to unwind that (uniform init at D=1e5 sits at chance accuracy
+        # for tens of epochs).  The reference has no sparse model to be
+        # compatible with.
+        return jnp.zeros(self.num_features, jnp.float32)
 
     def logits(self, w, cols, vals):
         return jnp.sum(w[cols] * vals, axis=-1)

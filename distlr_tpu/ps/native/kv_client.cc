@@ -124,11 +124,21 @@ int RoundTrip(Client* c, Op op, const Key* keys, const float* vals,
   const uint32_t ts = c->next_ts++;
   auto slices = SliceByRange(*c, keys, n);
 
+  // A PUSH visits EVERY server even when its key slice is empty: in sync
+  // mode the server releases the BSP barrier only after num_workers
+  // pushes, so a keyed (sparse) push that skipped an untouched server
+  // would desynchronize the round — peers' deferred replies would wait
+  // for a push that never comes, then mix gradients across rounds when
+  // the next batch happens to touch that range.  The empty push is the
+  // worker's "present" vote; it merges nothing.  (PULLs may still skip:
+  // replies are immediate, no barrier semantics.)
+  const bool visit_all = op == Op::kPush;
+
   // Phase 1: send the sliced request to every involved server.
   std::vector<std::vector<Key>> local_keys(c->servers.size());
   for (size_t s = 0; s < c->servers.size(); ++s) {
     const auto [b, e] = slices[s];
-    if (b == e && !(op == Op::kBarrier && s == 0)) continue;
+    if (b == e && !visit_all && !(op == Op::kBarrier && s == 0)) continue;
     MsgHeader h{kMagic, static_cast<uint8_t>(op), kNone, 0,
                 c->client_id, ts, e - b};
     auto& lk = local_keys[s];
@@ -150,7 +160,7 @@ int RoundTrip(Client* c, Op op, const Key* keys, const float* vals,
   // in sync mode this wait IS the BSP barrier).
   for (size_t s = 0; s < c->servers.size(); ++s) {
     const auto [b, e] = slices[s];
-    if (b == e && !(op == Op::kBarrier && s == 0)) continue;
+    if (b == e && !visit_all && !(op == Op::kBarrier && s == 0)) continue;
     MsgHeader rh{};
     errno = 0;
     if (!ReadFull(c->servers[s].fd, &rh, sizeof(rh))) {

@@ -223,8 +223,11 @@ class KVServer {
     ++n_push_;
     if (!keys.empty()) EnsureCapacity(keys.back());
 
-    if (!initialized_) {
-      // First push seeds the weights (src/main.cc:50-56).
+    if (!initialized_ && !keys.empty()) {
+      // First non-empty push seeds the weights (src/main.cc:50-56).  An
+      // EMPTY push (a sparse worker's "present" vote for a range it did
+      // not touch) can never initialize — it falls through to the normal
+      // sync/async handling so it still counts toward the BSP barrier.
       for (size_t i = 0; i < keys.size(); ++i) weights_[keys[i]] = vals[i];
       initialized_ = true;
       lock.unlock();

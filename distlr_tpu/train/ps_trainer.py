@@ -34,6 +34,7 @@ import numpy as np
 
 from distlr_tpu.config import Config
 from distlr_tpu.data import DataIter
+from distlr_tpu.data.iterator import SparseDataIter
 from distlr_tpu.data.sharding import part_name
 from distlr_tpu.models import get_model
 from distlr_tpu.ps import KVWorker, ServerGroup
@@ -105,17 +106,53 @@ def _compiled_acc(model):
     return jax.jit(lambda w, X, y, mask: model.accuracy(w, (X, y, mask)))
 
 
+def _sparse_batch_grad(w_u, pos, vals, y, mask, l2_c, l2_scale_by_batch):
+    """Gradient of the sparse one-hot LR loss wrt the batch's UNIQUE
+    touched weights (numpy, host-side).
+
+    Mirrors ``SparseBinaryLR.grad`` (models/linear.py) restricted to the
+    touched key set: ``w_u`` are the pulled weights for the batch's unique
+    columns, ``pos`` maps each (row, slot) to its index in ``w_u``.  The
+    scatter is ``np.bincount`` (vectorized C) — PS-sparse batches are
+    exactly the tiny host-side steps where jit dispatch would dominate,
+    and a per-batch-varying unique-key count would recompile every step.
+
+    L2 is applied *lazily* (only the touched coordinates, like every
+    sparse parameter server): with ``l2_c > 0`` the effective decay per
+    weight scales with how often it is touched, unlike the dense path's
+    every-step decay — callers comparing against the dense trainer should
+    set ``l2_c = 0`` or account for touch frequency.
+    """
+    z = (w_u[pos] * vals).sum(axis=-1)
+    sig = 0.5 * (1.0 + np.tanh(0.5 * z))  # overflow-stable sigmoid
+    n = np.float32(max(mask.sum(), 1))
+    resid = ((sig - y) * mask).astype(np.float32)
+    contrib = (resid[:, None] * vals).ravel() / n
+    g = np.bincount(pos.ravel(), weights=contrib, minlength=len(w_u)).astype(np.float32)
+    if l2_c:
+        # Decay only genuinely-active keys: COO padding (col 0, val 0)
+        # puts key 0 in EVERY batch's unique set, which would give bucket
+        # 0 dense-style every-step decay while real features decay per
+        # touch.
+        active = np.bincount(pos.ravel(), weights=(vals != 0).ravel().astype(np.float32),
+                             minlength=len(w_u)) > 0
+        term = np.float32(l2_c) * w_u * active
+        g += term / n if l2_scale_by_batch else term
+    return g
+
+
 class PSWorker:
-    """One worker's training loop against a KV server group."""
+    """One worker's training loop against a KV server group.
+
+    Dense models (``binary_lr``, ``softmax``) pull/push the full weight
+    vector per batch like the reference worker.  ``sparse_lr`` uses
+    *keyed* Push/Pull (the ps-lite capability the reference app never
+    exercises — its key set is always dense 0..D-1, ``src/lr.cc:117-121``):
+    each batch pulls and pushes only its unique touched columns, so a
+    D=1M-bucket CTR model ships KBs per step instead of 12 MB.
+    """
 
     def __init__(self, cfg: Config, rank: int, hosts: str, *, train_iter=None, test_iter=None):
-        if cfg.model == "sparse_lr":
-            # The PS data path serves dense (X, y, mask) batches; padded-COO
-            # sparse batches are a Trainer/SPMD-mode feature.
-            raise NotImplementedError(
-                "PS mode supports dense models (binary_lr, softmax); use the "
-                "sync Trainer for sparse_lr"
-            )
         self.cfg = cfg
         self.rank = rank
         self.model = get_model(cfg)
@@ -125,8 +162,15 @@ class PSWorker:
         )
         self._train_iter = train_iter
         self._test_iter = test_iter
-        self._grad_fn = _compiled_fns(self.model, cfg.l2_c, bool(cfg.l2_scale_by_batch))
-        self._acc_fn = _compiled_acc(self.model)
+        # sparse_lr never uses the jitted dense-batch fns (its per-batch
+        # unique-key count varies, so it runs numpy host math instead —
+        # _sparse_batch_grad); building them would plant a lambda whose
+        # (X, y, mask) signature crashes on padded-COO batches.
+        if cfg.model == "sparse_lr":
+            self._grad_fn = self._acc_fn = None
+        else:
+            self._grad_fn = _compiled_fns(self.model, cfg.l2_c, bool(cfg.l2_scale_by_batch))
+            self._acc_fn = _compiled_acc(self.model)
         self.metrics = MetricsLogger()
         self.final_weights: np.ndarray | None = None
 
@@ -137,11 +181,17 @@ class PSWorker:
         # Reference re-reads its shard every epoch (src/main.cc:158-159);
         # we parse once and reset (same samples, no quirk).
         path = os.path.join(self.cfg.data_dir, "train", part_name(self.rank))
+        if self.cfg.model == "sparse_lr":
+            return SparseDataIter.from_file(path, self.cfg.num_feature_dim,
+                                            self.cfg.batch_size, nnz_max=self.cfg.nnz_max)
         return DataIter.from_file(path, self.cfg.num_feature_dim, self.cfg.batch_size,
                                   multiclass=self.cfg.model == "softmax")
 
     def _load_test_iter(self) -> DataIter:
         path = os.path.join(self.cfg.data_dir, "test", part_name(0))
+        if self.cfg.model == "sparse_lr":
+            return SparseDataIter.from_file(path, self.cfg.num_feature_dim, -1,
+                                            nnz_max=self.cfg.nnz_max)
         return DataIter.from_file(path, self.cfg.num_feature_dim, -1,
                                   multiclass=self.cfg.model == "softmax")
 
@@ -159,32 +209,50 @@ class PSWorker:
             self.kv.wait(self.kv.push(w0))
         self.kv.barrier()
 
-        # Committed inputs pin each jitted step to its device; jax.jit
-        # keys its executable cache on input placement, so both backends
-        # can coexist in one process.  Train and eval steps size their
-        # choice independently (a tiny minibatch must not drag a huge
-        # full-test-set eval onto the host CPU).
-        train_rows = cfg.batch_size if cfg.batch_size > 0 else train.num_samples
-        step_dev = ps_compute_device(cfg, train_rows)
-        eval_dev = ps_compute_device(cfg, test.num_samples) if test is not None else None
-
+        sparse = cfg.model == "sparse_lr"
+        if not sparse:
+            # Committed inputs pin each jitted step to its device; jax.jit
+            # keys its executable cache on input placement, so both
+            # backends can coexist in one process.  Train and eval steps
+            # size their choice independently (a tiny minibatch must not
+            # drag a huge full-test-set eval onto the host CPU).
+            train_rows = cfg.batch_size if cfg.batch_size > 0 else train.num_samples
+            step_dev = ps_compute_device(cfg, train_rows)
+            eval_dev = ps_compute_device(cfg, test.num_samples) if test is not None else None
         w = w0
         for epoch in range(cfg.num_iteration):
             train.reset()
-            for X, y, mask in train:
-                w = self.kv.pull()
-                g = self._grad_fn(*self._place(step_dev, self._shape_params(w), X, y, mask))
-                self.kv.wait(self.kv.push(np.asarray(g).reshape(-1)))
+            if sparse:
+                # Keyed Push/Pull: only the batch's unique touched columns
+                # travel (ps-lite's sliced-key capability, SURVEY.md §2.2
+                # E1.d/g — the reference app itself never exercises it).
+                for cols, vals, y, mask in train:
+                    keys, pos = np.unique(cols, return_inverse=True)
+                    keys = keys.astype(np.uint64)
+                    w_u = self.kv.pull(keys=keys)
+                    g_u = _sparse_batch_grad(
+                        w_u, pos.reshape(cols.shape), vals, y, mask,
+                        cfg.l2_c, bool(cfg.l2_scale_by_batch),
+                    )
+                    self.kv.wait(self.kv.push(g_u, keys=keys))
+            else:
+                for X, y, mask in train:
+                    w = self.kv.pull()
+                    g = self._grad_fn(*self._place(step_dev, self._shape_params(w), X, y, mask))
+                    self.kv.wait(self.kv.push(np.asarray(g).reshape(-1)))
             if (
                 self.rank == 0
                 and test is not None
                 and cfg.test_interval > 0
                 and (epoch + 1) % cfg.test_interval == 0
             ):
-                w = self.kv.pull()
-                test.reset()
-                Xt, yt, mt = test.next_batch()
-                acc = float(self._acc_fn(*self._place(eval_dev, self._shape_params(w), Xt, yt, mt)))
+                if sparse:
+                    acc = self._sparse_eval(test)
+                else:
+                    w = self.kv.pull()
+                    test.reset()
+                    Xt, yt, mt = test.next_batch()
+                    acc = float(self._acc_fn(*self._place(eval_dev, self._shape_params(w), Xt, yt, mt)))
                 self.metrics.log(epoch=epoch + 1, accuracy=acc)
                 if eval_fn is not None:
                     eval_fn(epoch + 1, acc)
@@ -205,6 +273,18 @@ class PSWorker:
         if self.rank == 0:
             self.kv.shutdown_servers()
         return self.final_weights
+
+    def _sparse_eval(self, test) -> float:
+        """Full-test-set accuracy: keyed pull of the test set's unique
+        columns, then the model's own accuracy math (no duplicated
+        forward — the pulled slice is scattered into a full-width vector
+        first)."""
+        test.reset()
+        cols, vals, y, mask = test.next_batch()
+        keys = np.unique(cols).astype(np.uint64)
+        w = np.zeros(self.cfg.num_feature_dim, np.float32)
+        w[keys] = self.kv.pull(keys=keys)
+        return float(self.model.accuracy(w, (cols, vals, y, mask.astype(np.float32))))
 
     @staticmethod
     def _place(device, *arrays):
