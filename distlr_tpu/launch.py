@@ -159,10 +159,6 @@ def cmd_ps(args: argparse.Namespace) -> int:
     from distlr_tpu.train.ps_trainer import run_ps_local, run_ps_workers  # noqa: PLC0415
 
     cfg = _config_from_args(args)
-    if cfg.model == "sparse_lr":  # fail before any server process spawns
-        print("error: ps mode supports dense models (binary_lr, softmax); "
-              "use the sync trainer for sparse_lr", file=sys.stderr)
-        return 2
     if args.asynchronous:
         cfg = cfg.replace(sync_mode=False)
     if args.hosts:
@@ -173,13 +169,13 @@ def cmd_ps(args: argparse.Namespace) -> int:
             if args.worker_ranks
             else range(cfg.num_workers)
         )
-        run_ps_workers(cfg, args.hosts, ranks, save=True)
+        run_ps_workers(cfg, args.hosts, ranks, save=True, resume=args.resume)
     else:
         if args.worker_ranks:
             print("error: --worker-ranks requires --hosts (local mode always "
                   "runs all ranks)", file=sys.stderr)
             return 2
-        run_ps_local(cfg, save=True)
+        run_ps_local(cfg, save=True, resume=args.resume)
     return 0
 
 

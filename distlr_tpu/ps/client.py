@@ -52,6 +52,8 @@ def _load():
         lib.kv_shutdown_servers.argtypes = [ctypes.c_void_p]
         lib.kv_set_timeout_ms.restype = ctypes.c_int
         lib.kv_set_timeout_ms.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.kv_set_push_visit_all.restype = ctypes.c_int
+        lib.kv_set_push_visit_all.argtypes = [ctypes.c_void_p, ctypes.c_int]
         lib.kv_timed_out.restype = ctypes.c_int
         lib.kv_timed_out.argtypes = [ctypes.c_void_p]
         lib.kv_stats.restype = ctypes.c_int
@@ -68,7 +70,8 @@ def _load():
 class KVWorker:
     """Blocking Push/Pull/Wait client over a range-sharded server group."""
 
-    def __init__(self, hosts: str, dim: int, client_id: int = 0, *, timeout_ms: int = 0):
+    def __init__(self, hosts: str, dim: int, client_id: int = 0, *,
+                 timeout_ms: int = 0, sync_group: bool = True):
         lib = _load()
         self._lib = lib
         self.dim = dim
@@ -79,6 +82,11 @@ class KVWorker:
         self._all_keys = np.arange(dim, dtype=np.uint64)
         if timeout_ms:
             self.set_timeout(timeout_ms)
+        if not sync_group:
+            # Async group: no BSP barrier to vote in, so keyed pushes may
+            # skip servers whose key slice is empty (saves S-1 round
+            # trips per sparse push).  MUST stay True for sync groups.
+            lib.kv_set_push_visit_all(self._h, 0)
 
     def set_timeout(self, timeout_ms: int) -> None:
         """Receive timeout for every op; 0 = block forever (reference

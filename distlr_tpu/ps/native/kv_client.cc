@@ -48,6 +48,12 @@ struct Client {
   uint64_t dim = 0;
   uint32_t client_id = 0;
   uint32_t next_ts = 0;
+  // Whether pushes visit servers with EMPTY key slices (the sync-mode
+  // BSP "present" vote; see RoundTrip).  Async groups have no barrier to
+  // keep honest, so their clients turn this off and save S-1 round
+  // trips per keyed push.  Defaults on — the safe choice for a client
+  // that does not know the group's mode.
+  bool push_visit_all = true;
   bool timed_out = false;  // last failure was a receive timeout
   // After any receive failure the stream may still hold a late/partial
   // reply, so every subsequent frame would be misparsed.  The handle is
@@ -132,7 +138,7 @@ int RoundTrip(Client* c, Op op, const Key* keys, const float* vals,
   // the next batch happens to touch that range.  The empty push is the
   // worker's "present" vote; it merges nothing.  (PULLs may still skip:
   // replies are immediate, no barrier semantics.)
-  const bool visit_all = op == Op::kPush;
+  const bool visit_all = op == Op::kPush && c->push_visit_all;
 
   // Phase 1: send the sliced request to every involved server.
   std::vector<std::vector<Key>> local_keys(c->servers.size());
@@ -271,6 +277,14 @@ int kv_set_timeout_ms(void* handle, int ms) {
       rc = -1;
   }
   return rc;
+}
+
+// Whether keyed pushes visit servers whose key slice is empty (default
+// 1).  Required ON for sync groups (the empty push is the worker's BSP
+// barrier vote); async groups may set 0 to skip the wasted round trips.
+int kv_set_push_visit_all(void* handle, int on) {
+  static_cast<distlr::Client*>(handle)->push_visit_all = on != 0;
+  return 0;
 }
 
 // 1 if the most recent failed op failed on a receive timeout (vs a dead

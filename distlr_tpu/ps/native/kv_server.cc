@@ -254,9 +254,15 @@ class KVServer {
       if (last_gradient_) {
         // Q1 compat: apply only the last-arriving gradient / W
         // (the reference reads req_data.vals, src/main.cc:70-72).
-        const PendingPush& last = pending_.back();
-        for (size_t i = 0; i < last.keys.size(); ++i)
-          weights_[last.keys[i]] -= lr_ * last.vals[i] / w;
+        // Keyed rounds can end on an empty "present" vote; the quirk's
+        // meaning is the last worker that pushed DATA, so skip back over
+        // empty votes rather than silently dropping the round.
+        for (auto it = pending_.rbegin(); it != pending_.rend(); ++it) {
+          if (it->keys.empty()) continue;
+          for (size_t i = 0; i < it->keys.size(); ++i)
+            weights_[it->keys[i]] -= lr_ * it->vals[i] / w;
+          break;
+        }
       } else {
         // Correct BSP: mean of the merged gradients.
         for (size_t i = 0; i < merge_.size(); ++i)

@@ -24,7 +24,9 @@ Worker lifecycle parity:
 
 from __future__ import annotations
 
+import contextlib
 import functools
+import json
 import os
 import threading
 import types
@@ -141,6 +143,39 @@ def _sparse_batch_grad(w_u, pos, vals, y, mask, l2_c, l2_scale_by_batch):
     return g
 
 
+def _ps_resume_state(cfg: Config, rank: int):
+    """``(start_epoch, weights | None)`` from ``cfg.checkpoint_dir``.
+
+    Every rank reads the epoch from a JSON sidecar (``ps_latest.json``,
+    written atomically by rank 0 at each checkpoint) so sync-mode workers
+    agree on how many epochs remain without concurrently opening the
+    orbax manager; rank 0 additionally restores the weights, which reach
+    the servers through its init push.  Multi-host deployments need
+    ``checkpoint_dir`` on a shared filesystem — the same rule orbax has.
+    """
+    sidecar = os.path.join(cfg.checkpoint_dir, "ps_latest.json")
+    if not os.path.exists(sidecar):
+        return 0, None
+    with open(sidecar) as f:
+        epoch = int(json.load(f)["epoch"])
+    if rank != 0:
+        return epoch, None
+    from distlr_tpu.train.checkpoint import Checkpointer  # noqa: PLC0415
+
+    with Checkpointer(cfg.checkpoint_dir) as ckpt:
+        # Restore exactly the sidecar's step, NOT latest: a crash between
+        # orbax save N and the sidecar rename leaves latest=N with the
+        # sidecar still naming N-interval — resuming N-interval epochs on
+        # top of step-N weights would double-train the gap.
+        state = ckpt.restore(epoch) if epoch in ckpt.all_steps() else None
+    if state is None:  # sidecar without its orbax step: corrupt dir
+        raise FileNotFoundError(
+            f"{sidecar} names epoch {epoch} but {cfg.checkpoint_dir} holds "
+            f"no orbax checkpoint for that step"
+        )
+    return epoch, np.asarray(state["weights"]).reshape(-1)
+
+
 class PSWorker:
     """One worker's training loop against a KV server group.
 
@@ -156,9 +191,18 @@ class PSWorker:
         self.cfg = cfg
         self.rank = rank
         self.model = get_model(cfg)
+        if cfg.model == "sparse_lr" and cfg.sync_last_gradient:
+            # Q1 is a dense-reference parity quirk; with keyed pushes
+            # "the last worker's gradient" touches an arbitrary key
+            # subset per server — no reference behavior exists to mirror.
+            raise ValueError(
+                "sync_last_gradient (Q1 compat) is a dense-model parity "
+                "quirk; sparse_lr PS training requires the correct-mean "
+                "update (compat_mode='correct')"
+            )
         self.kv = KVWorker(
             hosts, self._param_dim(), client_id=rank,
-            timeout_ms=cfg.ps_timeout_ms,
+            timeout_ms=cfg.ps_timeout_ms, sync_group=cfg.sync_mode,
         )
         self._train_iter = train_iter
         self._test_iter = test_iter
@@ -195,19 +239,53 @@ class PSWorker:
         return DataIter.from_file(path, self.cfg.num_feature_dim, -1,
                                   multiclass=self.cfg.model == "softmax")
 
-    def run(self, *, eval_fn=None, save=True) -> np.ndarray:
+    def run(self, *, eval_fn=None, save=True, resume=False) -> np.ndarray:
         cfg = self.cfg
         train = self._train_iter if self._train_iter is not None else self._load_train_iter()
         test = self._test_iter if self._test_iter is not None else (
             self._load_test_iter() if self.rank == 0 else None
         )
 
+        start_epoch = 0
+        restored = None
+        if resume and cfg.checkpoint_dir:
+            start_epoch, restored = _ps_resume_state(cfg, self.rank)
+
         # Identical deterministic init on every worker (Q2); only rank 0
         # pushes — the server's first-push branch stores it verbatim.
-        w0 = np.asarray(self.model.init(cfg)).reshape(-1)
+        # On resume, the restored weights take the init push's place.
+        w0 = (restored if restored is not None
+              else np.asarray(self.model.init(cfg)).reshape(-1))
         if self.rank == 0:
             self.kv.wait(self.kv.push(w0))
         self.kv.barrier()
+
+        ckpt = None
+        if self.rank == 0 and cfg.checkpoint_dir:
+            from distlr_tpu.train.checkpoint import Checkpointer  # noqa: PLC0415
+
+            ckpt = Checkpointer(cfg.checkpoint_dir)
+
+        with contextlib.ExitStack() as stack:
+            if ckpt is not None:
+                stack.callback(ckpt.close)
+            return self._run_epochs(
+                start_epoch, w0, train, test, ckpt,
+                eval_fn=eval_fn, save=save,
+            )
+
+    def _checkpoint(self, ckpt, epoch: int) -> None:
+        """Rank 0: snapshot the servers' weights + the epoch sidecar
+        (atomic rename) every ``checkpoint_interval`` epochs."""
+        ckpt.save(epoch, self.kv.pull(), extra={"epoch": epoch})
+        sidecar = os.path.join(self.cfg.checkpoint_dir, "ps_latest.json")
+        tmp = sidecar + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"epoch": epoch}, f)
+        os.replace(tmp, sidecar)
+
+    def _run_epochs(self, start_epoch, w0, train, test, ckpt, *, eval_fn, save):
+        cfg = self.cfg
 
         sparse = cfg.model == "sparse_lr"
         if not sparse:
@@ -220,7 +298,7 @@ class PSWorker:
             step_dev = ps_compute_device(cfg, train_rows)
             eval_dev = ps_compute_device(cfg, test.num_samples) if test is not None else None
         w = w0
-        for epoch in range(cfg.num_iteration):
+        for epoch in range(start_epoch, cfg.num_iteration):
             train.reset()
             if sparse:
                 # Keyed Push/Pull: only the batch's unique touched columns
@@ -258,6 +336,19 @@ class PSWorker:
                     eval_fn(epoch + 1, acc)
                 else:
                     log_eval_line(epoch + 1, acc)
+            if (
+                ckpt is not None
+                and cfg.checkpoint_interval > 0
+                and (epoch + 1) % cfg.checkpoint_interval == 0
+            ):
+                self._checkpoint(ckpt, epoch + 1)
+
+        if (
+            ckpt is not None
+            and cfg.num_iteration > start_epoch
+            and ckpt.latest_step() != cfg.num_iteration
+        ):
+            self._checkpoint(ckpt, cfg.num_iteration)
 
         self.final_weights = self.kv.pull()
         if save:
@@ -302,7 +393,7 @@ class PSWorker:
 
 
 def run_ps_workers(cfg: Config, hosts: str, ranks, *, eval_fn=None, save=False,
-                   on_error=None):
+                   on_error=None, resume=False):
     """Run the given worker ranks (threads) against an EXISTING server
     group at ``hosts`` — the multi-host entry point: each host runs its
     subset of ranks against remote servers (started via
@@ -322,7 +413,8 @@ def run_ps_workers(cfg: Config, hosts: str, ranks, *, eval_fn=None, save=False,
 
     def run_one(i, r):
         try:
-            results[r] = workers[i].run(eval_fn=eval_fn if r == 0 else None, save=save)
+            results[r] = workers[i].run(eval_fn=eval_fn if r == 0 else None,
+                                        save=save, resume=resume)
         except Exception as e:  # surface worker failures to the caller
             errors.append(e)
             if on_error is not None:
@@ -352,7 +444,7 @@ def ps_param_dim(cfg: Config) -> int:
     return cfg.num_feature_dim * (cfg.num_classes if cfg.model == "softmax" else 1)
 
 
-def run_ps_local(cfg: Config, *, eval_fn=None, save=False):
+def run_ps_local(cfg: Config, *, eval_fn=None, save=False, resume=False):
     """Single-host PS run: native server subprocesses + threaded workers.
 
     The local-mode successor of ``examples/local.sh`` for the PS path
@@ -371,6 +463,6 @@ def run_ps_local(cfg: Config, *, eval_fn=None, save=False):
     with group:
         results = run_ps_workers(
             cfg, group.hosts, range(cfg.num_workers),
-            eval_fn=eval_fn, save=save, on_error=group.stop,
+            eval_fn=eval_fn, save=save, on_error=group.stop, resume=resume,
         )
     return [results[r] for r in range(cfg.num_workers)]

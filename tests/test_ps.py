@@ -409,3 +409,97 @@ class TestSparseDataIter:
         for cols, vals, y, mask in it:
             n += int(mask.sum())
         assert n == it.num_samples
+
+
+class TestKeyedOpsModes:
+    def test_async_client_skips_untouched_servers(self):
+        """sync_group=False: a keyed push whose slice for a server is
+        empty skips it entirely (no barrier to vote in) — observable via
+        that server's push counter."""
+        dim = 10
+        group = ServerGroup(2, 1, dim, learning_rate=1.0, sync=False)
+        with group:
+            with KVWorker(group.hosts, dim, timeout_ms=20_000, sync_group=False) as kv:
+                kv.wait(kv.push(np.zeros(dim, np.float32)))  # init: both servers
+                kv.wait(kv.push(np.array([1.0], np.float32),
+                                keys=np.array([2], np.uint64)))  # server 0 only
+                s0, s1 = kv.stats(0), kv.stats(1)
+                assert s0["total_pushes"] == 2
+                assert s1["total_pushes"] == 1, "async empty vote was sent anyway"
+                kv.shutdown_servers()
+
+    def test_sparse_q1_compat_rejected(self, tmp_path):
+        """Q1 (last-gradient) is a dense parity quirk; sparse PS must
+        refuse it rather than nondeterministically drop rounds."""
+        from distlr_tpu.train.ps_trainer import PSWorker
+
+        cfg = Config(
+            data_dir=str(tmp_path), num_feature_dim=32, model="sparse_lr",
+            compat_mode="reference", num_workers=1, num_servers=1,
+        )
+        with pytest.raises(ValueError, match="sync_last_gradient"):
+            PSWorker(cfg, 0, "127.0.0.1:1")
+
+
+class TestPSCheckpointResume:
+    """PS-mode durable checkpoint + resume (SURVEY.md §5.4 — the
+    reference can only text-dump final weights, no load path at all)."""
+
+    def test_resume_matches_straight_run(self, ps_data_dir, tmp_path):
+        """Sync full-batch PS is deterministic: 4 epochs + resume(4 more)
+        must equal a straight 8-epoch run."""
+        base = Config(
+            data_dir=ps_data_dir, num_feature_dim=16, num_workers=2,
+            num_servers=2, learning_rate=0.5, l2_c=0.0, batch_size=-1,
+            test_interval=0, sync_mode=True,
+        )
+        straight = run_ps_local(base.replace(num_iteration=8), save=False)
+
+        ck = str(tmp_path / "ck")
+        cfg = base.replace(checkpoint_dir=ck, checkpoint_interval=2)
+        run_ps_local(cfg.replace(num_iteration=4), save=False)
+        import os
+        assert os.path.exists(os.path.join(ck, "ps_latest.json"))
+        resumed = run_ps_local(cfg.replace(num_iteration=8), save=False, resume=True)
+        np.testing.assert_allclose(resumed[0], straight[0], rtol=1e-5, atol=1e-6)
+
+    def test_resume_without_checkpoint_starts_fresh(self, ps_data_dir, tmp_path):
+        cfg = Config(
+            data_dir=ps_data_dir, num_feature_dim=16, num_workers=2,
+            num_servers=1, num_iteration=3, learning_rate=0.5, l2_c=0.0,
+            batch_size=-1, test_interval=0, sync_mode=True,
+            checkpoint_dir=str(tmp_path / "empty"), checkpoint_interval=2,
+        )
+        results = run_ps_local(cfg, save=False, resume=True)
+        assert all(r is not None for r in results)
+
+    def test_async_checkpoints_written(self, ps_data_dir, tmp_path):
+        from distlr_tpu.train.checkpoint import Checkpointer
+
+        ck = str(tmp_path / "ck")
+        cfg = Config(
+            data_dir=ps_data_dir, num_feature_dim=16, num_workers=2,
+            num_servers=1, num_iteration=5, learning_rate=0.2, l2_c=0.0,
+            batch_size=200, test_interval=0, sync_mode=False,
+            checkpoint_dir=ck, checkpoint_interval=2,
+        )
+        run_ps_local(cfg, save=False)
+        with Checkpointer(ck) as c:
+            steps = c.all_steps()
+        assert 5 in steps, f"final checkpoint missing: {steps}"
+
+
+class TestPSSoftmax:
+    def test_softmax_ps_converges(self, tmp_path):
+        d = str(tmp_path / "mc")
+        write_synthetic_shards(d, 1500, 12, num_parts=2, seed=7,
+                               num_classes=4, sparsity=0.0)
+        cfg = Config(
+            data_dir=d, num_feature_dim=12, model="softmax", num_classes=4,
+            num_workers=2, num_servers=2, num_iteration=60,
+            learning_rate=0.5, l2_c=0.0, batch_size=-1, test_interval=30,
+            sync_mode=True,
+        )
+        accs = []
+        run_ps_local(cfg, eval_fn=lambda _e, a: accs.append(a), save=False)
+        assert accs[-1] > 0.6, f"softmax PS accuracy {accs}"
