@@ -77,6 +77,13 @@ class Config:
     nnz_max: int | None = None        # sparse_lr: cap per-row nonzeros (pad width)
     dtype: str = "float32"            # accumulation dtype
     compute_dtype: str = "bfloat16"   # matmul dtype on TPU (MXU-friendly)
+    # Device-resident storage dtype of DENSE feature matrices. The dense
+    # D=1M step is HBM-bound on the feature stream (benchmarks/ROOFLINE.md):
+    # "bfloat16" halves the bytes, "int8" quarters them (symmetric
+    # per-dataset quantization; the scale folds into the model as
+    # feature_scale, measured +11% step rate here and 2x the max resident
+    # dataset).  Dense models only; sparse vals stay float32.
+    feature_dtype: str = "float32"    # float32 | bfloat16 | int8
 
     # ---- parity / compat with reference quirks (SURVEY.md §3.5) ----
     # "reference" reproduces documented quirks (Q1 last-gradient sync update,
@@ -137,6 +144,10 @@ class Config:
             raise ValueError("num_feature_dim must be positive")
         if self.batch_size == 0 or self.batch_size < -1:
             raise ValueError("batch_size must be -1 (full shard) or positive")
+        if self.feature_dtype not in ("float32", "bfloat16", "int8"):
+            raise ValueError(
+                f"feature_dtype must be float32|bfloat16|int8, got {self.feature_dtype!r}"
+            )
         if self.ps_compute_backend not in ("auto", "cpu", "default"):
             raise ValueError(
                 f"ps_compute_backend must be auto|cpu|default, got {self.ps_compute_backend!r}"

@@ -45,6 +45,11 @@ def _add_config_flags(p: argparse.ArgumentParser) -> None:
     p.add_argument("--nnz-max", dest="nnz_max", type=int,
                    help="sparse_lr: cap per-row nonzeros (pad width)")
     p.add_argument("--compat-mode", dest="compat_mode", choices=["correct", "reference"])
+    p.add_argument("--feature-dtype", dest="feature_dtype",
+                   choices=["float32", "bfloat16", "int8"],
+                   help="device-resident storage dtype for dense features "
+                   "(int8: symmetric per-dataset quantization; halves/quarters "
+                   "the HBM stream the dense step is bound by)")
     p.add_argument("--checkpoint-dir", dest="checkpoint_dir")
     p.add_argument("--checkpoint-interval", dest="checkpoint_interval", type=int)
     p.add_argument("--profile-dir", dest="profile_dir")
@@ -83,6 +88,7 @@ def _config_from_args(args: argparse.Namespace) -> Config:
             "learning_rate", "l2_c", "test_interval", "model", "num_classes",
             "nnz_max", "compat_mode", "checkpoint_dir", "checkpoint_interval",
             "profile_dir", "num_workers", "num_servers", "ps_compute_backend",
+            "feature_dtype",
         }
     }
     cfg = Config.from_env(**overrides)

@@ -54,6 +54,12 @@ class BinaryLR:
     num_features: int
     # MXU-friendly matmul dtype; set "float32" for bit-level parity runs.
     compute_dtype: str = "bfloat16"
+    # Dequantization scale for reduced-precision feature storage
+    # (cfg.feature_dtype="int8": X is stored as round(X/scale) and the
+    # true logit is (Xq @ w) * scale).  Static so XLA folds the convert
+    # into the matmul read; applied to the (B,)/(D,) RESULT vectors, not
+    # the (B, D) matrix.  1.0 = features are already real-valued.
+    feature_scale: float = 1.0
 
     def init(self, cfg: Config) -> jnp.ndarray:
         if cfg.reference_rng_init:
@@ -65,11 +71,12 @@ class BinaryLR:
 
     def logits(self, w, X):
         cdt = jnp.dtype(self.compute_dtype)
-        return jnp.dot(
+        z = jnp.dot(
             X.astype(cdt),
             w.astype(cdt),
             preferred_element_type=jnp.float32,
         )
+        return z * self.feature_scale if self.feature_scale != 1.0 else z
 
     def loss(self, w, batch, cfg: Config):
         X, y, mask = batch
@@ -95,6 +102,8 @@ class BinaryLR:
             )
             / n
         )
+        if self.feature_scale != 1.0:
+            g = g * self.feature_scale
         return g + _l2_grad(w, cfg, n)
 
     def predict(self, w, X):
@@ -114,6 +123,7 @@ class SoftmaxRegression:
     num_features: int
     num_classes: int
     compute_dtype: str = "bfloat16"
+    feature_scale: float = 1.0  # see BinaryLR.feature_scale
 
     def init(self, cfg: Config) -> jnp.ndarray:
         shape = (self.num_features, self.num_classes)
@@ -125,11 +135,12 @@ class SoftmaxRegression:
 
     def logits(self, W, X):
         cdt = jnp.dtype(self.compute_dtype)
-        return jnp.dot(
+        z = jnp.dot(
             X.astype(cdt),
             W.astype(cdt),
             preferred_element_type=jnp.float32,
         )
+        return z * self.feature_scale if self.feature_scale != 1.0 else z
 
     def loss(self, W, batch, cfg: Config):
         X, y, mask = batch
@@ -156,6 +167,8 @@ class SoftmaxRegression:
             )
             / n
         )
+        if self.feature_scale != 1.0:
+            g = g * self.feature_scale
         return g + _l2_grad(W, cfg, n)
 
     def predict(self, W, X):

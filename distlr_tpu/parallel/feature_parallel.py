@@ -55,6 +55,8 @@ def _local_forward(model, w_shard, X_shard):
     z_partial = jnp.dot(
         X_shard.astype(cdt), w_shard.astype(cdt), preferred_element_type=jnp.float32
     )
+    if model.feature_scale != 1.0:  # int8-quantized features (BinaryLR doc)
+        z_partial = z_partial * model.feature_scale
     return lax.psum(z_partial, MODEL_AXIS)
 
 
@@ -83,6 +85,8 @@ def make_feature_sharded_train_step(model, cfg: Config, mesh: Mesh, *, with_metr
             resid = (jax.nn.sigmoid(z) - y.astype(jnp.float32)) * mask
             g = jnp.dot(resid.astype(cdt), X.astype(cdt), preferred_element_type=jnp.float32) / n
             ll = jax.nn.softplus(z) - y.astype(jnp.float32) * z
+        if model.feature_scale != 1.0:  # d/dw of (X*scale) @ w
+            g = g * model.feature_scale
         # L2 on the local shard (gradient of 0.5*C*|w|^2 is shard-local)
         l2 = cfg.l2_c * w
         if cfg.l2_scale_by_batch:

@@ -191,6 +191,16 @@ class PSWorker:
         self.cfg = cfg
         self.rank = rank
         self.model = get_model(cfg)
+        if cfg.feature_dtype != "float32":
+            # PS workers stream numpy batches from host RAM per step —
+            # there is no resident device feature matrix whose HBM
+            # footprint quantization would shrink. Reject rather than
+            # silently ignore the documented +11%/2x expectation.
+            raise ValueError(
+                "feature_dtype quantization applies to the sync SPMD "
+                "trainer's device-resident features; PS mode streams "
+                "host batches (set feature_dtype='float32')"
+            )
         if cfg.model == "sparse_lr" and cfg.sync_last_gradient:
             # Q1 is a dense-reference parity quirk; with keyed pushes
             # "the last worker's gradient" touches an arbitrary key

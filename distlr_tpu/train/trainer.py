@@ -19,6 +19,7 @@ worker's full shard, exactly one reference "iteration".
 from __future__ import annotations
 
 import contextlib
+import dataclasses
 import os
 
 import jax
@@ -214,6 +215,17 @@ class Trainer:
             raise NotImplementedError(
                 "sparse_lr supports data-parallel meshes only (no 'model' axis)"
             )
+        self._build_steps()
+        self.timer = StepTimer()
+        self.weights = None
+        self._train_data: GlobalShardedData | None = None
+        self._test_data: GlobalShardedData | None = None
+
+    def _build_steps(self) -> None:
+        """(Re)compile the train/eval step closures over the current
+        model — called again when load-time feature quantization bakes a
+        dequantization scale into the model."""
+        cfg = self.cfg
         if self.feature_sharded:
             from distlr_tpu.parallel.feature_parallel import (  # noqa: PLC0415
                 make_feature_sharded_eval_step,
@@ -233,10 +245,33 @@ class Trainer:
             self._shard_weights = lambda w: jax.device_put(
                 w, jax.sharding.NamedSharding(self.mesh, jax.sharding.PartitionSpec())
             )
-        self.timer = StepTimer()
-        self.weights = None
-        self._train_data: GlobalShardedData | None = None
-        self._test_data: GlobalShardedData | None = None
+
+    def _quantize_features(self) -> None:
+        """Convert loaded dense feature storage to ``cfg.feature_dtype``.
+
+        int8: symmetric per-dataset quantization — one scale from the
+        train split's max |x| (the test split reuses it, clipped), folded
+        into the model as ``feature_scale`` so the jitted steps dequantize
+        on the fly (XLA fuses the convert into the matmul read).
+        """
+        fd = self.cfg.feature_dtype
+        datasets = [d for d in (self._train_data, self._test_data) if d is not None]
+        if fd == "bfloat16":
+            import ml_dtypes  # noqa: PLC0415  (ships with jax)
+
+            for d in datasets:
+                d._feats[0] = d._feats[0].astype(ml_dtypes.bfloat16)
+            return
+        X = self._train_data._feats[0]
+        scale = float(np.abs(X).max()) / 127.0
+        if scale == 0.0:  # all-zero features: nothing to represent
+            scale = 1.0
+        for d in datasets:
+            d._feats[0] = np.clip(
+                np.rint(d._feats[0] / scale), -127, 127
+            ).astype(np.int8)
+        self.model = dataclasses.replace(self.model, feature_scale=scale)
+        self._build_steps()
 
     # -- data ---------------------------------------------------------------
     def load_data(self, train: GlobalShardedData | None = None, test: GlobalShardedData | None = None):
@@ -251,6 +286,8 @@ class Trainer:
             self.cfg.data_dir, "test", W, self.cfg.num_feature_dim,
             multiclass=multiclass, sparse=sparse, nnz_max=self.cfg.nnz_max,
         )
+        if self.cfg.feature_dtype != "float32" and not sparse:
+            self._quantize_features()
         return self
 
     # -- training -----------------------------------------------------------

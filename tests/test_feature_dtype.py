@@ -1,0 +1,122 @@
+"""Reduced-precision dense feature storage (cfg.feature_dtype).
+
+The dense D=1M step is HBM-bound on the feature stream
+(benchmarks/ROOFLINE.md): bfloat16 halves the bytes, int8 quarters them
+via symmetric per-dataset quantization with the scale folded into the
+model (``feature_scale``).  These tests pin the numerics.
+"""
+
+import numpy as np
+import pytest
+
+from distlr_tpu.config import Config
+from distlr_tpu.data.synthetic import write_synthetic_shards
+from distlr_tpu.models import BinaryLR
+from distlr_tpu.train import Trainer
+
+
+@pytest.fixture(scope="module")
+def data_dir(tmp_path_factory):
+    d = tmp_path_factory.mktemp("fd")
+    write_synthetic_shards(str(d), 2000, 32, num_parts=1, seed=11, sparsity=0.0)
+    return str(d)
+
+
+def _fit(data_dir, **kw):
+    cfg = Config(
+        data_dir=data_dir, num_feature_dim=32, num_iteration=40,
+        learning_rate=0.5, l2_c=0.0, test_interval=0, batch_size=-1, **kw,
+    )
+    tr = Trainer(cfg).load_data()
+    tr.fit()
+    return tr
+
+
+class TestFeatureScaleModel:
+    def test_scaled_logits_match_float(self):
+        rng = np.random.default_rng(0)
+        X = rng.standard_normal((64, 16)).astype(np.float32)
+        w = rng.standard_normal(16).astype(np.float32)
+        scale = float(np.abs(X).max()) / 127.0
+        Xq = np.clip(np.rint(X / scale), -127, 127).astype(np.int8)
+
+        exact = BinaryLR(16, compute_dtype="float32")
+        quant = BinaryLR(16, compute_dtype="float32", feature_scale=scale)
+        z_f = np.asarray(exact.logits(w, X))
+        z_q = np.asarray(quant.logits(w, Xq))
+        # quantization error bound: ~||w||_1 * scale/2 per logit
+        assert np.max(np.abs(z_f - z_q)) < np.abs(w).sum() * scale
+
+    def test_scaled_grad_matches_float(self):
+        rng = np.random.default_rng(1)
+        X = rng.standard_normal((64, 16)).astype(np.float32)
+        y = rng.integers(0, 2, 64).astype(np.int32)
+        mask = np.ones(64, np.float32)
+        w = 0.1 * rng.standard_normal(16).astype(np.float32)
+        scale = float(np.abs(X).max()) / 127.0
+        Xq = np.clip(np.rint(X / scale), -127, 127).astype(np.int8)
+        cfg = Config(num_feature_dim=16, l2_c=0.0)
+
+        exact = BinaryLR(16, compute_dtype="float32")
+        quant = BinaryLR(16, compute_dtype="float32", feature_scale=scale)
+        g_f = np.asarray(exact.grad(w, (X, y, mask), cfg))
+        g_q = np.asarray(quant.grad(w, (Xq, y, mask), cfg))
+        np.testing.assert_allclose(g_f, g_q, atol=5e-2)
+
+
+class TestTrainerQuantized:
+    def test_int8_accuracy_tracks_float32(self, data_dir):
+        acc_f = _fit(data_dir).evaluate()
+        tr_q = _fit(data_dir, feature_dtype="int8")
+        assert tr_q.model.feature_scale != 1.0
+        assert tr_q._train_data._feats[0].dtype == np.int8
+        acc_q = tr_q.evaluate()
+        assert abs(acc_f - acc_q) < 0.02, (acc_f, acc_q)
+
+    def test_bfloat16_storage(self, data_dir):
+        tr = _fit(data_dir, feature_dtype="bfloat16")
+        assert tr._train_data._feats[0].dtype.name == "bfloat16"
+        assert tr.model.feature_scale == 1.0
+        assert tr.evaluate() > 0.7
+
+    def test_sparse_ignores_feature_dtype(self, tmp_path):
+        from distlr_tpu.data.hashing import write_ctr_shards
+
+        d = str(tmp_path / "ctr")
+        write_ctr_shards(d, 400, 6, 100, 64, num_parts=1, seed=1)
+        cfg = Config(
+            data_dir=d, num_feature_dim=64, model="sparse_lr",
+            feature_dtype="int8", num_iteration=5, test_interval=0,
+            l2_c=0.0, batch_size=-1,
+        )
+        tr = Trainer(cfg).load_data()  # must not quantize COO vals
+        assert tr._train_data._feats[1].dtype == np.float32
+
+    def test_invalid_dtype_rejected(self):
+        with pytest.raises(ValueError, match="feature_dtype"):
+            Config(feature_dtype="fp8")
+
+    def test_int8_feature_sharded_tracks_float32(self, data_dir):
+        """The 2D data x model path must dequantize too (its local
+        matvecs bypass model.logits/grad)."""
+        from distlr_tpu.parallel import make_mesh
+
+        mesh = make_mesh({"data": 2, "model": 2})
+        accs = {}
+        for fd in ("float32", "int8"):
+            cfg = Config(
+                data_dir=data_dir, num_feature_dim=32, num_iteration=40,
+                learning_rate=0.5, l2_c=0.0, test_interval=0, batch_size=-1,
+                feature_dtype=fd, feature_shards=2,
+            )
+            tr = Trainer(cfg, mesh=mesh).load_data()
+            tr.fit()
+            accs[fd] = tr.evaluate()
+        assert abs(accs["float32"] - accs["int8"]) < 0.02, accs
+
+    def test_ps_mode_rejects_quantization(self, data_dir):
+        from distlr_tpu.train.ps_trainer import PSWorker
+
+        cfg = Config(data_dir=data_dir, num_feature_dim=32, feature_dtype="int8")
+        with pytest.raises(ValueError, match="feature_dtype"):
+            PSWorker(cfg, 0, "127.0.0.1:1")
