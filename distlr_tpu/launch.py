@@ -129,17 +129,32 @@ def _maybe_init_distributed(args: argparse.Namespace) -> None:
 
 
 def cmd_gen_data(args: argparse.Namespace) -> int:
-    from distlr_tpu.data.synthetic import write_synthetic_shards  # noqa: PLC0415
+    if args.ctr_fields:
+        # Hashed one-hot CTR shards (sparse_lr workloads): num-feature-dim
+        # is the bucket count, --ctr-vocab the raw categorical vocabulary.
+        from distlr_tpu.data.hashing import write_ctr_shards  # noqa: PLC0415
 
-    manifest = write_synthetic_shards(
-        args.data_dir,
-        args.num_samples,
-        args.num_feature_dim,
-        args.num_parts,
-        seed=args.seed,
-        num_classes=args.num_classes,
-        sparsity=args.sparsity,
-    )
+        manifest = write_ctr_shards(
+            args.data_dir,
+            args.num_samples,
+            args.ctr_fields,
+            args.ctr_vocab,
+            args.num_feature_dim,
+            args.num_parts,
+            seed=args.seed,
+        )
+    else:
+        from distlr_tpu.data.synthetic import write_synthetic_shards  # noqa: PLC0415
+
+        manifest = write_synthetic_shards(
+            args.data_dir,
+            args.num_samples,
+            args.num_feature_dim,
+            args.num_parts,
+            seed=args.seed,
+            num_classes=args.num_classes,
+            sparsity=args.sparsity,
+        )
     log.info("wrote %d train shards + test to %s", len(manifest["train_parts"]), args.data_dir)
     return 0
 
@@ -238,6 +253,12 @@ def main(argv=None) -> int:
     g.add_argument("--seed", type=int, default=0)
     g.add_argument("--num-classes", type=int, default=2)
     g.add_argument("--sparsity", type=float, default=0.5)
+    g.add_argument("--ctr-fields", type=int, default=0,
+                   help="if >0: write hashed one-hot CTR shards with this "
+                   "many categorical fields (sparse_lr workloads; "
+                   "--num-feature-dim becomes the bucket count)")
+    g.add_argument("--ctr-vocab", type=int, default=100_000,
+                   help="raw categorical vocabulary size for --ctr-fields")
     g.set_defaults(fn=cmd_gen_data)
 
     s = sub.add_parser("sync", help="synchronous SPMD training (one process)")

@@ -126,9 +126,13 @@ def make_ring_train_step(model, cfg: Config, mesh: Mesh, *, with_metrics: bool =
         z_partial = jnp.dot(
             X.astype(cdt), w.astype(cdt), preferred_element_type=jnp.float32
         )
+        if model.feature_scale != 1.0:  # int8-quantized X (BinaryLR doc)
+            z_partial = z_partial * model.feature_scale
         z = ring_psum(z_partial, MODEL_AXIS)
         resid = (jax.nn.sigmoid(z) - y.astype(jnp.float32)) * mask
         g = jnp.dot(resid.astype(cdt), X.astype(cdt), preferred_element_type=jnp.float32) / n
+        if model.feature_scale != 1.0:  # d/dw of (X*scale) @ w
+            g = g * model.feature_scale
         l2 = cfg.l2_c * w
         if cfg.l2_scale_by_batch:
             l2 = l2 / n

@@ -277,6 +277,10 @@ class PSWorker:
             ckpt = Checkpointer(cfg.checkpoint_dir)
 
         with contextlib.ExitStack() as stack:
+            # §5.1 tracing hook, PS flavor: rank 0's worker loop (jit
+            # steps + KV round trips) lands in a jax.profiler trace.
+            if self.rank == 0 and cfg.profile_dir:
+                stack.enter_context(jax.profiler.trace(cfg.profile_dir))
             if ckpt is not None:
                 stack.callback(ckpt.close)
             return self._run_epochs(

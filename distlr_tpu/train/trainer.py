@@ -256,11 +256,29 @@ class Trainer:
         """
         fd = self.cfg.feature_dtype
         datasets = [d for d in (self._train_data, self._test_data) if d is not None]
+        # Datasets can be shared across Trainers (load_data(train=...)),
+        # so quantization is recorded on the object: a matching second
+        # Trainer reuses the stored scale instead of re-quantizing
+        # already-quantized ints (which would silently compute scale=1),
+        # and a mismatched one fails loudly.
+        done = {getattr(d, "_quant_dtype", None) for d in datasets}
+        if done != {None}:
+            if done != {fd}:
+                raise ValueError(
+                    f"dataset was already quantized as {done - {fd, None}} "
+                    f"by another Trainer; this one wants {fd!r}"
+                )
+            scale = self._train_data._quant_scale
+            if scale != 1.0:
+                self.model = dataclasses.replace(self.model, feature_scale=scale)
+                self._build_steps()
+            return
         if fd == "bfloat16":
             import ml_dtypes  # noqa: PLC0415  (ships with jax)
 
             for d in datasets:
                 d._feats[0] = d._feats[0].astype(ml_dtypes.bfloat16)
+                d._quant_dtype, d._quant_scale = fd, 1.0
             return
         X = self._train_data._feats[0]
         scale = float(np.abs(X).max()) / 127.0
@@ -270,6 +288,7 @@ class Trainer:
             d._feats[0] = np.clip(
                 np.rint(d._feats[0] / scale), -127, 127
             ).astype(np.int8)
+            d._quant_dtype, d._quant_scale = fd, scale
         self.model = dataclasses.replace(self.model, feature_scale=scale)
         self._build_steps()
 
@@ -288,6 +307,15 @@ class Trainer:
         )
         if self.cfg.feature_dtype != "float32" and not sparse:
             self._quantize_features()
+        elif any(
+            getattr(d, "_quant_dtype", None)
+            for d in (self._train_data, self._test_data)
+        ):
+            raise ValueError(
+                "dataset was quantized by a previous Trainer; a "
+                "feature_dtype='float32' run would train on raw quantized "
+                "ints — reload the data or match feature_dtype"
+            )
         return self
 
     # -- training -----------------------------------------------------------

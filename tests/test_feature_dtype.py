@@ -114,6 +114,52 @@ class TestTrainerQuantized:
             accs[fd] = tr.evaluate()
         assert abs(accs["float32"] - accs["int8"]) < 0.02, accs
 
+    def test_int8_ring_step_tracks_float32(self, data_dir):
+        """The explicit-ring feature-sharded step must dequantize too."""
+        import jax
+
+        from distlr_tpu.parallel import make_mesh
+        from distlr_tpu.parallel.ring import make_ring_train_step
+        from distlr_tpu.train.trainer import GlobalShardedData
+
+        mesh = make_mesh({"data": 2, "model": 2})
+        cfg = Config(
+            data_dir=data_dir, num_feature_dim=32, learning_rate=0.5,
+            l2_c=0.0, feature_dtype="int8", feature_shards=2,
+        )
+        tr = Trainer(cfg, mesh=mesh).load_data()
+        tr.init_weights()
+        batch = tr._shard_batch(tr._train_data.full_batch())
+        # both steps donate their weights arg: give each its own copy
+        w0 = np.asarray(tr.weights)
+        w_ring, m_ring = make_ring_train_step(tr.model, cfg, mesh)(
+            tr._shard_weights(w0.copy()), batch
+        )
+        w_ref, m_ref = tr.train_step(tr._shard_weights(w0.copy()), batch)
+        np.testing.assert_allclose(
+            np.asarray(w_ring), np.asarray(w_ref), rtol=1e-4, atol=1e-5
+        )
+
+    def test_shared_dataset_across_trainers(self, data_dir):
+        """Quantization is recorded on the dataset: a second matching
+        Trainer reuses the scale; a float32 Trainer fails loudly."""
+        from distlr_tpu.train.trainer import GlobalShardedData
+
+        tr1 = _fit(data_dir, feature_dtype="int8")
+        train, test = tr1._train_data, tr1._test_data
+        cfg = Config(
+            data_dir=data_dir, num_feature_dim=32, num_iteration=5,
+            l2_c=0.0, test_interval=0, feature_dtype="int8",
+        )
+        tr2 = Trainer(cfg).load_data(train=train, test=test)
+        assert tr2.model.feature_scale == tr1.model.feature_scale != 1.0
+        assert train._feats[0].dtype == np.int8  # not re-quantized
+
+        with pytest.raises(ValueError, match="quantized by a previous"):
+            Trainer(cfg.replace(feature_dtype="float32")).load_data(
+                train=train, test=test
+            )
+
     def test_ps_mode_rejects_quantization(self, data_dir):
         from distlr_tpu.train.ps_trainer import PSWorker
 
