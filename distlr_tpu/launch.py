@@ -130,6 +130,11 @@ def _maybe_init_distributed(args: argparse.Namespace) -> None:
 
 def cmd_gen_data(args: argparse.Namespace) -> int:
     if args.ctr_fields:
+        if args.num_classes != 2 or args.sparsity != 0.5:
+            print("error: --num-classes/--sparsity do not apply to CTR shards "
+                  "(--ctr-fields writes binary-label hashed one-hot data)",
+                  file=sys.stderr)
+            return 2
         # Hashed one-hot CTR shards (sparse_lr workloads): num-feature-dim
         # is the bucket count, --ctr-vocab the raw categorical vocabulary.
         from distlr_tpu.data.hashing import write_ctr_shards  # noqa: PLC0415
@@ -190,13 +195,15 @@ def cmd_ps(args: argparse.Namespace) -> int:
             if args.worker_ranks
             else range(cfg.num_workers)
         )
-        run_ps_workers(cfg, args.hosts, ranks, save=True, resume=args.resume)
+        run_ps_workers(cfg, args.hosts, ranks, save=True, resume=args.resume,
+                       max_restarts=args.max_worker_restarts)
     else:
         if args.worker_ranks:
             print("error: --worker-ranks requires --hosts (local mode always "
                   "runs all ranks)", file=sys.stderr)
             return 2
-        run_ps_local(cfg, save=True, resume=args.resume)
+        run_ps_local(cfg, save=True, resume=args.resume,
+                     max_restarts=args.max_worker_restarts)
     return 0
 
 
@@ -273,6 +280,10 @@ def main(argv=None) -> int:
                    "host:port, rank order) instead of spawning local ones")
     p.add_argument("--worker-ranks", dest="worker_ranks",
                    help="with --hosts: this host's ranks, e.g. 0,1 (default: all)")
+    p.add_argument("--max-worker-restarts", dest="max_worker_restarts",
+                   type=int, default=0,
+                   help="async mode: restart a failed worker in place up to "
+                   "N times (sync recovery is --checkpoint-dir + --resume)")
     p.set_defaults(fn=cmd_ps)
 
     v = sub.add_parser("ps-server", help="host a KV server group (multi-host PS)")

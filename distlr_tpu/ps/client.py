@@ -44,8 +44,10 @@ def _load():
         lib.kv_push.argtypes = [ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p, ctypes.c_uint64]
         lib.kv_pull.restype = ctypes.c_int
         lib.kv_pull.argtypes = [ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p, ctypes.c_uint64]
+        lib.kv_push_init.restype = ctypes.c_int
+        lib.kv_push_init.argtypes = [ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p, ctypes.c_uint64]
         lib.kv_barrier.restype = ctypes.c_int
-        lib.kv_barrier.argtypes = [ctypes.c_void_p]
+        lib.kv_barrier.argtypes = [ctypes.c_void_p, ctypes.c_uint32]
         lib.kv_wait.restype = ctypes.c_int
         lib.kv_wait.argtypes = [ctypes.c_void_p, ctypes.c_int]
         lib.kv_shutdown_servers.restype = ctypes.c_int
@@ -131,6 +133,22 @@ class KVWorker:
         )
         return self._check(ts, "push")
 
+    def push_init(self, vals: np.ndarray, keys: np.ndarray | None = None) -> int:
+        """Idempotent weight-seeding push: initializes an uninitialized
+        server group, no-ops otherwise (kInitPush) — safe for a restarted
+        worker to re-send, unlike a plain first push."""
+        vals = np.ascontiguousarray(vals, dtype=np.float32)
+        keys = self._all_keys if keys is None else self._validate_keys(keys)
+        if vals.shape[0] != keys.shape[0]:
+            raise ValueError(f"{vals.shape[0]} vals vs {keys.shape[0]} keys")
+        ts = self._lib.kv_push_init(
+            self._h,
+            keys.ctypes.data_as(ctypes.c_void_p),
+            vals.ctypes.data_as(ctypes.c_void_p),
+            keys.shape[0],
+        )
+        return self._check(ts, "push_init")
+
     def pull(self, keys: np.ndarray | None = None) -> np.ndarray:
         keys = self._all_keys if keys is None else self._validate_keys(keys)
         out = np.empty(keys.shape[0], dtype=np.float32)
@@ -148,10 +166,12 @@ class KVWorker:
         pairs every Push/Pull with an immediate Wait)."""
         self._lib.kv_wait(self._h, ts)
 
-    def barrier(self) -> None:
+    def barrier(self, barrier_id: int = 0) -> None:
         """Worker-group barrier via server 0 (Postoffice::Barrier
-        equivalent, reference src/main.cc:150)."""
-        self._check(self._lib.kv_barrier(self._h), "barrier")
+        equivalent, reference src/main.cc:150).  ``barrier_id`` is the
+        generation: a late vote for an already-released generation
+        returns immediately (restart safety — kv_protocol.h)."""
+        self._check(self._lib.kv_barrier(self._h, barrier_id), "barrier")
 
     def stats(self, server: int = 0) -> dict:
         """Health/progress counters of one server (never deferred, so it

@@ -119,7 +119,8 @@ std::vector<std::pair<uint64_t, uint64_t>> SliceByRange(
 }
 
 int RoundTrip(Client* c, Op op, const Key* keys, const float* vals,
-              float* out_vals, uint64_t n) {
+              float* out_vals, uint64_t n, uint8_t flags = kNone,
+              uint16_t barrier_id = 0) {
   c->timed_out = false;
   if (c->poisoned) {
     snprintf(c->err, sizeof(c->err),
@@ -145,7 +146,7 @@ int RoundTrip(Client* c, Op op, const Key* keys, const float* vals,
   for (size_t s = 0; s < c->servers.size(); ++s) {
     const auto [b, e] = slices[s];
     if (b == e && !visit_all && !(op == Op::kBarrier && s == 0)) continue;
-    MsgHeader h{kMagic, static_cast<uint8_t>(op), kNone, 0,
+    MsgHeader h{kMagic, static_cast<uint8_t>(op), flags, barrier_id,
                 c->client_id, ts, e - b};
     auto& lk = local_keys[s];
     lk.resize(e - b);
@@ -258,6 +259,16 @@ int kv_push(void* handle, const uint64_t* keys, const float* vals, uint64_t n) {
   return distlr::RoundTrip(c, distlr::Op::kPush, keys, vals, nullptr, n);
 }
 
+// Idempotent weight-seeding push (kInitPush, kv_protocol.h): seeds only
+// an uninitialized server group, no-ops otherwise — safe for a restarted
+// worker to re-send.
+int kv_push_init(void* handle, const uint64_t* keys, const float* vals,
+                 uint64_t n) {
+  auto* c = static_cast<distlr::Client*>(handle);
+  return distlr::RoundTrip(c, distlr::Op::kPush, keys, vals, nullptr, n,
+                           distlr::kInitPush);
+}
+
 int kv_pull(void* handle, const uint64_t* keys, float* out_vals, uint64_t n) {
   auto* c = static_cast<distlr::Client*>(handle);
   return distlr::RoundTrip(c, distlr::Op::kPull, keys, nullptr, out_vals, n);
@@ -354,9 +365,13 @@ int kv_stats(void* handle, uint32_t server, double* out, uint64_t n) {
 }
 
 // Group barrier via server 0 (Postoffice::Barrier equivalent).
-int kv_barrier(void* handle) {
+// barrier_id is the generation (kv_protocol.h): late votes for an
+// already-released generation return immediately.
+int kv_barrier(void* handle, uint32_t barrier_id) {
   auto* c = static_cast<distlr::Client*>(handle);
-  return distlr::RoundTrip(c, distlr::Op::kBarrier, nullptr, nullptr, nullptr, 0);
+  return distlr::RoundTrip(c, distlr::Op::kBarrier, nullptr, nullptr, nullptr,
+                           0, distlr::kNone,
+                           static_cast<uint16_t>(barrier_id));
 }
 
 // No-op: kv_push/kv_pull already block until completion (see header

@@ -56,6 +56,12 @@ enum Flags : uint8_t {
   kNone = 0,
   kResponse = 1,
   kError = 2,
+  // PUSH that seeds the weights IF the server is uninitialized and is a
+  // no-op otherwise (always replied immediately, never counted toward
+  // the sync merge).  Idempotent by design: a restarted worker re-sends
+  // its init without corrupting state — without the flag, a re-sent
+  // init lands in the async path as a bogus gradient.
+  kInitPush = 4,
 };
 
 #pragma pack(push, 1)
@@ -63,6 +69,11 @@ struct MsgHeader {
   uint32_t magic;
   uint8_t op;
   uint8_t flags;
+  // For Op::kBarrier: the barrier GENERATION id.  Barriers are counted
+  // per id, and an id that has already released replies instantly to
+  // late votes — so a restarted worker re-voting the startup barrier
+  // (id 0) can never pair with peers' exit-barrier votes (id 1), and
+  // never hangs regardless of when its predecessor crashed.
   uint16_t reserved;
   uint32_t client_id;
   uint32_t timestamp;   // per-client op sequence number (ps-lite ts)

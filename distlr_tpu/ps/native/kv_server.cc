@@ -51,6 +51,7 @@
 #include <mutex>
 #include <string>
 #include <csignal>
+#include <set>
 #include <thread>
 #include <unordered_map>
 #include <vector>
@@ -223,6 +224,19 @@ class KVServer {
     ++n_push_;
     if (!keys.empty()) EnsureCapacity(keys.back());
 
+    if (h.flags & kInitPush) {
+      // Idempotent init (kv_protocol.h): seeds only an uninitialized
+      // server, replies immediately either way, never joins the sync
+      // merge — a restarted worker can re-send it safely.
+      if (!initialized_ && !keys.empty()) {
+        for (size_t i = 0; i < keys.size(); ++i) weights_[keys[i]] = vals[i];
+        initialized_ = true;
+      }
+      lock.unlock();
+      Respond(fd, h, nullptr, 0);
+      return;
+    }
+
     if (!initialized_ && !keys.empty()) {
       // First non-empty push seeds the weights (src/main.cc:50-56).  An
       // EMPTY push (a sparse worker's "present" vote for a range it did
@@ -295,9 +309,11 @@ class KVServer {
         ++it;
       }
     }
-    for (auto it = barrier_.begin(); it != barrier_.end();) {
-      if (it->fd == fd) it = barrier_.erase(it);
-      else ++it;
+    for (auto& [id, waiters] : barrier_) {
+      for (auto it = waiters.begin(); it != waiters.end();) {
+        if (it->fd == fd) it = waiters.erase(it);
+        else ++it;
+      }
     }
   }
 
@@ -325,7 +341,9 @@ class KVServer {
       stats[0] = static_cast<double>(weights_.size());
       stats[1] = initialized_ ? 1.0 : 0.0;
       stats[2] = static_cast<double>(pending_.size());
-      stats[3] = static_cast<double>(barrier_.size());
+      size_t waiters = 0;
+      for (auto& [id, w] : barrier_) waiters += w.size();
+      stats[3] = static_cast<double>(waiters);
       stats[4] = static_cast<double>(n_push_);
       stats[5] = static_cast<double>(n_pull_);
     }
@@ -334,13 +352,25 @@ class KVServer {
     Respond(fd, h, out, 2 * kStatsVals);
   }
 
-  // --- BARRIER: Postoffice::Barrier equivalent (src/main.cc:150) ---
+  // --- BARRIER: Postoffice::Barrier equivalent (src/main.cc:150),
+  // counted per GENERATION id (h.reserved; see kv_protocol.h).  A vote
+  // for an id that already released replies instantly, so restarted
+  // workers re-voting an old generation neither hang nor contaminate a
+  // later barrier's count. ---
   void HandleBarrier(int fd, const MsgHeader& h) {
     std::lock_guard<std::mutex> lock(mu_);
-    barrier_.push_back({fd, h, {}, {}});
-    if (static_cast<int>(barrier_.size()) < num_workers_) return;
+    const uint16_t id = h.reserved;
+    if (released_barriers_.count(id)) {
+      Respond(fd, h, nullptr, 0);
+      return;
+    }
+    auto& waiters = barrier_[id];
+    waiters.push_back({fd, h, {}, {}});
+    if (static_cast<int>(waiters.size()) < num_workers_) return;
     std::vector<PendingPush> release;
-    release.swap(barrier_);
+    release.swap(waiters);
+    barrier_.erase(id);
+    released_barriers_.insert(id);
     // Replies written under mu_ — see HandlePush's release loop: the
     // exit-barrier reply to rank 0 triggers its kShutdown, whose
     // connection-severing loop takes mu_ and must not interleave here
@@ -365,7 +395,8 @@ class KVServer {
   std::vector<Val> weights_;
   std::vector<Val> merge_;
   std::vector<PendingPush> pending_;
-  std::vector<PendingPush> barrier_;
+  std::unordered_map<uint16_t, std::vector<PendingPush>> barrier_;
+  std::set<uint16_t> released_barriers_;
 };
 
 }  // namespace distlr

@@ -262,13 +262,19 @@ class PSWorker:
             start_epoch, restored = _ps_resume_state(cfg, self.rank)
 
         # Identical deterministic init on every worker (Q2); only rank 0
-        # pushes — the server's first-push branch stores it verbatim.
-        # On resume, the restored weights take the init push's place.
+        # pushes — via the IDEMPOTENT init op, so a restarted rank 0
+        # re-sending it cannot corrupt live weights (a plain re-push
+        # would land in the async path as a bogus gradient).  On resume,
+        # the restored weights take the init push's place.  The startup
+        # barrier is generation 0; the exit barrier below is generation
+        # 1 — late re-votes of a released generation return immediately,
+        # so a restarted worker neither hangs here nor pairs with peers'
+        # exit votes.
         w0 = (restored if restored is not None
               else np.asarray(self.model.init(cfg)).reshape(-1))
         if self.rank == 0:
-            self.kv.wait(self.kv.push(w0))
-        self.kv.barrier()
+            self.kv.wait(self.kv.push_init(w0))
+        self.kv.barrier(0)
 
         ckpt = None
         if self.rank == 0 and cfg.checkpoint_dir:
@@ -370,11 +376,11 @@ class PSWorker:
             os.makedirs(os.path.dirname(path), exist_ok=True)
             save_model_text(path, self.final_weights)
         # ps::Finalize(do_barrier=true) parity (reference src/main.cc:179):
-        # a global exit barrier so no server retires while a peer still
-        # trains, then rank 0 retires the group — this is what lets
-        # foreground `launch ps-server` hosts exit when training is done
-        # (local mode: ServerGroup.stop() just finds the procs exited).
-        self.kv.barrier()
+        # a global exit barrier (generation 1) so no server retires while
+        # a peer still trains, then rank 0 retires the group — this is
+        # what lets foreground `launch ps-server` hosts exit when training
+        # is done (local mode: ServerGroup.stop() finds the procs exited).
+        self.kv.barrier(1)
         if self.rank == 0:
             self.kv.shutdown_servers()
         return self.final_weights
@@ -407,7 +413,7 @@ class PSWorker:
 
 
 def run_ps_workers(cfg: Config, hosts: str, ranks, *, eval_fn=None, save=False,
-                   on_error=None, resume=False):
+                   on_error=None, resume=False, max_restarts=0):
     """Run the given worker ranks (threads) against an EXISTING server
     group at ``hosts`` — the multi-host entry point: each host runs its
     subset of ranks against remote servers (started via
@@ -419,6 +425,15 @@ def run_ps_workers(cfg: Config, hosts: str, ranks, *, eval_fn=None, save=False,
     if any worker raises (local mode uses it to tear the servers down so
     peers blocked on the sync barrier fail fast instead of hanging).
     Returns ``{rank: final_weights}``.
+
+    ``max_restarts`` (async mode only): a failed worker is rebuilt on a
+    fresh connection and rejoins up to N times — Hogwild tolerates
+    arbitrary rejoin, and the server's disconnect rollback already
+    undid any half-round state.  Sync (BSP) runs keep fail-fast
+    semantics: rounds are counted per worker, so the recovery path for
+    sync is job-level ``checkpoint_dir`` + ``resume``, not in-place
+    restart.  The reference has neither path (SURVEY.md §5.3: its only
+    outcome is an eternal deadlock).
     """
     ranks = list(ranks)
     results: dict[int, np.ndarray | None] = {r: None for r in ranks}
@@ -426,16 +441,32 @@ def run_ps_workers(cfg: Config, hosts: str, ranks, *, eval_fn=None, save=False,
     workers = [PSWorker(cfg, r, hosts) for r in ranks]
 
     def run_one(i, r):
-        try:
-            results[r] = workers[i].run(eval_fn=eval_fn if r == 0 else None,
-                                        save=save, resume=resume)
-        except Exception as e:  # surface worker failures to the caller
-            errors.append(e)
-            if on_error is not None:
-                # A dead worker would deadlock every peer blocked on the
-                # sync barrier (the reference's named straggler failure,
-                # SURVEY.md §5.3).
-                on_error()
+        attempts = 0
+        while True:
+            try:
+                results[r] = workers[i].run(eval_fn=eval_fn if r == 0 else None,
+                                            save=save, resume=resume)
+                return
+            except Exception as e:  # surface worker failures to the caller
+                workers[i].close()
+                attempts += 1
+                if cfg.sync_mode or attempts > max_restarts:
+                    errors.append(e)
+                    if on_error is not None:
+                        # A dead worker would deadlock every peer blocked
+                        # on the sync barrier (the reference's named
+                        # straggler failure, SURVEY.md §5.3).
+                        on_error()
+                    return
+                log.warning("worker %d failed (%s); restart %d/%d",
+                            r, e, attempts, max_restarts)
+                try:
+                    workers[i] = PSWorker(cfg, r, hosts)
+                except Exception as e2:  # servers gone too: give up
+                    errors.append(e2)
+                    if on_error is not None:
+                        on_error()
+                    return
 
     threads = [
         threading.Thread(target=run_one, args=(i, r), daemon=True)
@@ -458,7 +489,8 @@ def ps_param_dim(cfg: Config) -> int:
     return cfg.num_feature_dim * (cfg.num_classes if cfg.model == "softmax" else 1)
 
 
-def run_ps_local(cfg: Config, *, eval_fn=None, save=False, resume=False):
+def run_ps_local(cfg: Config, *, eval_fn=None, save=False, resume=False,
+                 max_restarts=0):
     """Single-host PS run: native server subprocesses + threaded workers.
 
     The local-mode successor of ``examples/local.sh`` for the PS path
@@ -478,5 +510,6 @@ def run_ps_local(cfg: Config, *, eval_fn=None, save=False, resume=False):
         results = run_ps_workers(
             cfg, group.hosts, range(cfg.num_workers),
             eval_fn=eval_fn, save=save, on_error=group.stop, resume=resume,
+            max_restarts=max_restarts,
         )
     return [results[r] for r in range(cfg.num_workers)]

@@ -257,34 +257,39 @@ class Trainer:
         fd = self.cfg.feature_dtype
         datasets = [d for d in (self._train_data, self._test_data) if d is not None]
         # Datasets can be shared across Trainers (load_data(train=...)),
-        # so quantization is recorded on the object: a matching second
-        # Trainer reuses the stored scale instead of re-quantizing
-        # already-quantized ints (which would silently compute scale=1),
-        # and a mismatched one fails loudly.
-        done = {getattr(d, "_quant_dtype", None) for d in datasets}
-        if done != {None}:
-            if done != {fd}:
-                raise ValueError(
-                    f"dataset was already quantized as {done - {fd, None}} "
-                    f"by another Trainer; this one wants {fd!r}"
-                )
-            scale = self._train_data._quant_scale
-            if scale != 1.0:
-                self.model = dataclasses.replace(self.model, feature_scale=scale)
-                self._build_steps()
-            return
+        # so quantization is recorded on the object: already-quantized
+        # datasets keep their stored scale (re-quantizing ints would
+        # silently compute scale=1), freshly loaded ones are quantized
+        # WITH that scale, and a dtype mismatch fails loudly.
+        prev = {d._quant_dtype for d in datasets if getattr(d, "_quant_dtype", None)}
+        if prev and prev != {fd}:
+            raise ValueError(
+                f"dataset was already quantized as {sorted(prev)} by another "
+                f"Trainer; this one wants {fd!r}"
+            )
+        fresh = [d for d in datasets if getattr(d, "_quant_dtype", None) is None]
         if fd == "bfloat16":
             import ml_dtypes  # noqa: PLC0415  (ships with jax)
 
-            for d in datasets:
+            for d in fresh:
                 d._feats[0] = d._feats[0].astype(ml_dtypes.bfloat16)
                 d._quant_dtype, d._quant_scale = fd, 1.0
             return
-        X = self._train_data._feats[0]
-        scale = float(np.abs(X).max()) / 127.0
-        if scale == 0.0:  # all-zero features: nothing to represent
-            scale = 1.0
-        for d in datasets:
+        prev_scales = {
+            d._quant_scale for d in datasets if getattr(d, "_quant_dtype", None)
+        }
+        if len(prev_scales) > 1:
+            raise ValueError(
+                f"shared datasets carry inconsistent quantization scales {prev_scales}"
+            )
+        if prev_scales:
+            scale = prev_scales.pop()
+        else:
+            X = self._train_data._feats[0]
+            scale = float(np.abs(X).max()) / 127.0
+            if scale == 0.0:  # all-zero features: nothing to represent
+                scale = 1.0
+        for d in fresh:
             d._feats[0] = np.clip(
                 np.rint(d._feats[0] / scale), -127, 127
             ).astype(np.int8)

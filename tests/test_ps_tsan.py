@@ -53,12 +53,12 @@ def test_server_race_free_under_tsan(tmp_path, sync, monkeypatch):
         def run(rank: int):
             with KVWorker(group.hosts, dim, client_id=rank, timeout_ms=60_000) as kv:
                 if rank == 0:
-                    kv.wait(kv.push(np.zeros(dim, np.float32)))
-                kv.barrier()
+                    kv.wait(kv.push_init(np.zeros(dim, np.float32)))
+                kv.barrier(0)   # startup generation
                 for _ in range(steps):
                     w = kv.pull()
                     kv.wait(kv.push(w * 0.01 + 1.0))
-                kv.barrier()
+                kv.barrier(1)   # exit generation
                 if rank == 0:
                     # stats probe runs concurrently-shaped code paths too
                     kv.stats(0), kv.stats(1)
