@@ -101,13 +101,27 @@ def _config_from_args(args: argparse.Namespace) -> Config:
 
 
 def _maybe_force_cpu_devices(args: argparse.Namespace) -> None:
-    if getattr(args, "cpu_devices", None):
-        import os  # noqa: PLC0415
+    import os  # noqa: PLC0415
 
+    # DISTLR_CPU_DEVICES is the env twin of --cpu-devices, for wrappers
+    # that cannot pass flags (examples/local.sh).  Needed because some
+    # environments pre-import jax at interpreter start, so a plain
+    # JAX_PLATFORMS env var is silently overridden — only a
+    # jax.config.update after import wins.
+    n = getattr(args, "cpu_devices", None)
+    if n is None:  # flag (even an explicit 0) beats the env twin
+        raw = os.environ.get("DISTLR_CPU_DEVICES", "")
+        try:
+            n = int(raw) if raw else 0
+        except ValueError:
+            raise SystemExit(
+                f"DISTLR_CPU_DEVICES must be an integer, got {raw!r}"
+            ) from None
+    if n:
         flags = os.environ.get("XLA_FLAGS", "")
         if "xla_force_host_platform_device_count" not in flags:
             os.environ["XLA_FLAGS"] = (
-                flags + f" --xla_force_host_platform_device_count={args.cpu_devices}"
+                flags + f" --xla_force_host_platform_device_count={n}"
             ).strip()
         import jax  # noqa: PLC0415
 

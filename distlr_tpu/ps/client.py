@@ -45,7 +45,10 @@ def _load():
         lib.kv_pull.restype = ctypes.c_int
         lib.kv_pull.argtypes = [ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p, ctypes.c_uint64]
         lib.kv_push_init.restype = ctypes.c_int
-        lib.kv_push_init.argtypes = [ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p, ctypes.c_uint64]
+        lib.kv_push_init.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+            ctypes.c_uint64, ctypes.c_int,
+        ]
         lib.kv_barrier.restype = ctypes.c_int
         lib.kv_barrier.argtypes = [ctypes.c_void_p, ctypes.c_uint32]
         lib.kv_wait.restype = ctypes.c_int
@@ -133,10 +136,13 @@ class KVWorker:
         )
         return self._check(ts, "push")
 
-    def push_init(self, vals: np.ndarray, keys: np.ndarray | None = None) -> int:
+    def push_init(self, vals: np.ndarray, keys: np.ndarray | None = None,
+                  *, force: bool = False) -> int:
         """Idempotent weight-seeding push: initializes an uninitialized
         server group, no-ops otherwise (kInitPush) — safe for a restarted
-        worker to re-send, unlike a plain first push."""
+        worker to re-send, unlike a plain first push.  ``force=True``
+        overwrites live weights (kForceInit): checkpoint resume against a
+        surviving group; restarted workers must NOT use it."""
         vals = np.ascontiguousarray(vals, dtype=np.float32)
         keys = self._all_keys if keys is None else self._validate_keys(keys)
         if vals.shape[0] != keys.shape[0]:
@@ -146,6 +152,7 @@ class KVWorker:
             keys.ctypes.data_as(ctypes.c_void_p),
             vals.ctypes.data_as(ctypes.c_void_p),
             keys.shape[0],
+            1 if force else 0,
         )
         return self._check(ts, "push_init")
 
@@ -171,6 +178,10 @@ class KVWorker:
         equivalent, reference src/main.cc:150).  ``barrier_id`` is the
         generation: a late vote for an already-released generation
         returns immediately (restart safety — kv_protocol.h)."""
+        if not 0 <= barrier_id < (1 << 16):
+            # the wire field is u16; silent truncation could alias a
+            # released generation and turn a real barrier into a no-op
+            raise ValueError(f"barrier_id must fit in uint16, got {barrier_id}")
         self._check(self._lib.kv_barrier(self._h, barrier_id), "barrier")
 
     def stats(self, server: int = 0) -> dict:

@@ -261,12 +261,15 @@ int kv_push(void* handle, const uint64_t* keys, const float* vals, uint64_t n) {
 
 // Idempotent weight-seeding push (kInitPush, kv_protocol.h): seeds only
 // an uninitialized server group, no-ops otherwise — safe for a restarted
-// worker to re-send.
+// worker to re-send.  force != 0 adds kForceInit (overwrite live
+// weights; the checkpoint-resume path — see kv_protocol.h).
 int kv_push_init(void* handle, const uint64_t* keys, const float* vals,
-                 uint64_t n) {
+                 uint64_t n, int force) {
   auto* c = static_cast<distlr::Client*>(handle);
+  const uint8_t flags = force ? (distlr::kInitPush | distlr::kForceInit)
+                              : distlr::kInitPush;
   return distlr::RoundTrip(c, distlr::Op::kPush, keys, vals, nullptr, n,
-                           distlr::kInitPush);
+                           flags);
 }
 
 int kv_pull(void* handle, const uint64_t* keys, float* out_vals, uint64_t n) {

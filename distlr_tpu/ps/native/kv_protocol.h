@@ -62,6 +62,13 @@ enum Flags : uint8_t {
   // its init without corrupting state — without the flag, a re-sent
   // init lands in the async path as a bogus gradient.
   kInitPush = 4,
+  // With kInitPush: seed UNCONDITIONALLY, overwriting live weights.
+  // The checkpoint-resume path needs this against a surviving
+  // (already-initialized) server group — a plain init would no-op and
+  // training would silently resume from the servers' stale crash-time
+  // weights while the epoch counter says otherwise.  Restarted workers
+  // must NOT set it (they would roll peers back to the checkpoint).
+  kForceInit = 8,
 };
 
 #pragma pack(push, 1)

@@ -227,8 +227,9 @@ class KVServer {
     if (h.flags & kInitPush) {
       // Idempotent init (kv_protocol.h): seeds only an uninitialized
       // server, replies immediately either way, never joins the sync
-      // merge — a restarted worker can re-send it safely.
-      if (!initialized_ && !keys.empty()) {
+      // merge — a restarted worker can re-send it safely.  kForceInit
+      // (checkpoint resume against a surviving group) overwrites.
+      if ((!initialized_ || (h.flags & kForceInit)) && !keys.empty()) {
         for (size_t i = 0; i < keys.size(); ++i) weights_[keys[i]] = vals[i];
         initialized_ = true;
       }

@@ -249,7 +249,8 @@ class PSWorker:
         return DataIter.from_file(path, self.cfg.num_feature_dim, -1,
                                   multiclass=self.cfg.model == "softmax")
 
-    def run(self, *, eval_fn=None, save=True, resume=False) -> np.ndarray:
+    def run(self, *, eval_fn=None, save=True, resume=False,
+            rejoin=False) -> np.ndarray:
         cfg = self.cfg
         train = self._train_iter if self._train_iter is not None else self._load_train_iter()
         test = self._test_iter if self._test_iter is not None else (
@@ -273,7 +274,14 @@ class PSWorker:
         w0 = (restored if restored is not None
               else np.asarray(self.model.init(cfg)).reshape(-1))
         if self.rank == 0:
-            self.kv.wait(self.kv.push_init(w0))
+            # force on resume: against a SURVIVING (already-initialized)
+            # server group the restored checkpoint must overwrite the
+            # stale crash-time weights — a plain idempotent init would
+            # no-op and silently resume from the wrong state.  A
+            # restarted worker (rejoin) must NOT force: it would roll
+            # peers back to the checkpoint mid-run.
+            force = restored is not None and not rejoin
+            self.kv.wait(self.kv.push_init(w0, force=force))
         self.kv.barrier(0)
 
         ckpt = None
@@ -445,7 +453,8 @@ def run_ps_workers(cfg: Config, hosts: str, ranks, *, eval_fn=None, save=False,
         while True:
             try:
                 results[r] = workers[i].run(eval_fn=eval_fn if r == 0 else None,
-                                            save=save, resume=resume)
+                                            save=save, resume=resume,
+                                            rejoin=attempts > 0)
                 return
             except Exception as e:  # surface worker failures to the caller
                 workers[i].close()

@@ -10,6 +10,11 @@
 # Reference invocation for comparison: local.sh <S> <W> bin/distlr
 set -euo pipefail
 
+# Work from any cwd without installation: put the repo root (this
+# script's parent) on PYTHONPATH unless distlr_tpu is already importable.
+REPO_ROOT=$(cd "$(dirname "$0")/.." && pwd)
+export PYTHONPATH="$REPO_ROOT${PYTHONPATH:+:$PYTHONPATH}"
+
 NUM_SERVERS=${1:-1}
 NUM_WORKERS=${2:-4}
 MODE=${3:-sync}

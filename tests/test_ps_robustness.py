@@ -254,6 +254,30 @@ class TestMidTrainingRestart:
 
 
 class TestInitIdempotence:
+    def test_force_init_overwrites_surviving_group(self):
+        """Checkpoint resume against servers that survived a worker-job
+        crash: the restored weights must REPLACE the stale live ones
+        (plain idempotent init would no-op and silently resume wrong)."""
+        from distlr_tpu.ps import KVWorker, ServerGroup
+
+        with ServerGroup(1, 1, dim=4, learning_rate=1.0, sync=False) as sg:
+            with KVWorker(sg.hosts, 4, timeout_ms=20_000) as kv:
+                kv.wait(kv.push_init(np.arange(4, dtype=np.float32)))
+                kv.wait(kv.push(np.ones(4, np.float32)))  # live training drift
+                restored = np.full(4, 7.0, np.float32)
+                kv.wait(kv.push_init(restored, force=True))
+                np.testing.assert_allclose(kv.pull(), restored)
+                kv.shutdown_servers()
+
+    def test_barrier_id_range_checked(self):
+        from distlr_tpu.ps import KVWorker, ServerGroup
+
+        with ServerGroup(1, 1, dim=2, sync=False) as sg:
+            with KVWorker(sg.hosts, 2, timeout_ms=20_000) as kv:
+                with pytest.raises(ValueError, match="uint16"):
+                    kv.barrier(1 << 16)
+                kv.shutdown_servers()
+
     def test_push_init_noops_after_initialization(self):
         from distlr_tpu.ps import KVWorker, ServerGroup
 
