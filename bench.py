@@ -22,9 +22,14 @@ from __future__ import annotations
 import json
 import time
 
+# NOTE: importing jax is safe (sitecustomize already does); *initializing*
+# the backend is what can hang when the TPU tunnel is wedged.  Backend
+# selection below is probe-in-subprocess, never an in-process touch.
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+from distlr_tpu.utils.backend import force_cpu, probe_default_backend
 
 
 def _bench_tpu(d: int, b: int, steps: int, lr: float, l2: float) -> float:
@@ -88,8 +93,20 @@ def _bench_cpu_baseline(d: int, b: int, steps: int, lr: float, l2: float) -> flo
 
 
 def main():
-    on_cpu = jax.default_backend() == "cpu"
-    # Shrink on CPU (test/dry-run environments); full scale on the chip.
+    # Probe the default backend in a killable subprocess: a wedged TPU
+    # tunnel hangs forever on any in-process backend touch (round-1
+    # BENCH artifact was lost to exactly this).  CPU fallback is explicit
+    # and recorded in the output JSON.
+    probed = probe_default_backend()
+    if probed is None or probed[0] == "cpu":
+        force_cpu()
+        backend = "cpu"
+    else:
+        backend = probed[0]
+    on_cpu = backend == "cpu"
+    # Shrink on CPU (test/dry-run/dead-tunnel environments); full scale
+    # on the chip.  Shapes are recorded in the JSON so a fallback number
+    # can never be mistaken for a TPU number.
     d = 65536 if on_cpu else 1_000_000
     b = 512 if on_cpu else 2048
     steps = 4 if on_cpu else 20
@@ -105,6 +122,10 @@ def main():
                 "value": round(value, 1),
                 "unit": "samples/sec",
                 "vs_baseline": round(value / baseline, 2),
+                "backend": backend,
+                "D": d,
+                "B": b,
+                "steps": steps,
             }
         )
     )

@@ -1,0 +1,82 @@
+"""Backend liveness probing and safe CPU forcing.
+
+The default accelerator backend in some environments (e.g. a TPU chip
+reached through an experimental tunnel) can be *wedged*: any call that
+initializes it — ``jax.devices()``, ``jax.default_backend()``, building a
+``jnp`` array — hangs forever rather than erroring.  Entry points that
+must never hang (``bench.py``, ``__graft_entry__.dryrun_multichip``)
+therefore must decide CPU-vs-accelerator *without* touching the backend
+in-process.  The only safe probe is a killable subprocess with a timeout;
+the only safe fallback is ``jax.config.update("jax_platforms", "cpu")``
+issued before the first in-process backend initialization (env vars do
+not work when a sitecustomize pre-imports jax and pins the platform).
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+_PROBE_SRC = (
+    "import jax, jax.numpy as jnp;"
+    # A device->host readback is the only honest liveness check: on some
+    # experimental platforms block_until_ready returns at dispatch time.
+    "v = float(jnp.sum(jnp.ones(8)));"
+    "print(jax.default_backend(), len(jax.devices()), v)"
+)
+
+
+def probe_default_backend(timeout_s: float | None = None) -> tuple[str, int] | None:
+    """Run one tiny computation on the default backend in a subprocess.
+
+    Returns ``(backend_name, n_devices)`` if the backend completes a
+    dispatch+readback within ``timeout_s``, else ``None`` (hung backend,
+    import error, or crash).  Never initializes a backend in-process.
+
+    Default timeout is 60s (override via ``DISTLR_PROBE_TIMEOUT_S``) — it
+    must stay comfortably inside any outer artifact-timeout budget, or a
+    hung probe turns back into the hung-artifact failure it prevents.
+    """
+    if timeout_s is None:
+        timeout_s = float(os.environ.get("DISTLR_PROBE_TIMEOUT_S", "60"))
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c", _PROBE_SRC],
+            capture_output=True,
+            text=True,
+            timeout=timeout_s,
+        )
+    except (subprocess.TimeoutExpired, OSError):
+        return None
+    if out.returncode != 0:
+        return None
+    try:
+        name, n, v = out.stdout.split()
+        if float(v) != 8.0:
+            return None
+        return name, int(n)
+    except ValueError:
+        return None
+
+
+def force_cpu(n_devices: int | None = None) -> None:
+    """Switch jax to the CPU platform, optionally with virtual devices.
+
+    Must run before the first in-process backend initialization to be
+    hang-proof; if a backend was already initialized, this clears it
+    first (that path can only be reached when the prior backend is
+    live, so it cannot hang).
+    """
+    import jax
+
+    try:
+        import jax.extend.backend
+
+        jax.clear_caches()
+        jax.extend.backend.clear_backends()
+    except Exception:
+        pass  # no backend initialized yet — nothing to clear
+    jax.config.update("jax_platforms", "cpu")
+    if n_devices is not None:
+        jax.config.update("jax_num_cpu_devices", n_devices)
