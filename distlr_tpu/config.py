@@ -148,6 +148,14 @@ class Config:
             raise ValueError(
                 f"feature_dtype must be float32|bfloat16|int8, got {self.feature_dtype!r}"
             )
+        if self.model == "sparse_lr" and self.feature_dtype != "float32":
+            # Quantized resident feature storage is a dense-matrix
+            # capability; sparse COO vals stay float32 in every mode.
+            # Fail here so sync and PS reject the combination identically.
+            raise ValueError(
+                "feature_dtype quantization applies to dense models only; "
+                "sparse_lr stores COO vals as float32 (set feature_dtype='float32')"
+            )
         if self.ps_compute_backend not in ("auto", "cpu", "default"):
             raise ValueError(
                 f"ps_compute_backend must be auto|cpu|default, got {self.ps_compute_backend!r}"

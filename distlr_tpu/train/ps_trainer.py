@@ -144,7 +144,8 @@ def _sparse_batch_grad(w_u, pos, vals, y, mask, l2_c, l2_scale_by_batch):
 
 
 def _ps_resume_state(cfg: Config, rank: int):
-    """``(start_epoch, weights | None)`` from ``cfg.checkpoint_dir``.
+    """``(start_epoch, weights | None, attempt | None)`` from
+    ``cfg.checkpoint_dir`` (``attempt`` is None when no sidecar exists).
 
     Every rank reads the epoch from a JSON sidecar (``ps_latest.json``,
     written atomically by rank 0 at each checkpoint) so sync-mode workers
@@ -155,11 +156,13 @@ def _ps_resume_state(cfg: Config, rank: int):
     """
     sidecar = os.path.join(cfg.checkpoint_dir, "ps_latest.json")
     if not os.path.exists(sidecar):
-        return 0, None
+        return 0, None, None
     with open(sidecar) as f:
-        epoch = int(json.load(f)["epoch"])
+        data = json.load(f)
+    epoch = int(data["epoch"])
+    attempt = int(data.get("attempt", 0))
     if rank != 0:
-        return epoch, None
+        return epoch, None, attempt
     from distlr_tpu.train.checkpoint import Checkpointer  # noqa: PLC0415
 
     with Checkpointer(cfg.checkpoint_dir) as ckpt:
@@ -173,7 +176,32 @@ def _ps_resume_state(cfg: Config, rank: int):
             f"{sidecar} names epoch {epoch} but {cfg.checkpoint_dir} holds "
             f"no orbax checkpoint for that step"
         )
-    return epoch, np.asarray(state["weights"]).reshape(-1)
+    return epoch, np.asarray(state["weights"]).reshape(-1), attempt
+
+
+def bump_resume_attempt(cfg: Config) -> None:
+    """Advance the sidecar's resume-attempt counter (launcher-side).
+
+    Called ONCE per resumed job, on the rank-0 host, BEFORE any worker
+    starts (multi-host: start the rank-0 host first).  Each resume then
+    rendezvouses on barrier generations the server group has never
+    released: a surviving group already released the previous run's
+    startup generation, and a barrier vote on a released generation
+    returns immediately — which would let peers pull stale crash-time
+    weights before rank 0's forced init overwrites them.
+    """
+    if not cfg.checkpoint_dir:
+        return
+    sidecar = os.path.join(cfg.checkpoint_dir, "ps_latest.json")
+    if not os.path.exists(sidecar):
+        return
+    with open(sidecar) as f:
+        data = json.load(f)
+    data["attempt"] = int(data.get("attempt", 0)) + 1
+    tmp = sidecar + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(data, f)
+    os.replace(tmp, sidecar)
 
 
 class PSWorker:
@@ -227,6 +255,18 @@ class PSWorker:
             self._acc_fn = _compiled_acc(self.model)
         self.metrics = MetricsLogger()
         self.final_weights: np.ndarray | None = None
+        self._barrier_base = 0
+        self._sidecar_attempt = 0
+        if cfg.model == "sparse_lr" and cfg.l2_c > 0:
+            # Sparse PS applies L2 lazily (only a batch's touched keys
+            # decay, scaled by touch frequency) while the sync sparse
+            # trainer decays every weight every step — same l2_c,
+            # different effective regularization (PARITY.md).
+            log.warning(
+                "sparse_lr PS mode applies L2 lazily (touched keys only); "
+                "effective regularization differs from the sync trainer "
+                "at the same l2_c — see PARITY.md"
+            )
 
     def _param_dim(self) -> int:
         return ps_param_dim(self.cfg)
@@ -259,18 +299,27 @@ class PSWorker:
 
         start_epoch = 0
         restored = None
+        attempt = None
         if resume and cfg.checkpoint_dir:
-            start_epoch, restored = _ps_resume_state(cfg, self.rank)
+            start_epoch, restored, attempt = _ps_resume_state(cfg, self.rank)
 
         # Identical deterministic init on every worker (Q2); only rank 0
         # pushes — via the IDEMPOTENT init op, so a restarted rank 0
         # re-sending it cannot corrupt live weights (a plain re-push
         # would land in the async path as a bogus gradient).  On resume,
-        # the restored weights take the init push's place.  The startup
-        # barrier is generation 0; the exit barrier below is generation
-        # 1 — late re-votes of a released generation return immediately,
-        # so a restarted worker neither hangs here nor pairs with peers'
-        # exit votes.
+        # the restored weights take the init push's place.
+        #
+        # Barrier generations: fresh runs use (0, 1) for (startup, exit).
+        # Resumed runs derive a FRESH pair from the sidecar's attempt
+        # counter (bumped once per resume by the launcher,
+        # bump_resume_attempt): a surviving server group already released
+        # the previous run's generations, and votes on a released
+        # generation return immediately — reusing one would let peers
+        # pull stale crash-time weights before rank 0's forced init
+        # lands.  All ranks read the same sidecar, so they agree; late
+        # re-votes of a released generation (worker rejoin) still return
+        # immediately, so a restarted worker neither hangs nor pairs
+        # with peers' exit votes.
         w0 = (restored if restored is not None
               else np.asarray(self.model.init(cfg)).reshape(-1))
         if self.rank == 0:
@@ -282,7 +331,9 @@ class PSWorker:
             # peers back to the checkpoint mid-run.
             force = restored is not None and not rejoin
             self.kv.wait(self.kv.push_init(w0, force=force))
-        self.kv.barrier(0)
+        self._barrier_base = 0 if attempt is None else 2 * (attempt + 1)
+        self._sidecar_attempt = 0 if attempt is None else attempt
+        self.kv.barrier(self._barrier_base)
 
         ckpt = None
         if self.rank == 0 and cfg.checkpoint_dir:
@@ -309,7 +360,9 @@ class PSWorker:
         sidecar = os.path.join(self.cfg.checkpoint_dir, "ps_latest.json")
         tmp = sidecar + ".tmp"
         with open(tmp, "w") as f:
-            json.dump({"epoch": epoch}, f)
+            # attempt is preserved, not reset: a rejoining worker re-reads
+            # the sidecar mid-run and must derive the same barrier base.
+            json.dump({"epoch": epoch, "attempt": self._sidecar_attempt}, f)
         os.replace(tmp, sidecar)
 
     def _run_epochs(self, start_epoch, w0, train, test, ckpt, *, eval_fn, save):
@@ -384,11 +437,12 @@ class PSWorker:
             os.makedirs(os.path.dirname(path), exist_ok=True)
             save_model_text(path, self.final_weights)
         # ps::Finalize(do_barrier=true) parity (reference src/main.cc:179):
-        # a global exit barrier (generation 1) so no server retires while
-        # a peer still trains, then rank 0 retires the group — this is
-        # what lets foreground `launch ps-server` hosts exit when training
-        # is done (local mode: ServerGroup.stop() finds the procs exited).
-        self.kv.barrier(1)
+        # a global exit barrier (startup generation + 1) so no server
+        # retires while a peer still trains, then rank 0 retires the
+        # group — this is what lets foreground `launch ps-server` hosts
+        # exit when training is done (local mode: ServerGroup.stop()
+        # finds the procs exited).
+        self.kv.barrier(self._barrier_base + 1)
         if self.rank == 0:
             self.kv.shutdown_servers()
         return self.final_weights
@@ -444,6 +498,12 @@ def run_ps_workers(cfg: Config, hosts: str, ranks, *, eval_fn=None, save=False,
     outcome is an eternal deadlock).
     """
     ranks = list(ranks)
+    if resume and 0 in ranks:
+        # Once per resumed job, before any worker reads the sidecar:
+        # advance the barrier-generation epoch so the rendezvous below
+        # cannot ride generations a surviving server group already
+        # released (multi-host: the rank-0 host must launch first).
+        bump_resume_attempt(cfg)
     results: dict[int, np.ndarray | None] = {r: None for r in ranks}
     errors: list[Exception] = []
     workers = [PSWorker(cfg, r, hosts) for r in ranks]

@@ -79,18 +79,13 @@ class TestTrainerQuantized:
         assert tr.model.feature_scale == 1.0
         assert tr.evaluate() > 0.7
 
-    def test_sparse_ignores_feature_dtype(self, tmp_path):
-        from distlr_tpu.data.hashing import write_ctr_shards
-
-        d = str(tmp_path / "ctr")
-        write_ctr_shards(d, 400, 6, 100, 64, num_parts=1, seed=1)
-        cfg = Config(
-            data_dir=d, num_feature_dim=64, model="sparse_lr",
-            feature_dtype="int8", num_iteration=5, test_interval=0,
-            l2_c=0.0, batch_size=-1,
-        )
-        tr = Trainer(cfg).load_data()  # must not quantize COO vals
-        assert tr._train_data._feats[1].dtype == np.float32
+    def test_sparse_rejects_feature_dtype(self):
+        """Quantized resident features are a dense-matrix capability;
+        sparse_lr + int8 must fail loudly and identically in BOTH the
+        sync trainer and PS mode (ADVICE r1: it used to be silently
+        ignored by one and rejected by the other)."""
+        with pytest.raises(ValueError, match="dense models only"):
+            Config(model="sparse_lr", feature_dtype="int8", num_feature_dim=64)
 
     def test_invalid_dtype_rejected(self):
         with pytest.raises(ValueError, match="feature_dtype"):

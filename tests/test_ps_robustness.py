@@ -298,3 +298,91 @@ class TestInitIdempotence:
                 kv.barrier(0)   # late re-vote: must return, not hang
                 kv.barrier(1)   # next generation independent
                 kv.shutdown_servers()
+
+
+class TestSurvivingGroupResume:
+    """Job-level resume against a server group that SURVIVED the worker
+    crash (ADVICE r1): the group already released the crashed run's
+    startup barrier generation, so the resumed run must rendezvous on a
+    FRESH generation pair (sidecar attempt counter, bumped once per
+    resume by the launcher) — otherwise peers sail through barrier(0)
+    and pull stale crash-time weights before rank 0's forced init."""
+
+    def test_resume_against_surviving_group(self, tmp_path, monkeypatch):
+        import json
+        import os
+        import shutil
+
+        from distlr_tpu.config import Config
+        from distlr_tpu.data.synthetic import write_synthetic_shards
+        from distlr_tpu.train.ps_trainer import (
+            PSWorker, ps_param_dim, run_ps_local, run_ps_workers,
+        )
+
+        d = str(tmp_path / "data")
+        write_synthetic_shards(d, 600, 16, num_parts=2, seed=9, sparsity=0.0)
+        ck = str(tmp_path / "ck")
+        cfg = Config(
+            data_dir=d, num_feature_dim=16, num_workers=2, num_servers=2,
+            num_iteration=4, learning_rate=0.5, l2_c=0.0, batch_size=-1,
+            test_interval=0, sync_mode=True, checkpoint_dir=ck,
+            checkpoint_interval=2, ps_timeout_ms=4000,
+        )
+
+        # Rank 0 dies right after writing the epoch-2 checkpoint; rank 1
+        # then times out on the next BSP round.  No on_error: servers live.
+        real_ckpt = PSWorker._checkpoint
+        state = {"crashed": False}
+
+        def crashing_ckpt(self, ckpt, epoch):
+            real_ckpt(self, ckpt, epoch)
+            if epoch == 2 and not state["crashed"]:
+                state["crashed"] = True
+                raise RuntimeError("injected crash after checkpoint")
+
+        monkeypatch.setattr(PSWorker, "_checkpoint", crashing_ckpt)
+        group = ServerGroup(2, 2, ps_param_dim(cfg), learning_rate=0.5, sync=True)
+        with group:
+            with pytest.raises(Exception):
+                run_ps_workers(cfg, group.hosts, range(2), save=False)
+            assert state["crashed"]
+            with open(os.path.join(ck, "ps_latest.json")) as f:
+                sc = json.load(f)
+            assert sc == {"epoch": 2, "attempt": 0}
+
+            # Deterministic oracle: the same resume on a FRESH group from
+            # a copy of the checkpoint (sync full-batch is deterministic).
+            ck2 = str(tmp_path / "ck2")
+            shutil.copytree(ck, ck2)
+
+            resumed = run_ps_workers(
+                cfg, group.hosts, range(2), save=False, resume=True,
+            )
+        with open(os.path.join(ck, "ps_latest.json")) as f:
+            sc = json.load(f)
+        assert sc["attempt"] == 1, "resume must advance the barrier epoch"
+        assert sc["epoch"] == 4
+
+        ref = run_ps_local(
+            cfg.replace(checkpoint_dir=ck2), save=False, resume=True,
+        )
+        np.testing.assert_allclose(resumed[0], ref[0], rtol=1e-5, atol=1e-6)
+
+    def test_bump_resume_attempt_preserves_epoch_and_noops_without_sidecar(self, tmp_path):
+        import json
+        import os
+
+        from distlr_tpu.config import Config
+        from distlr_tpu.train.ps_trainer import bump_resume_attempt
+
+        cfg = Config(checkpoint_dir=str(tmp_path), num_feature_dim=4)
+        bump_resume_attempt(cfg)  # no sidecar: must not create one
+        sidecar = os.path.join(str(tmp_path), "ps_latest.json")
+        assert not os.path.exists(sidecar)
+
+        with open(sidecar, "w") as f:
+            json.dump({"epoch": 6}, f)  # legacy sidecar without attempt
+        bump_resume_attempt(cfg)
+        bump_resume_attempt(cfg)
+        with open(sidecar) as f:
+            assert json.load(f) == {"epoch": 6, "attempt": 2}
