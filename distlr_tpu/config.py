@@ -96,6 +96,9 @@ class Config:
     sync_last_gradient: bool | None = None
     # Q2: init weights with C rand() after srand(0), uniform [0,1).
     reference_rng_init: bool | None = None
+    # Q5: the final batch of each epoch wraps to the shard head (duplicate
+    # samples) instead of being padded+masked (data_iter.h:44-56).
+    wrap_final_batch: bool | None = None
 
     # ---- parallelism ----
     num_workers: int = 1              # data-parallel shards (DMLC_NUM_WORKER)
@@ -138,6 +141,8 @@ class Config:
             self.sync_last_gradient = ref
         if self.reference_rng_init is None:
             self.reference_rng_init = ref
+        if self.wrap_final_batch is None:
+            self.wrap_final_batch = ref
         if self.model not in ("binary_lr", "softmax", "sparse_lr"):
             raise ValueError(f"unknown model {self.model!r}")
         if self.num_feature_dim <= 0:

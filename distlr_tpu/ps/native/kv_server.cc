@@ -267,16 +267,25 @@ class KVServer {
     if (static_cast<int>(pending_.size()) == num_workers_) {
       const float w = static_cast<float>(num_workers_);
       if (last_gradient_) {
-        // Q1 compat: apply only the last-arriving gradient / W
-        // (the reference reads req_data.vals, src/main.cc:70-72).
-        // Keyed rounds can end on an empty "present" vote; the quirk's
-        // meaning is the last worker that pushed DATA, so skip back over
-        // empty votes rather than silently dropping the round.
-        for (auto it = pending_.rbegin(); it != pending_.rend(); ++it) {
-          if (it->keys.empty()) continue;
-          for (size_t i = 0; i < it->keys.size(); ++i)
-            weights_[it->keys[i]] -= lr_ * it->vals[i] / w;
-          break;
+        // Q1 compat: apply only ONE worker's gradient / W (the reference
+        // reads req_data.vals of the final arrival, src/main.cc:70-72 —
+        // an arrival-order lottery).  We refine the lottery into a
+        // deterministic pick: the DATA push with the highest client_id,
+        // the same "last = rank W-1" convention the SPMD Q1 gate uses —
+        // any fixed arrival order is a valid reference execution, and a
+        // deterministic one is testable against the trajectory oracle
+        // (benchmarks/reference_oracle.cc).  Keyed rounds can end on an
+        // empty "present" vote; the quirk means the last worker that
+        // pushed DATA, so empty votes never win the pick.
+        const PendingPush* pick = nullptr;
+        for (const auto& p : pending_) {
+          if (p.keys.empty()) continue;
+          if (pick == nullptr || p.header.client_id > pick->header.client_id)
+            pick = &p;
+        }
+        if (pick != nullptr) {
+          for (size_t i = 0; i < pick->keys.size(); ++i)
+            weights_[pick->keys[i]] -= lr_ * pick->vals[i] / w;
         }
       } else {
         // Correct BSP: mean of the merged gradients.

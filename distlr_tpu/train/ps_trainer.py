@@ -275,11 +275,14 @@ class PSWorker:
         # Reference re-reads its shard every epoch (src/main.cc:158-159);
         # we parse once and reset (same samples, no quirk).
         path = os.path.join(self.cfg.data_dir, "train", part_name(self.rank))
+        wrap = bool(self.cfg.wrap_final_batch)  # Q5
         if self.cfg.model == "sparse_lr":
             return SparseDataIter.from_file(path, self.cfg.num_feature_dim,
-                                            self.cfg.batch_size, nnz_max=self.cfg.nnz_max)
+                                            self.cfg.batch_size, nnz_max=self.cfg.nnz_max,
+                                            wrap_compat=wrap)
         return DataIter.from_file(path, self.cfg.num_feature_dim, self.cfg.batch_size,
-                                  multiclass=self.cfg.model == "softmax")
+                                  multiclass=self.cfg.model == "softmax",
+                                  wrap_compat=wrap)
 
     def _load_test_iter(self) -> DataIter:
         path = os.path.join(self.cfg.data_dir, "test", part_name(0))
