@@ -1,0 +1,102 @@
+"""Experiment: row-blocked vs scalar gathers on the config-4 CTR step.
+
+ROOFLINE.md measured rows-of-8 gathers at 3.4x the bytes/s of scalar
+gathers (amortized per-index cost).  The blocked CTR path
+(data/hashing.hash_group_blocks + models.BlockedSparseLR) exploits that:
+21 fields grouped into 3 blocks of 8 -> 3 row gathers + 3 row
+scatter-adds per sample instead of 21 + 21 scalars.  This measures the
+full train step (grad + SGD update, donated weights) for both layouts at
+config-4 scale (D=1M params, B=65536).
+
+Run on the real chip: python benchmarks/exp_blocked.py
+(On a dead/absent accelerator it falls back to CPU and says so — CPU
+numbers are NOT comparable to BENCH_CONFIGS.json.)
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.dirname(HERE))
+
+from distlr_tpu.utils.backend import force_cpu, probe_default_backend  # noqa: E402
+
+probed = probe_default_backend()
+if probed is None or probed[0] == "cpu":
+    force_cpu()
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+from distlr_tpu.config import Config  # noqa: E402
+from distlr_tpu.models import BlockedSparseLR, SparseBinaryLR  # noqa: E402
+
+D, B, FIELDS, R, STEPS = 1_000_000, 65536, 21, 8, 20
+LR = 0.5
+
+
+def timeit(name, step, w, batch, steps=STEPS):
+    w1 = step(w, batch)
+    # device->host readback: the only honest sync on the axon tunnel
+    assert np.isfinite(float(jnp.sum(w1)))
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        w1 = step(w1, batch)
+    checksum = float(jnp.sum(w1))
+    dt = time.perf_counter() - t0
+    assert np.isfinite(checksum)
+    rate = B * steps / dt
+    print(f"{name:42s} {dt / steps * 1e3:8.2f} ms/step  {rate / 1e6:7.2f} M samples/s")
+    return rate
+
+
+def main():
+    print(f"backend={jax.default_backend()} D={D} B={B} fields={FIELDS} R={R}")
+    rng = np.random.default_rng(0)
+    y = jnp.asarray(rng.integers(0, 2, B), jnp.int32)
+    mask = jnp.ones(B, jnp.float32)
+
+    # --- scalar path (status quo): (B, 21) scalar gathers -------------
+    cfg_s = Config(num_feature_dim=D, model="sparse_lr", l2_c=0.0)
+    scalar = SparseBinaryLR(D)
+    cols = jnp.asarray(rng.integers(0, D, size=(B, FIELDS)), jnp.int32)
+    vals = jnp.ones((B, FIELDS), jnp.float32)
+
+    @jax.jit
+    def step_scalar(w, batch):
+        g = scalar.grad(w, batch, cfg_s)
+        return w - LR * g
+
+    w0 = jnp.zeros(D, jnp.float32)
+    r_scalar = timeit("scalar gathers (21 idx/sample)", step_scalar, w0,
+                      (cols, vals, y, mask))
+
+    # --- blocked path: 3 row gathers of 8 lanes per sample ------------
+    g_count = -(-FIELDS // R)  # 3 groups (last padded)
+    nb = D // R
+    cfg_b = Config(num_feature_dim=D, model="blocked_lr", block_size=R, l2_c=0.0)
+    blocked = BlockedSparseLR(nb, R)
+    blocks = jnp.asarray(rng.integers(0, nb, size=(B, g_count)), jnp.int32)
+    lane_vals = np.ones((B, g_count, R), np.float32)
+    lane_vals[:, -1, FIELDS - (g_count - 1) * R:] = 0.0  # padded lanes
+    lane_vals = jnp.asarray(lane_vals)
+
+    @jax.jit
+    def step_blocked(t, batch):
+        g = blocked.grad(t, batch, cfg_b)
+        return t - LR * g
+
+    t0 = jnp.zeros((nb, R), jnp.float32)
+    r_blocked = timeit(f"blocked rows ({g_count} idx/sample, R={R})",
+                       step_blocked, t0, (blocks, lane_vals, y, mask))
+
+    print(f"speedup: {r_blocked / r_scalar:.2f}x "
+          f"(backend={jax.default_backend()})")
+
+
+if __name__ == "__main__":
+    main()

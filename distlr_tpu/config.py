@@ -75,6 +75,9 @@ class Config:
     model: str = "binary_lr"          # binary_lr | softmax | sparse_lr
     num_classes: int = 2              # softmax only
     nnz_max: int | None = None        # sparse_lr: cap per-row nonzeros (pad width)
+    # blocked_lr: lanes per table row (params = num_feature_dim, rows =
+    # num_feature_dim / block_size) — see data/hashing.hash_group_blocks.
+    block_size: int = 8
     dtype: str = "float32"            # accumulation dtype
     compute_dtype: str = "bfloat16"   # matmul dtype on TPU (MXU-friendly)
     # Device-resident storage dtype of DENSE feature matrices. The dense
@@ -143,8 +146,10 @@ class Config:
             self.reference_rng_init = ref
         if self.wrap_final_batch is None:
             self.wrap_final_batch = ref
-        if self.model not in ("binary_lr", "softmax", "sparse_lr"):
+        if self.model not in ("binary_lr", "softmax", "sparse_lr", "blocked_lr"):
             raise ValueError(f"unknown model {self.model!r}")
+        if self.block_size <= 0:
+            raise ValueError("block_size must be positive")
         if self.num_feature_dim <= 0:
             raise ValueError("num_feature_dim must be positive")
         if self.batch_size == 0 or self.batch_size < -1:

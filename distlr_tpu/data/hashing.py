@@ -31,6 +31,7 @@ import numpy as np
 __all__ = [
     "splitmix64",
     "hash_buckets",
+    "hash_group_blocks",
     "HashedFeatureEncoder",
     "csr_to_padded_coo",
     "make_ctr_dataset",
@@ -75,6 +76,63 @@ def hash_buckets(ids: np.ndarray, num_buckets: int, *, seed: int = 0, field_ids=
     # bit 63 is independent of the modulus for num_buckets << 2^63
     signs = np.where((h >> _U64(63)).astype(bool), np.float32(1.0), np.float32(-1.0))
     return buckets, signs
+
+
+def hash_group_blocks(raw_ids, field_groups, num_blocks: int, *, seed: int = 0,
+                      raw_vals=None):
+    """Row-aligned ("blocked") hashing: field groups -> block-row ids.
+
+    TPU gathers amortize their per-index cost over contiguous elements
+    (benchmarks/ROOFLINE.md: rows-of-8 move 3.4x the bytes/s of scalar
+    gathers), but that only pays off if the fetched lanes are all used —
+    which requires co-locating several of a sample's features in ONE
+    table row.  Per-field buckets cannot co-locate (each field's value
+    picks an independent bucket), so this scheme hashes a GROUP of R
+    fields jointly: the group's value tuple selects the block row, and
+    lane j holds the learned weight of member field j under that
+    conjunction.  One R-wide row gather then replaces R scalar gathers.
+
+    The statistical trade (documented, opt-in): weights are per
+    (conjunction, field) instead of per field — rows are trained only
+    when their exact value tuple recurs, so group LOW-CARDINALITY fields
+    (tuple space small enough to recur in training data) and keep
+    high-cardinality fields on the scalar `hash_buckets` path.
+
+    Args:
+      raw_ids: (N, F) integer categorical values.
+      field_groups: sequence of equal-length field-index tuples; use -1
+        to pad a short group (its lane contributes value 0).
+      num_blocks: table rows; total params = num_blocks * R.
+      raw_vals: optional (N, F) float values (default one-hot 1.0).
+
+    Returns ``(blocks, lane_vals)``: (N, G) int64 block ids and
+    (N, G, R) float32 per-lane values.
+    """
+    raw_ids = np.asarray(raw_ids, dtype=np.int64)
+    groups = np.asarray(field_groups, dtype=np.int64)
+    if groups.ndim != 2:
+        raise ValueError("field_groups must be a (G, R) array of field indices")
+    n, _ = raw_ids.shape
+    g_count, r = groups.shape
+    pad = groups < 0
+    safe = np.where(pad, 0, groups)
+    vals_f = (np.ones_like(raw_ids, dtype=np.float32) if raw_vals is None
+              else np.asarray(raw_vals, dtype=np.float32))
+    member_ids = raw_ids[:, safe.reshape(-1)].reshape(n, g_count, r)
+    lane_vals = vals_f[:, safe.reshape(-1)].reshape(n, g_count, r).copy()
+    lane_vals[:, pad] = 0.0
+
+    # Conjunction key: fold member (field, value) mixes in lane order so
+    # the tuple (not the multiset) is keyed; padded lanes fold a constant.
+    key = np.full((n, g_count), _U64(seed), dtype=_U64)
+    with np.errstate(over="ignore"):
+        key = splitmix64(key)
+        for j in range(r):
+            fj = np.where(pad[:, j], _U64(0xD1F), safe[:, j].astype(_U64))
+            vj = np.where(pad[None, :, j], _U64(0), member_ids[:, :, j].astype(_U64))
+            key = splitmix64(key ^ splitmix64(vj + splitmix64(fj + _U64(0x9E))))
+    blocks = (key % _U64(num_blocks)).astype(np.int64)
+    return blocks, lane_vals
 
 
 @dataclasses.dataclass(frozen=True)

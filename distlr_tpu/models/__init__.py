@@ -1,5 +1,6 @@
 from distlr_tpu.models.linear import (  # noqa: F401
     BinaryLR,
+    BlockedSparseLR,
     SoftmaxRegression,
     SparseBinaryLR,
     get_model,

@@ -235,6 +235,64 @@ class SparseBinaryLR:
         return _masked_mean(correct, mask)
 
 
+@dataclasses.dataclass(frozen=True)
+class BlockedSparseLR:
+    """Binary LR over row-aligned block batches (the row-blocked CTR
+    path — see :func:`distlr_tpu.data.hashing.hash_group_blocks`).
+
+    Params are a ``(num_blocks, block_size)`` table.  A batch is
+    ``(blocks, lane_vals, y, mask)`` with ``blocks`` of shape (B, G) and
+    ``lane_vals`` of shape (B, G, R): each sample gathers G contiguous
+    R-wide rows instead of G*R scalars, which amortizes the TPU gather
+    unit's per-index cost (benchmarks/ROOFLINE.md: 3.4x the bytes/s of
+    scalar gathers); the gradient scatter is a ``segment_sum`` of R-wide
+    rows, blocked the same way.  Logit = sum over groups of
+    ``T[block_g] . lane_vals_g`` — with lane_vals the one-hot/raw values
+    of the group's member fields, this is per-(conjunction, field)
+    logistic regression.
+    """
+
+    num_blocks: int
+    block_size: int = 8
+
+    def init(self, cfg: Config) -> jnp.ndarray:
+        # Zeros for the same reason SparseBinaryLR uses them: untrained
+        # rows (unseen conjunctions) must contribute nothing, not noise.
+        return jnp.zeros((self.num_blocks, self.block_size), jnp.float32)
+
+    def logits(self, t, blocks, lane_vals):
+        return jnp.sum(t[blocks] * lane_vals, axis=(-1, -2))
+
+    def loss(self, t, batch, cfg: Config):
+        blocks, lane_vals, y, mask = batch
+        z = self.logits(t, blocks, lane_vals)
+        ll = jax.nn.softplus(z) - y.astype(jnp.float32) * z
+        reg = 0.5 * cfg.l2_c * jnp.sum(t * t)
+        if cfg.l2_scale_by_batch:
+            reg = reg / jnp.maximum(jnp.sum(mask), 1)
+        return _masked_mean(ll, mask) + reg
+
+    def grad(self, t, batch, cfg: Config):
+        blocks, lane_vals, y, mask = batch
+        z = self.logits(t, blocks, lane_vals)
+        resid = (jax.nn.sigmoid(z) - y.astype(jnp.float32)) * mask
+        n = jnp.maximum(jnp.sum(mask), 1).astype(jnp.float32)
+        # Row-blocked scatter: (B*G, R) row contributions summed per block.
+        contrib = (resid[:, None, None] * lane_vals).reshape(-1, self.block_size)
+        g = jax.ops.segment_sum(
+            contrib, blocks.reshape(-1), num_segments=self.num_blocks
+        ) / n
+        return g + _l2_grad(t, cfg, n)
+
+    def predict(self, t, blocks, lane_vals):
+        return (self.logits(t, blocks, lane_vals) > 0).astype(jnp.int32)
+
+    def accuracy(self, t, batch):
+        blocks, lane_vals, y, mask = batch
+        correct = (self.predict(t, blocks, lane_vals) == y).astype(jnp.float32)
+        return _masked_mean(correct, mask)
+
+
 def get_model(cfg: Config):
     if cfg.model == "binary_lr":
         return BinaryLR(cfg.num_feature_dim, compute_dtype=cfg.compute_dtype)
@@ -242,4 +300,11 @@ def get_model(cfg: Config):
         return SoftmaxRegression(cfg.num_feature_dim, cfg.num_classes, compute_dtype=cfg.compute_dtype)
     if cfg.model == "sparse_lr":
         return SparseBinaryLR(cfg.num_feature_dim)
+    if cfg.model == "blocked_lr":
+        if cfg.num_feature_dim % cfg.block_size:
+            raise ValueError(
+                f"num_feature_dim ({cfg.num_feature_dim}) must be a multiple "
+                f"of block_size ({cfg.block_size}) for blocked_lr"
+            )
+        return BlockedSparseLR(cfg.num_feature_dim // cfg.block_size, cfg.block_size)
     raise ValueError(f"unknown model {cfg.model!r}")
