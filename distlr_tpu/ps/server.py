@@ -9,6 +9,7 @@ Replaces the reference launcher's server-spawning half
 from __future__ import annotations
 
 import subprocess
+import threading
 
 from distlr_tpu.ps.build import build_native, server_binary
 from distlr_tpu.utils.logging import get_logger
@@ -55,41 +56,78 @@ class ServerGroup:
             last_gradient=int(last_gradient),
             bind_any=int(bind_any),
         )
+        # serializes respawn() against stop() (supervisor thread vs
+        # teardown) and marks teardown so a racing respawn becomes a no-op
+        self._lock = threading.Lock()
+        self._stopped = False
 
     @property
     def hosts(self) -> str:
         """Client connection spec, server-rank order."""
         return ",".join(f"127.0.0.1:{p}" for p in self.ports)
 
+    def key_range(self, rank: int) -> tuple[int, int]:
+        """Global key slice ``[lo, hi)`` owned by server ``rank``."""
+        lo = self.dim * rank // self.num_servers
+        hi = self.dim * (rank + 1) // self.num_servers
+        return lo, hi
+
+    def _spawn(self, rank: int, port: int) -> tuple[subprocess.Popen, int]:
+        lo, hi = self.key_range(rank)
+        cmd = [
+            self._binary,
+            f"--port={port}",
+            f"--num_workers={self.num_workers}",
+            f"--dim={hi - lo}",
+            f"--lr={self._args['lr']}",
+            f"--sync={self._args['sync']}",
+            f"--last_gradient={self._args['last_gradient']}",
+            f"--bind_any={self._args['bind_any']}",
+        ]
+        proc = subprocess.Popen(cmd, stdout=subprocess.PIPE, text=True)
+        # The server prints "PORT <n>" once listening; blocking on that
+        # line doubles as the readiness wait.
+        line = proc.stdout.readline().strip()
+        if not line.startswith("PORT "):
+            proc.terminate()
+            raise RuntimeError(
+                f"KV server rank {rank} failed to start (got {line!r})"
+            )
+        return proc, int(line.split()[1])
+
     def start(self) -> "ServerGroup":
         fixed_ports = list(self.ports)
         self.ports = []
+        self._stopped = False
         for rank in range(self.num_servers):
-            lo = self.dim * rank // self.num_servers
-            hi = self.dim * (rank + 1) // self.num_servers
-            port = fixed_ports[rank] if fixed_ports else 0
-            cmd = [
-                self._binary,
-                f"--port={port}",
-                f"--num_workers={self.num_workers}",
-                f"--dim={hi - lo}",
-                f"--lr={self._args['lr']}",
-                f"--sync={self._args['sync']}",
-                f"--last_gradient={self._args['last_gradient']}",
-                f"--bind_any={self._args['bind_any']}",
-            ]
-            proc = subprocess.Popen(cmd, stdout=subprocess.PIPE, text=True)
-            self.procs.append(proc)
-            # The server prints "PORT <n>" once listening; blocking on that
-            # line doubles as the readiness wait.
-            line = proc.stdout.readline().strip()
-            if not line.startswith("PORT "):
+            try:
+                proc, port = self._spawn(rank, fixed_ports[rank] if fixed_ports else 0)
+            except RuntimeError:
                 self.stop()
-                raise RuntimeError(
-                    f"KV server rank {rank} failed to start (got {line!r})"
-                )
-            self.ports.append(int(line.split()[1]))
+                raise
+            self.procs.append(proc)
+            self.ports.append(port)
         return self
+
+    def respawn(self, rank: int) -> bool:
+        """Restart a dead server process on its ORIGINAL port (so the
+        group's ``hosts`` string — already baked into every client —
+        stays valid).  The new process starts UNINITIALIZED: the caller
+        (ServerSupervisor) must re-seed its key slice via a forced init
+        push.  Returns False if the group is being torn down or the rank
+        is still alive."""
+        with self._lock:
+            if self._stopped:
+                return False
+            old = self.procs[rank]
+            if old.poll() is None:
+                return False
+            if old.stdout:
+                old.stdout.close()
+            proc, port = self._spawn(rank, self.ports[rank])
+            self.procs[rank] = proc
+            assert port == self.ports[rank]
+            return True
 
     def alive(self) -> list[bool]:
         """Process-level liveness, one flag per server rank."""
@@ -116,6 +154,8 @@ class ServerGroup:
             p.wait()
 
     def stop(self) -> None:
+        with self._lock:
+            self._stopped = True
         for p in self.procs:
             if p.poll() is None:
                 p.terminate()
