@@ -15,6 +15,7 @@ numbers are NOT comparable to BENCH_CONFIGS.json.)
 
 from __future__ import annotations
 
+import functools
 import os
 import sys
 import time
@@ -66,7 +67,7 @@ def main():
     cols = jnp.asarray(rng.integers(0, D, size=(B, FIELDS)), jnp.int32)
     vals = jnp.ones((B, FIELDS), jnp.float32)
 
-    @jax.jit
+    @functools.partial(jax.jit, donate_argnums=0)
     def step_scalar(w, batch):
         g = scalar.grad(w, batch, cfg_s)
         return w - LR * g
@@ -85,7 +86,7 @@ def main():
     lane_vals[:, -1, FIELDS - (g_count - 1) * R:] = 0.0  # padded lanes
     lane_vals = jnp.asarray(lane_vals)
 
-    @jax.jit
+    @functools.partial(jax.jit, donate_argnums=0)
     def step_blocked(t, batch):
         g = blocked.grad(t, batch, cfg_b)
         return t - LR * g

@@ -125,8 +125,19 @@ class ServerGroup:
             if old.stdout:
                 old.stdout.close()
             proc, port = self._spawn(rank, self.ports[rank])
+            if port != self.ports[rank]:
+                # Another process stole the port between death and respawn;
+                # clients hold the old hosts string, so this replacement is
+                # unreachable — fail the respawn, not the supervisor thread.
+                proc.terminate()
+                if proc.stdout:
+                    proc.stdout.close()
+                proc.wait()
+                raise RuntimeError(
+                    f"respawned server rank {rank} bound port {port}, "
+                    f"expected {self.ports[rank]} (port stolen while down)"
+                )
             self.procs[rank] = proc
-            assert port == self.ports[rank]
             return True
 
     def alive(self) -> list[bool]:

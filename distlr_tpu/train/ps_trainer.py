@@ -161,7 +161,11 @@ def _ps_resume_state(cfg: Config, rank: int):
         data = json.load(f)
     epoch = int(data["epoch"])
     attempt = int(data.get("attempt", 0))
-    if rank != 0:
+    if rank != 0 or epoch == 0:
+        # epoch 0 = a resume-attempt sidecar written before the first
+        # checkpoint existed (bump_resume_attempt on a crashed-early run):
+        # there is no orbax step to restore, only a barrier generation to
+        # advance.
         return epoch, None, attempt
     from distlr_tpu.train.checkpoint import Checkpointer  # noqa: PLC0415
 
@@ -193,10 +197,17 @@ def bump_resume_attempt(cfg: Config) -> None:
     if not cfg.checkpoint_dir:
         return
     sidecar = os.path.join(cfg.checkpoint_dir, "ps_latest.json")
-    if not os.path.exists(sidecar):
-        return
-    with open(sidecar) as f:
-        data = json.load(f)
+    if os.path.exists(sidecar):
+        with open(sidecar) as f:
+            data = json.load(f)
+    else:
+        # Workers can crash BEFORE the first checkpoint writes a sidecar;
+        # a resume must still advance the barrier generation, or peers
+        # ride barrier(0) — which a surviving server group already
+        # released — straight past rank 0's re-init (the race this
+        # counter exists to close).  Create the sidecar at epoch 0.
+        os.makedirs(cfg.checkpoint_dir, exist_ok=True)
+        data = {"epoch": 0, "attempt": 0}
     data["attempt"] = int(data.get("attempt", 0)) + 1
     tmp = sidecar + ".tmp"
     with open(tmp, "w") as f:
@@ -327,12 +338,13 @@ class PSWorker:
               else np.asarray(self.model.init(cfg)).reshape(-1))
         if self.rank == 0:
             # force on resume: against a SURVIVING (already-initialized)
-            # server group the restored checkpoint must overwrite the
-            # stale crash-time weights — a plain idempotent init would
-            # no-op and silently resume from the wrong state.  A
-            # restarted worker (rejoin) must NOT force: it would roll
-            # peers back to the checkpoint mid-run.
-            force = restored is not None and not rejoin
+            # server group the restored checkpoint — or, when the crash
+            # predated the first checkpoint, the fresh epoch-0 init —
+            # must overwrite the stale crash-time weights; a plain
+            # idempotent init would no-op and silently resume from the
+            # wrong state.  A restarted worker (rejoin) must NOT force:
+            # it would roll peers back mid-run.
+            force = resume and not rejoin
             self.kv.wait(self.kv.push_init(w0, force=force))
         self._barrier_base = 0 if attempt is None else 2 * (attempt + 1)
         self._sidecar_attempt = 0 if attempt is None else attempt

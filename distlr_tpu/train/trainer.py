@@ -164,11 +164,41 @@ class GlobalShardedData:
     def num_samples(self) -> int:
         return int(sum(self.shard_sizes))
 
-    def batches(self, per_worker_batch: int):
+    def batches(self, per_worker_batch: int, *, wrap: bool = False):
         """One epoch of lockstep global batches ``(*feats, y, mask)``
         shaped ``(W*b, ...)``. ``-1`` = full shard per worker (one
-        step/epoch)."""
+        step/epoch).
+
+        ``wrap=True`` reproduces the reference's Q5 final-batch semantics
+        (``include/data_iter.h:44-56``): the short final batch wraps to the
+        shard head and re-serves leading samples instead of being
+        padded+masked.  Lockstep batching can only express this when every
+        shard wraps at the same offset, so unequal shard sizes reject
+        loudly rather than silently approximating the quirk.
+        """
         b = self.n_pad if per_worker_batch == -1 else min(per_worker_batch, self.n_pad)
+        # Q5 is defined on REAL per-shard sizes, before padding/clamping:
+        # batch=-1 is one whole-shard batch (no wrap possible,
+        # data_iter.h:39-43), and a batch larger than the shard cycles it.
+        if wrap and per_worker_batch != -1 and any(
+            sz % per_worker_batch for sz in self.shard_sizes
+        ):
+            if any(n != self.n_pad for n in self.shard_sizes):
+                raise ValueError(
+                    "wrap_final_batch (Q5 compat) requires equal-size shards "
+                    f"in the sync trainer (got sizes {self.shard_sizes}); "
+                    "per-shard wraparound points diverge otherwise — use the "
+                    "PS trainer for Q5 parity on unequal shards, or "
+                    "compat_mode='correct'"
+                )
+            bw, n = per_worker_batch, self.n_pad
+            for k in range(-(-n // bw)):
+                idx = np.arange(k * bw, (k + 1) * bw) % n
+                yield tuple(
+                    a[:, idx].reshape((-1,) + a.shape[2:])
+                    for a in (*self._feats, self.y, self.mask)
+                )
+            return
 
         def _slice(arr, sl, bw):
             out = arr[:, sl]
@@ -372,7 +402,9 @@ class Trainer:
                 stack.callback(ckpt.close)
 
             for epoch in range(start_epoch, epochs):
-                for host_batch in self._train_data.batches(cfg.batch_size):
+                for host_batch in self._train_data.batches(
+                    cfg.batch_size, wrap=bool(cfg.wrap_final_batch)
+                ):
                     batch = self._shard_batch(host_batch)
                     self.timer.start()
                     self.weights, step_metrics = self.train_step(self.weights, batch)

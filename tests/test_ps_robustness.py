@@ -368,17 +368,21 @@ class TestSurvivingGroupResume:
         )
         np.testing.assert_allclose(resumed[0], ref[0], rtol=1e-5, atol=1e-6)
 
-    def test_bump_resume_attempt_preserves_epoch_and_noops_without_sidecar(self, tmp_path):
+    def test_bump_resume_attempt_preserves_epoch_and_creates_missing_sidecar(self, tmp_path):
         import json
         import os
 
         from distlr_tpu.config import Config
         from distlr_tpu.train.ps_trainer import bump_resume_attempt
 
-        cfg = Config(checkpoint_dir=str(tmp_path), num_feature_dim=4)
-        bump_resume_attempt(cfg)  # no sidecar: must not create one
-        sidecar = os.path.join(str(tmp_path), "ps_latest.json")
-        assert not os.path.exists(sidecar)
+        cfg = Config(checkpoint_dir=str(tmp_path / "ck"), num_feature_dim=4)
+        # No sidecar (crash predated the first checkpoint): the resume must
+        # still get a fresh barrier generation, so the sidecar is CREATED
+        # at epoch 0 (ADVICE r2 — a no-op here reused released barrier 0).
+        bump_resume_attempt(cfg)
+        sidecar = os.path.join(cfg.checkpoint_dir, "ps_latest.json")
+        with open(sidecar) as f:
+            assert json.load(f) == {"epoch": 0, "attempt": 1}
 
         with open(sidecar, "w") as f:
             json.dump({"epoch": 6}, f)  # legacy sidecar without attempt
@@ -386,3 +390,63 @@ class TestSurvivingGroupResume:
         bump_resume_attempt(cfg)
         with open(sidecar) as f:
             assert json.load(f) == {"epoch": 6, "attempt": 2}
+
+    def test_resume_before_first_checkpoint_reinitializes(self, tmp_path, monkeypatch):
+        """Workers crash BEFORE any checkpoint exists; the surviving server
+        group holds stale crash-time weights and has already released
+        barrier generation 0.  The resume must (a) rendezvous on a fresh
+        generation and (b) force a fresh epoch-0 init over the stale
+        weights — equaling a from-scratch run on a fresh group."""
+        import json
+        import os
+
+        from distlr_tpu.config import Config
+        from distlr_tpu.data.synthetic import write_synthetic_shards
+        from distlr_tpu.train.ps_trainer import (
+            PSWorker, ps_param_dim, run_ps_local, run_ps_workers,
+        )
+
+        d = str(tmp_path / "data")
+        write_synthetic_shards(d, 600, 16, num_parts=2, seed=9, sparsity=0.0)
+        ck = str(tmp_path / "ck")
+        cfg = Config(
+            data_dir=d, num_feature_dim=16, num_workers=2, num_servers=2,
+            num_iteration=3, learning_rate=0.5, l2_c=0.0, batch_size=-1,
+            test_interval=0, sync_mode=True, checkpoint_dir=ck,
+            checkpoint_interval=0, ps_timeout_ms=4000,
+        )
+
+        real_place = PSWorker._place
+        state = {"calls": 0, "crashed": False}
+
+        def flaky_place(device, *arrays):
+            state["calls"] += 1
+            if not state["crashed"] and state["calls"] == 3:
+                state["crashed"] = True
+                raise RuntimeError("injected crash before first checkpoint")
+            return real_place(device, *arrays)
+
+        monkeypatch.setattr(PSWorker, "_place", staticmethod(flaky_place))
+        group = ServerGroup(2, 2, ps_param_dim(cfg), learning_rate=0.5, sync=True)
+        with group:
+            with pytest.raises(Exception):
+                run_ps_workers(cfg, group.hosts, range(2), save=False)
+            assert state["crashed"]
+            sidecar = os.path.join(ck, "ps_latest.json")
+            assert not os.path.exists(sidecar)  # crash predates any ckpt
+
+            monkeypatch.setattr(PSWorker, "_place", staticmethod(real_place))
+            resumed = run_ps_workers(
+                cfg, group.hosts, range(2), save=False, resume=True,
+            )
+        with open(sidecar) as f:
+            sc = json.load(f)
+        assert sc["attempt"] == 1
+        assert sc["epoch"] == 3  # final checkpoint of the resumed run
+
+        # Oracle: from-scratch run, fresh group, fresh checkpoint dir
+        # (sync full-batch is deterministic; same Q2 deterministic init).
+        ref = run_ps_local(
+            cfg.replace(checkpoint_dir=str(tmp_path / "ck_ref")), save=False,
+        )
+        np.testing.assert_allclose(resumed[0], ref[0], rtol=1e-5, atol=1e-6)

@@ -43,6 +43,88 @@ class TestGlobalShardedData:
         batches = list(g.batches(-1))
         assert len(batches) == 1 and batches[0][0].shape == (8, 2)
 
+    def test_wrap_batches_match_dataiter_q5(self):
+        """batches(wrap=True) must reproduce the reference Q5 wraparound
+        exactly as DataIter(wrap_compat=True) does (the PS-path parity
+        oracle): the short final batch re-serves leading shard samples."""
+        from distlr_tpu.data import DataIter
+
+        rng = np.random.default_rng(3)
+        X = rng.normal(size=(10, 2)).astype(np.float32)
+        y = (rng.random(10) < 0.5).astype(np.int32)
+        g = GlobalShardedData([(X, y)])
+        it = DataIter(X, y, batch_size=4, wrap_compat=True)
+        got = list(g.batches(4, wrap=True))
+        want = list(it)
+        assert len(got) == len(want) == 3
+        for (Xg, yg, mg), (Xw, yw, mw) in zip(got, want):
+            np.testing.assert_array_equal(Xg, Xw)
+            np.testing.assert_array_equal(yg, yw)
+            assert mg.all() and mw.all()  # wrapped rows are REAL samples
+        # last batch holds the tail (8, 9) then wraps to the head (0, 1)
+        np.testing.assert_array_equal(got[-1][0], X[[8, 9, 0, 1]])
+
+    def test_wrap_rejects_unequal_shards(self):
+        shards = [
+            (np.ones((5, 2), np.float32), np.zeros(5, np.int32)),
+            (np.ones((3, 2), np.float32), np.zeros(3, np.int32)),
+        ]
+        g = GlobalShardedData(shards)
+        with pytest.raises(ValueError, match="wrap_final_batch"):
+            list(g.batches(2, wrap=True))
+        # the SHORT shard needs the wrap too (5 % 5 == 0 but 3 % 5 != 0) —
+        # keying the check on n_pad alone would silently serve padding here
+        with pytest.raises(ValueError, match="wrap_final_batch"):
+            list(g.batches(5, wrap=True))
+        # batch=-1 is one whole-shard batch: no wrap in the reference either
+        assert len(list(g.batches(-1, wrap=True))) == 1
+
+    def test_wrap_triggers_on_real_shard_sizes_not_padded(self):
+        """Sizes [8, 7] with b=4: n_pad % b == 0, but the short shard DOES
+        wrap in the reference — silent padded fall-through is the bug the
+        loud rejection exists to prevent."""
+        shards = [
+            (np.ones((8, 2), np.float32), np.zeros(8, np.int32)),
+            (np.ones((7, 2), np.float32), np.zeros(7, np.int32)),
+        ]
+        g = GlobalShardedData(shards)
+        with pytest.raises(ValueError, match="wrap_final_batch"):
+            list(g.batches(4, wrap=True))
+
+    def test_wrap_batch_larger_than_shard_cycles(self):
+        """b=5 over a 3-sample shard: the reference serves ONE 5-row batch
+        cycling the shard ([0,1,2,0,1]) — not a clamped 3-row batch."""
+        from distlr_tpu.data import DataIter
+
+        X = np.arange(6, dtype=np.float32).reshape(3, 2)
+        y = np.array([1, 0, 1], np.int32)
+        g = GlobalShardedData([(X, y)])
+        got = list(g.batches(5, wrap=True))
+        want = list(DataIter(X, y, batch_size=5, wrap_compat=True))
+        assert len(got) == len(want) == 1
+        np.testing.assert_array_equal(got[0][0], want[0][0])
+        np.testing.assert_array_equal(got[0][0], X[[0, 1, 2, 0, 1]])
+        assert got[0][2].all()
+
+    def test_trainer_reference_mode_uses_wrap(self, tmp_path):
+        """compat_mode='reference' must thread Q5 into the sync Trainer's
+        batching (ADVICE r2: the flag was silently ignored here)."""
+        d = str(tmp_path / "wrapdata")
+        # 300 samples over 1 shard, batch 64 -> short final batch
+        write_synthetic_shards(d, 300, 8, num_parts=1, seed=4, sparsity=0.0)
+        mesh = make_mesh({"data": 1})
+        base = dict(
+            data_dir=d, num_feature_dim=8, num_iteration=4, batch_size=64,
+            learning_rate=0.3, test_interval=0,
+        )
+        w_ref = Trainer(Config(compat_mode="reference", **base), mesh=mesh).fit()
+        w_cor = Trainer(Config(compat_mode="correct", sync_last_gradient=False,
+                               l2_scale_by_batch=True, reference_rng_init=True,
+                               **base), mesh=mesh).fit()
+        # identical except Q5: wrapped duplicates shift the final-batch
+        # gradient, so the trajectories must DIVERGE (teeth check)
+        assert not np.allclose(np.asarray(w_ref), np.asarray(w_cor))
+
     def test_from_data_dir_resharding(self, data_dir):
         g = GlobalShardedData.from_data_dir(data_dir, "train", 4, 24)
         assert g.num_shards == 4
