@@ -27,16 +27,23 @@ _PROBE_SRC = (
 )
 
 
-def probe_default_backend(timeout_s: float | None = None) -> tuple[str, int] | None:
+def probe_default_backend_ex(
+    timeout_s: float | None = None,
+) -> tuple[str, tuple[str, int] | None]:
     """Run one tiny computation on the default backend in a subprocess.
 
-    Returns ``(backend_name, n_devices)`` if the backend completes a
-    dispatch+readback within ``timeout_s``, else ``None`` (hung backend,
-    import error, or crash).  Never initializes a backend in-process.
+    Returns ``(status, payload)``:
 
-    Default timeout is 60s (override via ``DISTLR_PROBE_TIMEOUT_S``) — it
-    must stay comfortably inside any outer artifact-timeout budget, or a
-    hung probe turns back into the hung-artifact failure it prevents.
+    * ``("ok", (backend_name, n_devices))`` — live backend,
+    * ``("timeout", None)`` — the probe HUNG (wedged tunnel; transient,
+      worth retrying),
+    * ``("error", None)`` — the probe crashed or printed garbage
+      (broken install; permanent, retrying is pointless).
+
+    Never initializes a backend in-process.  Default timeout is 60s
+    (override via ``DISTLR_PROBE_TIMEOUT_S``) — it must stay comfortably
+    inside any outer artifact-timeout budget, or a hung probe turns back
+    into the hung-artifact failure it prevents.
     """
     if timeout_s is None:
         timeout_s = float(os.environ.get("DISTLR_PROBE_TIMEOUT_S", "60"))
@@ -47,17 +54,26 @@ def probe_default_backend(timeout_s: float | None = None) -> tuple[str, int] | N
             text=True,
             timeout=timeout_s,
         )
-    except (subprocess.TimeoutExpired, OSError):
-        return None
+    except subprocess.TimeoutExpired:
+        return "timeout", None
+    except OSError:
+        return "error", None
     if out.returncode != 0:
-        return None
+        return "error", None
     try:
         name, n, v = out.stdout.split()
         if float(v) != 8.0:
-            return None
-        return name, int(n)
+            return "error", None
+        return "ok", (name, int(n))
     except ValueError:
-        return None
+        return "error", None
+
+
+def probe_default_backend(timeout_s: float | None = None) -> tuple[str, int] | None:
+    """Back-compat wrapper: ``(backend_name, n_devices)`` or ``None``
+    (hung OR broken — callers that care which use
+    :func:`probe_default_backend_ex`)."""
+    return probe_default_backend_ex(timeout_s)[1]
 
 
 def force_cpu(n_devices: int | None = None) -> None:
