@@ -1,0 +1,131 @@
+"""Roofline experiment 4: integer dot_general on the D=1M LR step.
+
+ROOFLINE.md pinned int8-stored X at 151-154k samples/s: the win over
+bf16 (~139k) is small because XLA converts the whole (B, D) int8 tile to
+bf16/f32 before the dot, and that convert is VPU-bound at roughly the
+same rate as the HBM stream it replaced.  This experiment dodges the
+convert entirely: keep BOTH dot operands int8 and ask the MXU for a
+native int8 x int8 -> int32 contraction via
+``lax.dot_general(..., preferred_element_type=int32)``, quantizing the
+small operands (w over D, r over B) per step instead of the huge one.
+
+  z = (X_int @ w_q) * (s_w / 127)          x_real = X_int / 127
+  g = (r_q @ X_int) * (s_r / 127) / B      w ~ w_q * s_w,  r ~ r_q * s_r
+
+Per-step quantization touches D + B elements, vs the 2*B*D-element
+convert in the naive int8 path.  Variants:
+
+  1. bf16 matmul                 (headline calibration, = variants #1)
+  2. int8 -> bf16 convert matmul (the 151k convert wall, = variants #4)
+  3. int8 MXU dot, per-step w/r quantization (dynamic scale)
+  4. int8 MXU dot, fixed scales  (isolates quantization overhead)
+
+Run on the real chip: python benchmarks/exp_int8_dot.py
+"""
+
+from __future__ import annotations
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+B, D, STEPS = 2048, 1_000_000, 10
+LR = 0.2
+INT32 = jnp.int32
+
+
+def _time_steps(run, w, *args):
+    w2 = run(w, *args)
+    assert np.isfinite(float(jnp.sum(w2)))
+    t0 = time.perf_counter()
+    w2 = run(w, *args)
+    float(jnp.sum(w2))
+    return time.perf_counter() - t0
+
+
+def _report(name, dt):
+    print(f"{name}: {B*STEPS/dt:12,.0f} samples/s")
+
+
+def scan_steps(step):
+    @jax.jit
+    def run(w, *args):
+        def body(w, _):
+            return step(w, *args), None
+        w, _ = jax.lax.scan(body, w, None, length=STEPS)
+        return w
+    return run
+
+
+def int8_dot(a, b):
+    """a (.., K) int8  @  b (K, ..) int8  ->  int32, on the MXU."""
+    return jax.lax.dot_general(
+        a, b, (((a.ndim - 1,), (0,)), ((), ())),
+        preferred_element_type=INT32)
+
+
+def quantize(x, scale):
+    q = jnp.clip(jnp.round(x / scale), -127, 127)
+    return q.astype(jnp.int8)
+
+
+def main():
+    print(f"backend={jax.default_backend()} B={B} D={D} steps={STEPS}")
+    k = jax.random.PRNGKey(0)
+    kx, ky = jax.random.split(k)
+    Xi = jax.block_until_ready(
+        jax.random.randint(kx, (B, D), -127, 128, dtype=jnp.int8))
+    y = jax.block_until_ready(
+        jax.random.bernoulli(ky, 0.5, (B,)).astype(jnp.float32))
+    w0 = jnp.zeros(D, jnp.float32)
+
+    # 1. bf16 matmul calibration (X converted once outside the loop)
+    Xb = jax.block_until_ready(Xi.astype(jnp.bfloat16) * jnp.bfloat16(1 / 127))
+
+    def step1(w, X, y):
+        z = (X @ w.astype(jnp.bfloat16)).astype(jnp.float32)
+        r = jax.nn.sigmoid(z) - y
+        g = (r.astype(jnp.bfloat16) @ X).astype(jnp.float32) / B
+        return w - LR * g
+    _report("1 bf16 matmul (calibration) ", _time_steps(scan_steps(step1), w0, Xb, y))
+    del Xb
+
+    # 2. int8 X, per-step convert to bf16 (the known 151k wall)
+    def step2(w, X, y):
+        Xf = X.astype(jnp.bfloat16)
+        z = (Xf @ w.astype(jnp.bfloat16)).astype(jnp.float32) * (1 / 127)
+        r = jax.nn.sigmoid(z) - y
+        g = (r.astype(jnp.bfloat16) @ Xf).astype(jnp.float32) / (127 * B)
+        return w - LR * g
+    _report("2 int8->bf16 convert matmul ", _time_steps(scan_steps(step2), w0, Xi, y))
+
+    # 3. int8 MXU dot, dynamic per-step scales for w and r
+    def step3(w, X, y):
+        s_w = jnp.maximum(jnp.max(jnp.abs(w)), 1e-8) / 127
+        wq = quantize(w, s_w)
+        z = int8_dot(X, wq).astype(jnp.float32) * (s_w / 127)
+        r = jax.nn.sigmoid(z) - y
+        s_r = jnp.maximum(jnp.max(jnp.abs(r)), 1e-8) / 127
+        rq = quantize(r, s_r)
+        g = int8_dot(rq, X).astype(jnp.float32) * (s_r / (127 * B))
+        return w - LR * g
+    _report("3 int8 MXU dot, dyn scales  ", _time_steps(scan_steps(step3), w0, Xi, y))
+
+    # 4. int8 MXU dot, fixed scales (no max-reduces: pure dot cost)
+    S_W = jnp.float32(1 / 127)  # assumes |w| <= 1; fine for a probe
+    S_R = jnp.float32(1 / 127)  # residual in (-1, 1) always
+
+    def step4(w, X, y):
+        wq = quantize(w, S_W)
+        z = int8_dot(X, wq).astype(jnp.float32) * (S_W / 127)
+        r = jax.nn.sigmoid(z) - y
+        rq = quantize(r, S_R)
+        g = int8_dot(rq, X).astype(jnp.float32) * (S_R / (127 * B))
+        return w - LR * g
+    _report("4 int8 MXU dot, fixed scales", _time_steps(scan_steps(step4), w0, Xi, y))
+
+
+if __name__ == "__main__":
+    main()
