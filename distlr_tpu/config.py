@@ -78,6 +78,14 @@ class Config:
     # blocked_lr: lanes per table row (params = num_feature_dim, rows =
     # num_feature_dim / block_size) — see data/hashing.hash_group_blocks.
     block_size: int = 8
+    # blocked_lr from disk: number of raw categorical fields per row in
+    # raw-CTR shards (data/hashing.write_raw_ctr_shards).  0 = read it
+    # from the data dir's ctr_meta.json manifest at load time.
+    ctr_fields: int = 0
+    # Seed of the load-time feature hash (hash_group_blocks); train and
+    # test splits of one run always share it, so it only matters for
+    # reproducing a specific bucket assignment across runs.
+    hash_seed: int = 0
     dtype: str = "float32"            # accumulation dtype
     compute_dtype: str = "bfloat16"   # matmul dtype on TPU (MXU-friendly)
     # Device-resident storage dtype of DENSE feature matrices. The dense
@@ -158,14 +166,21 @@ class Config:
             raise ValueError(
                 f"feature_dtype must be float32|bfloat16|int8, got {self.feature_dtype!r}"
             )
-        if self.model == "sparse_lr" and self.feature_dtype != "float32":
+        if self.model in ("sparse_lr", "blocked_lr") and self.feature_dtype != "float32":
             # Quantized resident feature storage is a dense-matrix
-            # capability; sparse COO vals stay float32 in every mode.
-            # Fail here so sync and PS reject the combination identically.
+            # capability; sparse COO / blocked lane vals stay float32 in
+            # every mode. Fail here so sync and PS reject identically.
             raise ValueError(
                 "feature_dtype quantization applies to dense models only; "
-                "sparse_lr stores COO vals as float32 (set feature_dtype='float32')"
+                f"{self.model} stores feature values as float32 "
+                "(set feature_dtype='float32')"
             )
+        if self.ctr_fields < 0:
+            raise ValueError("ctr_fields must be >= 0 (0 = read from manifest)")
+        if not 0 <= self.hash_seed < 1 << 64:
+            # caught here as a config error, not an OverflowError deep in
+            # splitmix64's uint64 arithmetic after data already parsed
+            raise ValueError(f"hash_seed must be in [0, 2^64), got {self.hash_seed}")
         if self.ps_compute_backend not in ("auto", "cpu", "default"):
             raise ValueError(
                 f"ps_compute_backend must be auto|cpu|default, got {self.ps_compute_backend!r}"

@@ -1,11 +1,15 @@
 from distlr_tpu.data.libsvm import parse_libsvm_file, parse_libsvm_lines, write_libsvm  # noqa: F401
-from distlr_tpu.data.iterator import DataIter  # noqa: F401
+from distlr_tpu.data.iterator import BlockedDataIter, DataIter, SparseDataIter  # noqa: F401
 from distlr_tpu.data.synthetic import make_synthetic_dataset, write_synthetic_shards  # noqa: F401
 from distlr_tpu.data.sharding import shard_libsvm_file, prepare_data_dir  # noqa: F401
 from distlr_tpu.data.hashing import (  # noqa: F401
     HashedFeatureEncoder,
     csr_to_padded_coo,
+    encode_blocked,
     hash_buckets,
     make_ctr_dataset,
+    read_ctr_meta,
+    read_raw_ctr_file,
     write_ctr_shards,
+    write_raw_ctr_shards,
 )

@@ -32,10 +32,15 @@ __all__ = [
     "splitmix64",
     "hash_buckets",
     "hash_group_blocks",
+    "default_field_groups",
+    "encode_blocked",
     "HashedFeatureEncoder",
     "csr_to_padded_coo",
     "make_ctr_dataset",
     "write_ctr_shards",
+    "write_raw_ctr_shards",
+    "read_raw_ctr_file",
+    "read_ctr_meta",
 ]
 
 _U64 = np.uint64
@@ -133,6 +138,39 @@ def hash_group_blocks(raw_ids, field_groups, num_blocks: int, *, seed: int = 0,
             key = splitmix64(key ^ splitmix64(vj + splitmix64(fj + _U64(0x9E))))
     blocks = (key % _U64(num_blocks)).astype(np.int64)
     return blocks, lane_vals
+
+
+def default_field_groups(num_fields: int, block_size: int) -> np.ndarray:
+    """Consecutive grouping: fields 0..F-1 chunked into ceil(F/R) groups
+    of R, the last padded with -1.
+
+    The grouping is a statistical knob (co-hashed fields share a
+    conjunction key — see :func:`hash_group_blocks`); consecutive chunks
+    are the neutral default when no field-cardinality information exists.
+    """
+    g_count = -(-num_fields // block_size)
+    groups = np.full((g_count, block_size), -1, dtype=np.int64)
+    flat = groups.reshape(-1)
+    flat[:num_fields] = np.arange(num_fields)
+    return groups
+
+
+def encode_blocked(raw_ids, num_blocks: int, block_size: int, *, seed: int = 0,
+                   raw_vals=None, field_groups=None):
+    """Raw ``(N, F)`` categorical ids -> ``BlockedSparseLR`` batch leaves
+    ``(blocks, lane_vals)`` using the default consecutive grouping.
+
+    The one load-time call sites use; keeps the train/test splits of a
+    run hashing identically as long as they share ``seed`` and shape.
+    Returns ``(blocks (N, G) int32, lane_vals (N, G, R) float32)``.
+    """
+    raw_ids = np.asarray(raw_ids, dtype=np.int64)
+    if field_groups is None:
+        field_groups = default_field_groups(raw_ids.shape[1], block_size)
+    blocks, lane_vals = hash_group_blocks(
+        raw_ids, field_groups, num_blocks, seed=seed, raw_vals=raw_vals
+    )
+    return blocks.astype(np.int32), lane_vals
 
 
 @dataclasses.dataclass(frozen=True)
@@ -300,3 +338,154 @@ def write_ctr_shards(
     w_path = os.path.join(data_dir, "w_true.npy")
     np.save(w_path, w_true)
     return {"train_parts": parts, "test_path": test_path, "w_true_path": w_path}
+
+
+_CTR_META = "ctr_meta.json"
+
+
+def read_ctr_meta(data_dir: str) -> dict | None:
+    """The raw-CTR manifest written by :func:`write_raw_ctr_shards`
+    (None when the dir holds plain libsvm / hashed shards instead)."""
+    import json  # noqa: PLC0415
+
+    path = os.path.join(data_dir, _CTR_META)
+    if not os.path.exists(path):
+        return None
+    with open(path) as f:
+        return json.load(f)
+
+
+def resolve_ctr_fields(data_dir: str, ctr_fields: int) -> int:
+    """The raw field count for blocked loading: an explicit
+    ``cfg.ctr_fields`` wins; otherwise the data dir's manifest."""
+    if ctr_fields:
+        return int(ctr_fields)
+    meta = read_ctr_meta(data_dir)
+    if meta is None:
+        raise FileNotFoundError(
+            f"{data_dir} has no {_CTR_META} manifest and cfg.ctr_fields is 0 "
+            "— blocked_lr needs the raw field count (write shards with "
+            "write_raw_ctr_shards / `launch gen-data --ctr-fields F "
+            "--ctr-raw`, or set ctr_fields)"
+        )
+    return int(meta["num_fields"])
+
+
+def write_raw_ctr_shards(
+    data_dir: str,
+    num_samples: int,
+    num_fields: int,
+    vocab_size: int,
+    num_parts: int,
+    *,
+    seed: int = 0,
+    test_fraction: float = 0.2,
+) -> dict:
+    """Write RAW categorical CTR shards: reference-layout parts whose rows
+    are ``±1 field:id ...`` with 1-based field numbers and the raw
+    categorical id as the "value".
+
+    Unlike :func:`write_ctr_shards` (which bakes scalar bucket hashing
+    into the bytes on disk), this format is **hash-scheme agnostic**: the
+    same shard trains the scalar one-hot path (`hash_buckets` at load
+    time) or the row-blocked path (`hash_group_blocks`) — the hashing is
+    a load-time choice, exactly like the encoder split the roofline study
+    calls for (benchmarks/ROOFLINE.md, row-blocked section).  Labels come
+    from the same hashed-ground-truth logistic model as
+    :func:`make_ctr_dataset`, so signal recovery stays assertable.
+
+    A ``ctr_meta.json`` manifest records ``num_fields``/``vocab``/``seed``
+    so loaders need no side-channel configuration.  Raw ids ride the
+    libsvm float value slot; float32 is exact below 2**24, enforced here.
+    """
+    import json  # noqa: PLC0415
+
+    from distlr_tpu.data.sharding import part_name  # noqa: PLC0415
+
+    if vocab_size >= 1 << 24:
+        raise ValueError(
+            f"vocab_size {vocab_size} exceeds float32's exact-integer range "
+            "(2^24); raw ids would corrupt in the libsvm value slot"
+        )
+    raw_ids, _, _, y, w_true = make_ctr_dataset(
+        num_samples, num_fields, vocab_size, max(num_fields * 64, 1024),
+        seed=seed,
+    )
+    n_test = int(num_samples * test_fraction)
+    os.makedirs(os.path.join(data_dir, "train"), exist_ok=True)
+    os.makedirs(os.path.join(data_dir, "test"), exist_ok=True)
+    os.makedirs(os.path.join(data_dir, "models"), exist_ok=True)
+
+    def _write(path, ids, labels):
+        with open(path, "w") as f:
+            for i in range(len(labels)):
+                toks = [str(2 * int(labels[i]) - 1)]
+                toks += [f"{j + 1}:{int(ids[i, j])}" for j in range(num_fields)]
+                f.write(" ".join(toks) + "\n")
+
+    itr, ite = raw_ids[n_test:], raw_ids[:n_test]
+    ytr, yte = y[n_test:], y[:n_test]
+    parts = []
+    for i in range(num_parts):
+        sl = slice(i * len(ytr) // num_parts, (i + 1) * len(ytr) // num_parts)
+        path = os.path.join(data_dir, "train", part_name(i))
+        _write(path, itr[sl], ytr[sl])
+        parts.append(path)
+    test_path = os.path.join(data_dir, "test", part_name(0))
+    _write(test_path, ite, yte)
+    meta = {
+        "format": "raw_ctr",
+        "num_fields": num_fields,
+        "vocab_size": vocab_size,
+        "seed": seed,
+    }
+    with open(os.path.join(data_dir, _CTR_META), "w") as f:
+        json.dump(meta, f)
+    w_path = os.path.join(data_dir, "w_true.npy")
+    np.save(w_path, w_true)
+    return {"train_parts": parts, "test_path": test_path,
+            "w_true_path": w_path, "meta": meta}
+
+
+def read_raw_ctr_file(path: str, num_fields: int):
+    """Parse one raw-CTR shard -> ``(raw_ids (N, F) int64, y (N,) int32)``.
+
+    Rides the existing libsvm parser (native fast path included): field
+    numbers arrive as CSR columns, raw ids as float32 values (exact below
+    2^24 by the writer's contract).  Every row must carry all F fields —
+    raw-CTR is a dense-fields format, unlike one-hot libsvm.
+    """
+    from distlr_tpu.data.libsvm import parse_libsvm_file  # noqa: PLC0415
+
+    # num_features=None: keep ALL columns, so a shard with MORE fields
+    # than expected fails the checks below instead of being silently
+    # truncated to a passing width by the parser's column filter.
+    (row_ptr, cols, vals), y = parse_libsvm_file(path, None, dense=False)
+    n = len(y)
+    lengths = np.diff(row_ptr)
+    if n and not (lengths == num_fields).all():
+        bad = int(np.argmax(lengths != num_fields))
+        raise ValueError(
+            f"{path}: row {bad} has {int(lengths[bad])} fields, expected "
+            f"{num_fields} (raw-CTR rows carry every field)"
+        )
+    if n and ((cols < 0).any() or (cols >= num_fields).any()):
+        bad = int(cols[(cols < 0) | (cols >= num_fields)][0]) + 1
+        raise ValueError(
+            f"{path}: field number {bad} outside 1..{num_fields}"
+        )
+    if (vals < 0).any():
+        raise ValueError(f"{path}: raw-CTR ids must be non-negative")
+    # rows may list fields in any order; cols give the 0-based field slot.
+    # -1 fill + post-check: a duplicated field number passes the length
+    # check but leaves its partner slot unwritten — garbage must reject,
+    # not train.
+    raw_ids = np.full((n, num_fields), -1, np.int64)
+    raw_ids[np.repeat(np.arange(n), num_fields), cols] = vals.astype(np.int64)
+    if (raw_ids < 0).any():
+        bad = int(np.argmax((raw_ids < 0).any(axis=1)))
+        raise ValueError(
+            f"{path}: row {bad} repeats a field number (every field must "
+            "appear exactly once)"
+        )
+    return raw_ids, y

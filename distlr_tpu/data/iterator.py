@@ -146,3 +146,43 @@ class SparseDataIter(DataIter):
     def next_batch(self):
         idx, mask = self._next_idx()
         return self.X[idx], self.vals[idx], self.y[idx], mask
+
+
+class BlockedDataIter(DataIter):
+    """Row-blocked variant: yields ``(blocks, lane_vals, y, mask)`` —
+    the :class:`distlr_tpu.models.BlockedSparseLR` batch layout.
+
+    ``blocks`` is ``(B, G)`` int32 table-row ids, ``lane_vals`` is
+    ``(B, G, R)`` float32 per-lane values (zero = padded lane).  Same
+    epoch/batching semantics as :class:`DataIter`.
+    """
+
+    def __init__(self, blocks, lane_vals, y, batch_size: int = -1, **kw):
+        blocks = np.asarray(blocks)
+        self.lane_vals = np.asarray(lane_vals)
+        if blocks.shape != self.lane_vals.shape[:2]:
+            raise ValueError(
+                f"blocks {blocks.shape} vs lane_vals {self.lane_vals.shape}"
+            )
+        super().__init__(blocks, y, batch_size, **kw)
+
+    @property
+    def blocks(self) -> np.ndarray:
+        return self.X
+
+    @classmethod
+    def from_file(cls, path, num_fields: int, num_blocks: int, block_size: int,
+                  batch_size: int = -1, *, seed: int = 0, **kw):
+        """Parse a raw-CTR shard (``write_raw_ctr_shards`` format) and
+        hash its field groups into block rows at load time."""
+        from distlr_tpu.data.hashing import encode_blocked, read_raw_ctr_file  # noqa: PLC0415
+
+        raw_ids, y = read_raw_ctr_file(path, num_fields)
+        blocks, lane_vals = encode_blocked(
+            raw_ids, num_blocks, block_size, seed=seed
+        )
+        return cls(blocks, lane_vals, y, batch_size, **kw)
+
+    def next_batch(self):
+        idx, mask = self._next_idx()
+        return self.X[idx], self.lane_vals[idx], self.y[idx], mask

@@ -40,10 +40,18 @@ def _add_config_flags(p: argparse.ArgumentParser) -> None:
     p.add_argument("--learning-rate", dest="learning_rate", type=float)
     p.add_argument("--l2-c", dest="l2_c", type=float)
     p.add_argument("--test-interval", dest="test_interval", type=int)
-    p.add_argument("--model", choices=["binary_lr", "softmax", "sparse_lr"])
+    p.add_argument("--model", choices=["binary_lr", "softmax", "sparse_lr", "blocked_lr"])
     p.add_argument("--num-classes", dest="num_classes", type=int)
     p.add_argument("--nnz-max", dest="nnz_max", type=int,
                    help="sparse_lr: cap per-row nonzeros (pad width)")
+    p.add_argument("--block-size", dest="block_size", type=int,
+                   help="blocked_lr: lanes per table row (table rows = "
+                   "num-feature-dim / block-size)")
+    p.add_argument("--ctr-fields", dest="ctr_fields", type=int,
+                   help="blocked_lr: raw categorical fields per row "
+                   "(default: read from the data dir's ctr_meta.json)")
+    p.add_argument("--hash-seed", dest="hash_seed", type=int,
+                   help="seed of the load-time feature hash")
     p.add_argument("--compat-mode", dest="compat_mode", choices=["correct", "reference"])
     p.add_argument("--feature-dtype", dest="feature_dtype",
                    choices=["float32", "bfloat16", "int8"],
@@ -88,7 +96,7 @@ def _config_from_args(args: argparse.Namespace) -> Config:
             "learning_rate", "l2_c", "test_interval", "model", "num_classes",
             "nnz_max", "compat_mode", "checkpoint_dir", "checkpoint_interval",
             "profile_dir", "num_workers", "num_servers", "ps_compute_backend",
-            "feature_dtype",
+            "feature_dtype", "block_size", "ctr_fields", "hash_seed",
         }
     }
     cfg = Config.from_env(**overrides)
@@ -143,12 +151,31 @@ def _maybe_init_distributed(args: argparse.Namespace) -> None:
 
 
 def cmd_gen_data(args: argparse.Namespace) -> int:
+    if args.ctr_raw and not args.ctr_fields:
+        print("error: --ctr-raw requires --ctr-fields", file=sys.stderr)
+        return 2
     if args.ctr_fields:
         if args.num_classes != 2 or args.sparsity != 0.5:
             print("error: --num-classes/--sparsity do not apply to CTR shards "
-                  "(--ctr-fields writes binary-label hashed one-hot data)",
+                  "(--ctr-fields writes binary-label CTR data)",
                   file=sys.stderr)
             return 2
+        if args.ctr_raw:
+            # Raw categorical shards (hash-scheme-agnostic): the blocked_lr
+            # on-disk format; scalar hashing can also be applied at load.
+            from distlr_tpu.data.hashing import write_raw_ctr_shards  # noqa: PLC0415
+
+            manifest = write_raw_ctr_shards(
+                args.data_dir,
+                args.num_samples,
+                args.ctr_fields,
+                args.ctr_vocab,
+                args.num_parts,
+                seed=args.seed,
+            )
+            log.info("wrote %d raw-CTR train shards + test to %s",
+                     len(manifest["train_parts"]), args.data_dir)
+            return 0
         # Hashed one-hot CTR shards (sparse_lr workloads): num-feature-dim
         # is the bucket count, --ctr-vocab the raw categorical vocabulary.
         from distlr_tpu.data.hashing import write_ctr_shards  # noqa: PLC0415
@@ -280,6 +307,10 @@ def main(argv=None) -> int:
                    "--num-feature-dim becomes the bucket count)")
     g.add_argument("--ctr-vocab", type=int, default=100_000,
                    help="raw categorical vocabulary size for --ctr-fields")
+    g.add_argument("--ctr-raw", action="store_true",
+                   help="with --ctr-fields: write RAW categorical shards "
+                   "(hash-scheme-agnostic; the blocked_lr on-disk format) "
+                   "instead of pre-hashed one-hot rows")
     g.set_defaults(fn=cmd_gen_data)
 
     s = sub.add_parser("sync", help="synchronous SPMD training (one process)")

@@ -143,6 +143,43 @@ def _sparse_batch_grad(w_u, pos, vals, y, mask, l2_c, l2_scale_by_batch):
     return g
 
 
+def _expand_block_keys(blocks: np.ndarray, block_size: int) -> np.ndarray:
+    """Unique block-row ids -> their flat KV keys (row b owns the
+    contiguous range ``[b*R, (b+1)*R)`` of the ``ps_param_dim`` key
+    space — the row-major layout of the (num_blocks, R) table)."""
+    r = np.arange(block_size, dtype=np.uint64)
+    return (blocks.astype(np.uint64)[:, None] * np.uint64(block_size) + r).reshape(-1)
+
+
+def _blocked_batch_grad(t_u, pos, lane_vals, y, mask, l2_c, l2_scale_by_batch):
+    """Gradient of the blocked LR loss wrt the batch's UNIQUE touched
+    table rows (numpy, host-side).
+
+    Mirrors ``BlockedSparseLR.grad`` (models/linear.py) restricted to the
+    touched row set: ``t_u`` is the ``(n_u, R)`` pulled slice, ``pos``
+    maps each (sample, group) to its row in ``t_u``.  Like the sparse
+    path, L2 is applied lazily — and at ROW granularity: a gathered row
+    decays as a unit (all R lanes), because the row is the parameter unit
+    of this model (one conjunction's weights).
+    """
+    z = (t_u[pos] * lane_vals).sum(axis=(-1, -2))
+    sig = 0.5 * (1.0 + np.tanh(0.5 * z))  # overflow-stable sigmoid
+    n = np.float32(max(mask.sum(), 1))
+    resid = ((sig - y) * mask).astype(np.float32)
+    contrib = (resid[:, None, None] * lane_vals).reshape(-1, t_u.shape[1]) / n
+    g = np.zeros_like(t_u, dtype=np.float32)
+    np.add.at(g, pos.reshape(-1), contrib)
+    if l2_c:
+        # Padded groups (all-zero lanes) alias row pos of block id 0's
+        # slot; only rows gathered with a real (nonzero) lane decay.
+        touched = (lane_vals != 0).any(axis=-1).reshape(-1)
+        active = np.zeros(len(t_u), bool)
+        np.logical_or.at(active, pos.reshape(-1), touched)
+        term = np.float32(l2_c) * t_u * active[:, None]
+        g += term / n if l2_scale_by_batch else term
+    return g
+
+
 def _ps_resume_state(cfg: Config, rank: int):
     """``(start_epoch, weights | None, attempt | None)`` from
     ``cfg.checkpoint_dir`` (``attempt`` is None when no sidecar exists).
@@ -240,13 +277,13 @@ class PSWorker:
                 "trainer's device-resident features; PS mode streams "
                 "host batches (set feature_dtype='float32')"
             )
-        if cfg.model == "sparse_lr" and cfg.sync_last_gradient:
+        if cfg.model in ("sparse_lr", "blocked_lr") and cfg.sync_last_gradient:
             # Q1 is a dense-reference parity quirk; with keyed pushes
             # "the last worker's gradient" touches an arbitrary key
             # subset per server — no reference behavior exists to mirror.
             raise ValueError(
                 "sync_last_gradient (Q1 compat) is a dense-model parity "
-                "quirk; sparse_lr PS training requires the correct-mean "
+                f"quirk; {cfg.model} PS training requires the correct-mean "
                 "update (compat_mode='correct')"
             )
         self.kv = KVWorker(
@@ -255,11 +292,12 @@ class PSWorker:
         )
         self._train_iter = train_iter
         self._test_iter = test_iter
-        # sparse_lr never uses the jitted dense-batch fns (its per-batch
-        # unique-key count varies, so it runs numpy host math instead —
-        # _sparse_batch_grad); building them would plant a lambda whose
-        # (X, y, mask) signature crashes on padded-COO batches.
-        if cfg.model == "sparse_lr":
+        # Keyed models never use the jitted dense-batch fns (their
+        # per-batch unique-key count varies, so they run numpy host math
+        # instead — _sparse_batch_grad / _blocked_batch_grad); building
+        # them would plant a lambda whose (X, y, mask) signature crashes
+        # on padded-COO / blocked batches.
+        if cfg.model in ("sparse_lr", "blocked_lr"):
             self._grad_fn = self._acc_fn = None
         else:
             self._grad_fn = _compiled_fns(self.model, cfg.l2_c, bool(cfg.l2_scale_by_batch))
@@ -268,19 +306,30 @@ class PSWorker:
         self.final_weights: np.ndarray | None = None
         self._barrier_base = 0
         self._sidecar_attempt = 0
-        if cfg.model == "sparse_lr" and cfg.l2_c > 0:
-            # Sparse PS applies L2 lazily (only a batch's touched keys
-            # decay, scaled by touch frequency) while the sync sparse
-            # trainer decays every weight every step — same l2_c,
-            # different effective regularization (PARITY.md).
+        if cfg.model in ("sparse_lr", "blocked_lr") and cfg.l2_c > 0:
+            # Keyed PS applies L2 lazily (only a batch's touched keys/rows
+            # decay, scaled by touch frequency) while the sync trainer
+            # decays every weight every step — same l2_c, different
+            # effective regularization (PARITY.md).
             log.warning(
-                "sparse_lr PS mode applies L2 lazily (touched keys only); "
+                "%s PS mode applies L2 lazily (touched keys only); "
                 "effective regularization differs from the sync trainer "
-                "at the same l2_c — see PARITY.md"
+                "at the same l2_c — see PARITY.md", cfg.model
             )
 
     def _param_dim(self) -> int:
         return ps_param_dim(self.cfg)
+
+    def _blocked_iter(self, path: str, batch_size: int, *, wrap=False):
+        from distlr_tpu.data.hashing import resolve_ctr_fields  # noqa: PLC0415
+        from distlr_tpu.data.iterator import BlockedDataIter  # noqa: PLC0415
+
+        cfg = self.cfg
+        return BlockedDataIter.from_file(
+            path, resolve_ctr_fields(cfg.data_dir, cfg.ctr_fields),
+            cfg.num_feature_dim // cfg.block_size, cfg.block_size,
+            batch_size, seed=cfg.hash_seed, wrap_compat=wrap,
+        )
 
     def _load_train_iter(self) -> DataIter:
         # Reference re-reads its shard every epoch (src/main.cc:158-159);
@@ -291,6 +340,8 @@ class PSWorker:
             return SparseDataIter.from_file(path, self.cfg.num_feature_dim,
                                             self.cfg.batch_size, nnz_max=self.cfg.nnz_max,
                                             wrap_compat=wrap)
+        if self.cfg.model == "blocked_lr":
+            return self._blocked_iter(path, self.cfg.batch_size, wrap=wrap)
         return DataIter.from_file(path, self.cfg.num_feature_dim, self.cfg.batch_size,
                                   multiclass=self.cfg.model == "softmax",
                                   wrap_compat=wrap)
@@ -300,6 +351,8 @@ class PSWorker:
         if self.cfg.model == "sparse_lr":
             return SparseDataIter.from_file(path, self.cfg.num_feature_dim, -1,
                                             nnz_max=self.cfg.nnz_max)
+        if self.cfg.model == "blocked_lr":
+            return self._blocked_iter(path, -1)
         return DataIter.from_file(path, self.cfg.num_feature_dim, -1,
                                   multiclass=self.cfg.model == "softmax")
 
@@ -384,7 +437,8 @@ class PSWorker:
         cfg = self.cfg
 
         sparse = cfg.model == "sparse_lr"
-        if not sparse:
+        blocked = cfg.model == "blocked_lr"
+        if not (sparse or blocked):
             # Committed inputs pin each jitted step to its device; jax.jit
             # keys its executable cache on input placement, so both
             # backends can coexist in one process.  Train and eval steps
@@ -409,6 +463,21 @@ class PSWorker:
                         cfg.l2_c, bool(cfg.l2_scale_by_batch),
                     )
                     self.kv.wait(self.kv.push(g_u, keys=keys))
+            elif blocked:
+                # Keyed at ROW granularity: a batch's unique block rows
+                # travel as R-wide contiguous key ranges — same sliced-key
+                # machinery, amortized per-key bookkeeping (the KV analogue
+                # of the on-chip row gather, benchmarks/ROOFLINE.md).
+                R = cfg.block_size
+                for blocks, lane_vals, y, mask in train:
+                    ub, pos = np.unique(blocks, return_inverse=True)
+                    keys = _expand_block_keys(ub, R)
+                    t_u = self.kv.pull(keys=keys).reshape(len(ub), R)
+                    g_u = _blocked_batch_grad(
+                        t_u, pos.reshape(blocks.shape), lane_vals, y, mask,
+                        cfg.l2_c, bool(cfg.l2_scale_by_batch),
+                    )
+                    self.kv.wait(self.kv.push(g_u.reshape(-1), keys=keys))
             else:
                 for X, y, mask in train:
                     w = self.kv.pull()
@@ -422,6 +491,8 @@ class PSWorker:
             ):
                 if sparse:
                     acc = self._sparse_eval(test)
+                elif blocked:
+                    acc = self._blocked_eval(test)
                 else:
                     w = self.kv.pull()
                     test.reset()
@@ -461,6 +532,17 @@ class PSWorker:
         if self.rank == 0:
             self.kv.shutdown_servers()
         return self.final_weights
+
+    def _blocked_eval(self, test) -> float:
+        """Full-test-set accuracy: keyed pull of the test set's unique
+        block rows, scattered into a full (num_blocks, R) table."""
+        test.reset()
+        blocks, lane_vals, y, mask = test.next_batch()
+        R = self.cfg.block_size
+        ub = np.unique(blocks)
+        t = np.zeros((self.cfg.num_feature_dim // R, R), np.float32)
+        t[ub] = self.kv.pull(keys=_expand_block_keys(ub, R)).reshape(len(ub), R)
+        return float(self.model.accuracy(t, (blocks, lane_vals, y, mask.astype(np.float32))))
 
     def _sparse_eval(self, test) -> float:
         """Full-test-set accuracy: keyed pull of the test set's unique

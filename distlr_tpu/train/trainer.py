@@ -117,16 +117,7 @@ class GlobalShardedData:
         ``sparse=True`` keeps rows as padded-COO ``(cols, vals)`` for the
         ``segment_sum`` path instead of densifying (CTR-style data where
         ``(N, D)`` dense would not fit host RAM)."""
-        paths = []
-        i = 0
-        while True:
-            p = os.path.join(data_dir, split, part_name(i))
-            if not os.path.exists(p):
-                break
-            paths.append(p)
-            i += 1
-        if not paths:
-            raise FileNotFoundError(f"no shards under {data_dir}/{split}")
+        paths = cls._discover_parts(data_dir, split)
         parts = []
         for p in paths:
             if sparse:
@@ -139,6 +130,51 @@ class GlobalShardedData:
                 parts.append((pc, pv, y))
             else:
                 parts.append(parse_libsvm_file(p, num_features, multiclass=multiclass))
+        return cls._from_parts(parts, num_shards)
+
+    @staticmethod
+    def _discover_parts(data_dir: str, split: str) -> list[str]:
+        paths = []
+        i = 0
+        while True:
+            p = os.path.join(data_dir, split, part_name(i))
+            if not os.path.exists(p):
+                break
+            paths.append(p)
+            i += 1
+        if not paths:
+            raise FileNotFoundError(f"no shards under {data_dir}/{split}")
+        return paths
+
+    @classmethod
+    def from_raw_ctr_dir(cls, data_dir: str, split: str, num_shards: int, cfg):
+        """Load raw-CTR shards (``write_raw_ctr_shards`` format) as
+        row-blocked leaves ``(blocks, lane_vals, y)`` — the on-disk path
+        of the ``blocked_lr`` model.  Hashing happens at load time
+        (``encode_blocked``) so train/test share the grouping and seed by
+        construction."""
+        from distlr_tpu.data.hashing import (  # noqa: PLC0415
+            encode_blocked,
+            read_raw_ctr_file,
+            resolve_ctr_fields,
+        )
+
+        num_fields = resolve_ctr_fields(data_dir, cfg.ctr_fields)
+        num_blocks = cfg.num_feature_dim // cfg.block_size
+        parts = []
+        for p in cls._discover_parts(data_dir, split):
+            raw_ids, y = read_raw_ctr_file(p, num_fields)
+            blocks, lane_vals = encode_blocked(
+                raw_ids, num_blocks, cfg.block_size, seed=cfg.hash_seed
+            )
+            parts.append((blocks, lane_vals, y))
+        return cls._from_parts(parts, num_shards)
+
+    @classmethod
+    def _from_parts(cls, parts, num_shards: int):
+        """Redistribute loaded parts onto ``num_shards`` mesh slots
+        (round-robin split when fewer parts, interleaved merge when
+        more)."""
         if len(parts) != num_shards:
 
             def _concat(arrs):
@@ -238,12 +274,13 @@ class Trainer:
         # A mesh with a 'model' axis selects the 2D data x feature-sharded
         # path (weights partitioned like ps-lite's server key ranges).
         self.feature_sharded = MODEL_AXIS in mesh.axis_names
-        if self.feature_sharded and cfg.model == "sparse_lr":
-            # w[cols] gathers arbitrary buckets; a partitioned w would turn
-            # every gather into a cross-shard collective. Shard the data
-            # axis instead (sparse batches are small by construction).
+        if self.feature_sharded and cfg.model in ("sparse_lr", "blocked_lr"):
+            # w[cols] / t[blocks] gathers arbitrary buckets; a partitioned
+            # table would turn every gather into a cross-shard collective.
+            # Shard the data axis instead (sparse batches are small by
+            # construction).
             raise NotImplementedError(
-                "sparse_lr supports data-parallel meshes only (no 'model' axis)"
+                f"{cfg.model} supports data-parallel meshes only (no 'model' axis)"
             )
         self._build_steps()
         self.timer = StepTimer()
@@ -332,6 +369,14 @@ class Trainer:
         W = num_data_shards(self.mesh)
         multiclass = self.cfg.model == "softmax"
         sparse = self.cfg.model == "sparse_lr"
+        if self.cfg.model == "blocked_lr":
+            self._train_data = train or GlobalShardedData.from_raw_ctr_dir(
+                self.cfg.data_dir, "train", W, self.cfg
+            )
+            self._test_data = test or GlobalShardedData.from_raw_ctr_dir(
+                self.cfg.data_dir, "test", W, self.cfg
+            )
+            return self
         self._train_data = train or GlobalShardedData.from_data_dir(
             self.cfg.data_dir, "train", W, self.cfg.num_feature_dim,
             multiclass=multiclass, sparse=sparse, nnz_max=self.cfg.nnz_max,

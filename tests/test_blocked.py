@@ -209,3 +209,164 @@ class TestBlockedAccuracyGate:
 
         acc_scalar, acc_blocked = self._accs(ids, y)
         assert acc_blocked >= acc_scalar + 0.05, (acc_blocked, acc_scalar)
+
+
+class TestRawCtrShards:
+    """Raw-CTR on-disk format: hash-scheme-agnostic shards + manifest
+    (the blocked_lr load path; VERDICT r2 next-round item 2)."""
+
+    def test_write_read_roundtrip(self, tmp_path):
+        from distlr_tpu.data.hashing import (
+            read_ctr_meta,
+            read_raw_ctr_file,
+            resolve_ctr_fields,
+            write_raw_ctr_shards,
+        )
+
+        d = str(tmp_path)
+        m = write_raw_ctr_shards(d, 500, 6, 40, 2, seed=9)
+        assert m["meta"]["num_fields"] == 6
+        assert read_ctr_meta(d)["seed"] == 9
+        assert resolve_ctr_fields(d, 0) == 6
+        assert resolve_ctr_fields(d, 11) == 11  # explicit cfg wins
+        ids, y = read_raw_ctr_file(m["train_parts"][0], 6)
+        assert ids.shape[1] == 6 and ids.dtype == np.int64
+        assert (ids >= 0).all() and (ids < 40).all()
+        assert set(np.unique(y)) <= {0, 1}
+        # deterministic: rewrite produces identical bytes
+        d2 = str(tmp_path / "again")
+        m2 = write_raw_ctr_shards(d2, 500, 6, 40, 2, seed=9)
+        with open(m["train_parts"][0]) as f1, open(m2["train_parts"][0]) as f2:
+            assert f1.read() == f2.read()
+
+    def test_missing_manifest_and_field_mismatch_reject(self, tmp_path):
+        from distlr_tpu.data.hashing import (
+            read_raw_ctr_file,
+            resolve_ctr_fields,
+            write_raw_ctr_shards,
+        )
+
+        with pytest.raises(FileNotFoundError, match="ctr_meta"):
+            resolve_ctr_fields(str(tmp_path), 0)
+        m = write_raw_ctr_shards(str(tmp_path), 100, 5, 10, 1)
+        with pytest.raises(ValueError, match="fields"):
+            read_raw_ctr_file(m["train_parts"][0], 7)
+        # too FEW expected fields must also reject (the parser's column
+        # filter must not silently truncate a 5-field shard to 3)
+        with pytest.raises(ValueError, match="5 fields, expected 3"):
+            read_raw_ctr_file(m["train_parts"][0], 3)
+        # out-of-range field number with the right row length
+        bad = tmp_path / "range"
+        bad.write_text("1 1:3 2:4 9:7\n")
+        with pytest.raises(ValueError, match="field number 9"):
+            read_raw_ctr_file(str(bad), 3)
+
+    def test_malformed_rows_reject(self, tmp_path):
+        from distlr_tpu.data.hashing import read_raw_ctr_file
+
+        dup = tmp_path / "dup"
+        dup.write_text("1 1:3 1:4 3:7\n")  # field 1 twice, field 2 missing
+        with pytest.raises(ValueError, match="repeats a field"):
+            read_raw_ctr_file(str(dup), 3)
+        neg = tmp_path / "neg"
+        neg.write_text("1 1:3 2:-4 3:7\n")
+        with pytest.raises(ValueError, match="non-negative"):
+            read_raw_ctr_file(str(neg), 3)
+
+    def test_negative_hash_seed_rejected_at_config(self):
+        with pytest.raises(ValueError, match="hash_seed"):
+            Config(hash_seed=-1)
+
+    def test_vocab_beyond_float32_exact_range_rejects(self, tmp_path):
+        from distlr_tpu.data.hashing import write_raw_ctr_shards
+
+        with pytest.raises(ValueError, match="2\\^24"):
+            write_raw_ctr_shards(str(tmp_path), 10, 2, 1 << 24, 1)
+
+    def test_blocked_quantization_rejected(self):
+        with pytest.raises(ValueError, match="dense models only"):
+            Config(model="blocked_lr", feature_dtype="int8")
+
+
+def _gen_blocked_dir(tmp_path, n=4000, parts=2, seed=1):
+    from distlr_tpu.data.hashing import write_raw_ctr_shards
+
+    d = str(tmp_path / "data")
+    # vocab 4, groups of 4 -> 256 tuples: high recurrence, blocked learns
+    write_raw_ctr_shards(d, n, 8, 4, parts, seed=seed)
+    return d
+
+
+def _blocked_cfg(d, **kw):
+    kw.setdefault("num_iteration", 12)
+    kw.setdefault("batch_size", 256)
+    kw.setdefault("test_interval", 6)
+    return Config(model="blocked_lr", num_feature_dim=4096, block_size=4,
+                  data_dir=d, learning_rate=0.5, l2_c=0.0, **kw)
+
+
+class TestBlockedEndToEnd:
+    """blocked_lr trainable from shards on disk, in every mode."""
+
+    def test_sync_trainer_from_disk(self, tmp_path):
+        from distlr_tpu.train import Trainer
+
+        tr = Trainer(_blocked_cfg(_gen_blocked_dir(tmp_path))).load_data()
+        tr.fit()
+        assert tr.evaluate() >= 0.70
+        path = tr.save_model()
+        from distlr_tpu.train.export import load_model_text
+
+        w = load_model_text(path)
+        assert w.size == 4096
+
+    def test_ps_sync_matches_sync_trainer(self, tmp_path):
+        """Keyed row Push/Pull (2 workers x 2 servers) reproduces the
+        SPMD trainer's trajectory: same shards, full-batch, l2=0."""
+        from distlr_tpu.train import Trainer
+        from distlr_tpu.train.ps_trainer import run_ps_local
+
+        d = _gen_blocked_dir(tmp_path, n=1200, parts=2)
+        cfg = _blocked_cfg(d, num_iteration=4, batch_size=-1,
+                           num_workers=2, num_servers=2, test_interval=0)
+        ws = run_ps_local(cfg, save=False)
+        assert all(np.array_equal(ws[0], w) for w in ws)
+
+        tr = Trainer(cfg.replace(mesh_shape={"data": 2})).load_data()
+        w_sync = np.asarray(tr.fit()).reshape(-1)
+        np.testing.assert_allclose(ws[0], w_sync, rtol=2e-4, atol=2e-5)
+
+    def test_ps_async_converges(self, tmp_path):
+        from distlr_tpu.train.ps_trainer import run_ps_local
+
+        d = _gen_blocked_dir(tmp_path, n=2400, parts=2)
+        evals = []
+        cfg = _blocked_cfg(d, sync_mode=False, num_workers=2, num_servers=2,
+                           num_iteration=10, test_interval=5)
+        run_ps_local(cfg, save=False,
+                     eval_fn=lambda ep, acc: evals.append((ep, acc)))
+        assert evals and evals[-1][1] >= 0.65
+
+    def test_launch_cli_gen_and_sync(self, tmp_path):
+        from distlr_tpu import launch
+
+        d = str(tmp_path / "cli")
+        rc = launch.main([
+            "gen-data", "--data-dir", d, "--num-samples", "1500",
+            "--ctr-fields", "8", "--ctr-vocab", "4", "--ctr-raw",
+            "--num-parts", "2", "--seed", "3",
+        ])
+        assert rc == 0
+        rc = launch.main([
+            "sync", "--data-dir", d, "--model", "blocked_lr",
+            "--num-feature-dim", "4096", "--block-size", "4",
+            "--num-iteration", "6", "--batch-size", "256",
+            "--learning-rate", "0.5", "--l2-c", "0", "--test-interval", "3",
+        ])
+        assert rc == 0
+
+    def test_ctr_raw_requires_fields(self, capsys):
+        from distlr_tpu import launch
+
+        rc = launch.main(["gen-data", "--data-dir", "/tmp/x", "--ctr-raw"])
+        assert rc == 2
