@@ -476,6 +476,10 @@ def read_raw_ctr_file(path: str, num_fields: int):
         )
     if (vals < 0).any():
         raise ValueError(f"{path}: raw-CTR ids must be non-negative")
+    if (vals != np.floor(vals)).any():
+        raise ValueError(
+            f"{path}: raw-CTR ids must be integers (found fractional value)"
+        )
     # rows may list fields in any order; cols give the 0-based field slot.
     # -1 fill + post-check: a duplicated field number passes the length
     # check but leaves its partner slot unwritten — garbage must reject,

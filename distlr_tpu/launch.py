@@ -231,6 +231,11 @@ def cmd_ps(args: argparse.Namespace) -> int:
     if args.hosts:
         # Multi-host: join an existing server group (launch ps-server on
         # the server host first), running this host's worker ranks.
+        if args.supervise_servers:
+            print("error: --supervise-servers applies to local mode (the "
+                  "server host owns its processes; supervise there)",
+                  file=sys.stderr)
+            return 2
         ranks = (
             [int(s) for s in args.worker_ranks.split(",")]
             if args.worker_ranks
@@ -243,8 +248,14 @@ def cmd_ps(args: argparse.Namespace) -> int:
             print("error: --worker-ranks requires --hosts (local mode always "
                   "runs all ranks)", file=sys.stderr)
             return 2
+        if args.supervise_servers and cfg.sync_mode:
+            print("error: --supervise-servers requires --async (sync BSP "
+                  "state cannot be reconstructed; use --checkpoint-dir + "
+                  "--resume)", file=sys.stderr)
+            return 2
         run_ps_local(cfg, save=True, resume=args.resume,
-                     max_restarts=args.max_worker_restarts)
+                     max_restarts=args.max_worker_restarts,
+                     supervise_servers=args.supervise_servers)
     return 0
 
 
@@ -329,6 +340,11 @@ def main(argv=None) -> int:
                    type=int, default=0,
                    help="async mode: restart a failed worker in place up to "
                    "N times (sync recovery is --checkpoint-dir + --resume)")
+    p.add_argument("--supervise-servers", dest="supervise_servers",
+                   action="store_true",
+                   help="async local mode: respawn dead server ranks and "
+                   "re-seed them from a rolling snapshot (pair with "
+                   "--max-worker-restarts)")
     p.set_defaults(fn=cmd_ps)
 
     v = sub.add_parser("ps-server", help="host a KV server group (multi-host PS)")

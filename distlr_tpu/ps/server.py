@@ -10,6 +10,9 @@ from __future__ import annotations
 
 import subprocess
 import threading
+import time
+
+import numpy as np
 
 from distlr_tpu.ps.build import build_native, server_binary
 from distlr_tpu.utils.logging import get_logger
@@ -185,3 +188,167 @@ class ServerGroup:
 
     def __exit__(self, *exc):
         self.stop()
+
+
+class ServerSupervisor:
+    """Server-side crash recovery for ASYNC (Hogwild) groups: a daemon
+    thread that snapshots the group's weights on an interval, polls
+    process liveness, respawns dead ranks on their original ports
+    (:meth:`ServerGroup.respawn`), and re-seeds each respawned rank's key
+    slice from the latest snapshot via a forced keyed init push.
+
+    This closes the server half of §5.3 failure recovery (the worker
+    half — timeouts, kStats probes, in-place worker restarts — already
+    exists): the reference's only outcome for ANY dead process is an
+    eternal deadlock (``/root/reference/src/main.cc:67-78``, SURVEY.md
+    §5.3).  Recovery semantics are Hogwild-grade by design: updates the
+    dead rank absorbed after the last snapshot are lost (bounded by
+    ``snapshot_interval``), which is the same staleness class async
+    training already tolerates.  Sync (BSP) groups are REFUSED: a mid-round
+    merge buffer and pending barrier votes cannot be reconstructed — the
+    sync recovery path is job-level ``checkpoint_dir`` + ``resume``.
+
+    Workers riding the group still see one failed op per server death
+    (their TCP stream to the old process breaks); pair the supervisor
+    with ``run_ps_workers(..., max_restarts>0)`` so those workers rejoin
+    — the SIGKILL test in ``tests/test_ps_robustness.py`` exercises the
+    combination end-to-end.
+    """
+
+    def __init__(self, group: ServerGroup, *, poll_interval: float = 0.2,
+                 snapshot_interval: float = 1.0, max_respawns: int = 3,
+                 timeout_ms: int = 5000):
+        if group._args["sync"]:
+            raise ValueError(
+                "ServerSupervisor supports async groups only: a sync "
+                "server's mid-round BSP merge state cannot be "
+                "reconstructed — use checkpoint_dir + resume for sync runs"
+            )
+        self._group = group
+        self._poll_interval = poll_interval
+        self._snapshot_interval = snapshot_interval
+        self._max_respawns = max_respawns
+        self._timeout_ms = timeout_ms
+        self._snapshot: np.ndarray | None = None
+        self._snapshot_at = 0.0
+        self._respawns = [0] * group.num_servers
+        self._needs_reseed: set[int] = set()
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        #: (monotonic time, rank, event) audit trail — "respawned",
+        #: "reseeded", "seeded-zeros", "gave-up", "respawn-failed"
+        self.events: list[tuple[float, int, str]] = []
+
+    # -- lifecycle --------------------------------------------------------
+    def start(self) -> "ServerSupervisor":
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="ps-server-supervisor")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join()
+            self._thread = None
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+
+    # -- internals --------------------------------------------------------
+    def _probe(self):
+        from distlr_tpu.ps.client import KVWorker  # noqa: PLC0415  (cycle)
+
+        # A fresh connection per use: the supervisor's ops must not share
+        # a stream with anything, and a server death poisons open
+        # streams — reconnect-per-cycle makes every cycle independent.
+        return KVWorker(self._group.hosts, self._group.dim, client_id=0xFFFE,
+                        timeout_ms=self._timeout_ms, sync_group=False)
+
+    def _try_snapshot(self) -> None:
+        try:
+            with self._probe() as kv:
+                snap = kv.pull()
+        except Exception:
+            # some rank is down or wedged; the respawn pass handles it —
+            # the previous snapshot stays authoritative
+            return
+        self._snapshot = snap
+        self._snapshot_at = time.monotonic()
+
+    def _reseed(self, rank: int) -> bool:
+        lo, hi = self._group.key_range(rank)
+        if self._snapshot is not None:
+            vals, event = self._snapshot[lo:hi], "reseeded"
+        else:
+            # died before the first snapshot: zeros keep the server
+            # *initialized* (pulls return a defined value) even though
+            # the slice's training progress is lost
+            vals, event = np.zeros(hi - lo, np.float32), "seeded-zeros"
+        try:
+            with self._probe() as kv:
+                kv.push_init(vals, keys=np.arange(lo, hi, dtype=np.uint64),
+                             force=True)
+        except Exception as e:
+            # retried next poll (_needs_reseed): an unseeded-but-alive
+            # server would otherwise install the first gradient push AS
+            # the weights (the server's first-push-init branch)
+            log.warning("supervisor: re-seed of server %d failed: %s", rank, e)
+            return False
+        self.events.append((time.monotonic(), rank, event))
+        return True
+
+    def _run(self) -> None:
+        # eager first snapshot so an early death has something to restore
+        self._try_snapshot()
+        while not self._stop.wait(self._poll_interval):
+            now = time.monotonic()
+            procs = list(self._group.procs)
+            if not procs or all(p.poll() == 0 for p in procs):
+                # group retired (or torn down): every process exited
+                # voluntarily — rank 0's shutdown_servers at the end of a
+                # clean run, NOT a crash.  Respawning here would misread
+                # the job's own shutdown as a failure and spin up
+                # uninitialized servers on the old ports.
+                continue
+            dead = [
+                r for r, p in enumerate(procs)
+                if p.poll() is not None and p.returncode != 0
+            ]
+            for rank in list(self._needs_reseed):
+                # a previously-respawned rank whose re-seed failed (e.g. a
+                # second rank was still down, so the probe could not
+                # connect): alive but uninitialized — retry until seeded
+                if rank not in dead and self._reseed(rank):
+                    self._needs_reseed.discard(rank)
+            for rank in dead:
+                if self._respawns[rank] >= self._max_respawns:
+                    if not any(
+                        r == rank and ev == "gave-up" for _, r, ev in self.events
+                    ):
+                        log.error("supervisor: server %d exceeded %d respawns; "
+                                  "leaving it down", rank, self._max_respawns)
+                        self.events.append((now, rank, "gave-up"))
+                    continue
+                self._respawns[rank] += 1
+                try:
+                    if not self._group.respawn(rank):
+                        continue  # torn down, or raced a still-alive rank
+                except RuntimeError as e:  # spawn failure / stolen port
+                    log.warning("supervisor: respawn of server %d failed: %s",
+                                rank, e)
+                    self.events.append((now, rank, "respawn-failed"))
+                    continue
+                log.warning("supervisor: server %d died; respawned (%d/%d)",
+                            rank, self._respawns[rank], self._max_respawns)
+                self.events.append((now, rank, "respawned"))
+                if not self._reseed(rank):
+                    self._needs_reseed.add(rank)
+            if not dead and not self._needs_reseed and (
+                now - self._snapshot_at >= self._snapshot_interval
+            ):
+                self._try_snapshot()

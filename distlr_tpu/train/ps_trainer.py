@@ -29,6 +29,7 @@ import functools
 import json
 import os
 import threading
+import time
 import types
 
 import jax
@@ -626,13 +627,23 @@ def run_ps_workers(cfg: Config, hosts: str, ranks, *, eval_fn=None, save=False,
                     return
                 log.warning("worker %d failed (%s); restart %d/%d",
                             r, e, attempts, max_restarts)
-                try:
-                    workers[i] = PSWorker(cfg, r, hosts)
-                except Exception as e2:  # servers gone too: give up
-                    errors.append(e2)
-                    if on_error is not None:
-                        on_error()
-                    return
+                # Rebuild with a short reconnect window: when the failure
+                # was a SERVER death, a supervisor needs a beat to respawn
+                # the rank before this worker's fresh connect can succeed
+                # (ServerSupervisor poll+respawn is ~100 ms; 5 s covers a
+                # slow spawn without masking genuinely-gone servers).
+                deadline = time.monotonic() + 5.0
+                while True:
+                    try:
+                        workers[i] = PSWorker(cfg, r, hosts)
+                        break
+                    except Exception as e2:
+                        if time.monotonic() >= deadline:
+                            errors.append(e2)  # servers gone: give up
+                            if on_error is not None:
+                                on_error()
+                            return
+                        time.sleep(0.2)
 
     threads = [
         threading.Thread(target=run_one, args=(i, r), daemon=True)
@@ -656,13 +667,19 @@ def ps_param_dim(cfg: Config) -> int:
 
 
 def run_ps_local(cfg: Config, *, eval_fn=None, save=False, resume=False,
-                 max_restarts=0):
+                 max_restarts=0, supervise_servers=False):
     """Single-host PS run: native server subprocesses + threaded workers.
 
     The local-mode successor of ``examples/local.sh`` for the PS path
     (the scheduler role is gone — rendezvous is just TCP connect).
     Multi-host deployments start servers with ``launch ps-server`` and
     per-host workers with :func:`run_ps_workers` instead.
+
+    ``supervise_servers`` (async mode only) attaches a
+    :class:`distlr_tpu.ps.ServerSupervisor`: dead server ranks are
+    respawned and re-seeded from a rolling snapshot, completing the
+    two-sided §5.3 recovery story (pair it with ``max_restarts > 0`` so
+    workers whose stream broke rejoin).
     """
     group = ServerGroup(
         cfg.num_servers,
@@ -672,7 +689,12 @@ def run_ps_local(cfg: Config, *, eval_fn=None, save=False, resume=False,
         sync=cfg.sync_mode,
         last_gradient=bool(cfg.sync_last_gradient),
     )
-    with group:
+    with contextlib.ExitStack() as stack:
+        stack.enter_context(group)
+        if supervise_servers:
+            from distlr_tpu.ps import ServerSupervisor  # noqa: PLC0415
+
+            stack.enter_context(ServerSupervisor(group))
         results = run_ps_workers(
             cfg, group.hosts, range(cfg.num_workers),
             eval_fn=eval_fn, save=save, on_error=group.stop, resume=resume,

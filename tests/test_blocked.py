@@ -272,6 +272,10 @@ class TestRawCtrShards:
         neg.write_text("1 1:3 2:-4 3:7\n")
         with pytest.raises(ValueError, match="non-negative"):
             read_raw_ctr_file(str(neg), 3)
+        frac = tmp_path / "frac"
+        frac.write_text("1 1:3.7 2:4 3:7\n")
+        with pytest.raises(ValueError, match="integers"):
+            read_raw_ctr_file(str(frac), 3)
 
     def test_negative_hash_seed_rejected_at_config(self):
         with pytest.raises(ValueError, match="hash_seed"):
