@@ -82,13 +82,14 @@ def bench_config_1(quick: bool) -> dict:
         )
         tr = Trainer(cfg).load_data()
         tr.fit(eval_fn=lambda *_: None)
-        acc = float(tr.evaluate())
+        em = tr.evaluate_metrics()
         sps = tr.timer.samples_per_sec
     return {
         "config": 1,
         "name": "dense binary LR, synthetic gen-data, 1W/1S sync",
         "samples_per_sec": round(sps, 1),
-        "accuracy": round(acc, 4),
+        "accuracy": round(em["accuracy"], 4),
+        "test_logloss": round(em["logloss"], 5),
     }
 
 
@@ -119,14 +120,21 @@ def bench_config_2(quick: bool) -> dict:
                      eval_fn=lambda *_: None)
         accs: list[float] = []
         t0 = time.perf_counter()
-        run_ps_local(cfg, eval_fn=lambda _epoch, a: accs.append(a))
+        ws = run_ps_local(cfg, eval_fn=lambda _epoch, a: accs.append(a))
         dt = time.perf_counter() - t0
+        # test logloss of the final weights (the driver parity metric,
+        # BASELINE.json epochs-to-logloss), on the written test shard
+        from distlr_tpu.data import parse_libsvm_file
+        Xt, yt = parse_libsvm_file(os.path.join(tmp, "test", "part-001"), d)
+        z = Xt @ np.asarray(ws[0], np.float64)
+        test_ll = float(np.mean(np.logaddexp(0.0, z) - yt * z))
     n_train = int(n * 0.8)
     return {
         "config": 2,
         "name": "4-worker async-SGD dense LR (native PS, Hogwild)",
         "samples_per_sec": round(n_train * epochs / dt, 1),
         "accuracy": round(accs[-1], 4) if accs else None,
+        "test_logloss": round(test_ll, 5),
     }
 
 
@@ -195,11 +203,13 @@ def bench_config_4(quick: bool) -> dict:
     for _ in range(120):
         w = cstep(w, cbatch)
     acc = float(cmodel.accuracy(w, cbatch))
+    test_ll = float(cmodel.logloss(w, cbatch))
     return {
         "config": 4,
         "name": f"sparse one-hot LR (Avazu-style), D={d}, {fields} fields, segment_sum",
         "samples_per_sec": round(sps, 1),
         "accuracy": round(acc, 4),
+        "test_logloss": round(test_ll, 5),
         "oracle_accuracy": round(oracle, 4),
     }
 
@@ -227,11 +237,13 @@ def bench_config_5(quick: bool) -> dict:
     for _ in range(60):
         W = step(W, batch)
     acc = float(model.accuracy(W, batch))
+    test_ll = float(model.logloss(W, batch))
     return {
         "config": 5,
         "name": "multinomial softmax regression, D=784 K=10 (MNIST-shaped)",
         "samples_per_sec": round(sps, 1),
         "accuracy": round(acc, 4),
+        "test_logloss": round(test_ll, 5),
     }
 
 

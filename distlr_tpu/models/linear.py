@@ -115,6 +115,15 @@ class BinaryLR:
         correct = (self.predict(w, X) == y).astype(jnp.float32)
         return _masked_mean(correct, mask)
 
+    def logloss(self, w, batch):
+        """Mean test logloss WITHOUT the L2 term — the driver's parity
+        metric (BASELINE.json epochs-to-logloss), which regularization
+        must not contaminate."""
+        X, y, mask = batch
+        z = self.logits(w, X)
+        ll = jax.nn.softplus(z) - y.astype(jnp.float32) * z
+        return _masked_mean(ll, mask)
+
 
 @dataclasses.dataclass(frozen=True)
 class SoftmaxRegression:
@@ -179,6 +188,13 @@ class SoftmaxRegression:
         correct = (self.predict(W, X) == y).astype(jnp.float32)
         return _masked_mean(correct, mask)
 
+    def logloss(self, W, batch):
+        """Mean multiclass test logloss, no L2 (see BinaryLR.logloss)."""
+        X, y, mask = batch
+        z = self.logits(W, X)
+        ll = -jax.nn.log_softmax(z)[jnp.arange(z.shape[0]), y]
+        return _masked_mean(ll, mask)
+
 
 @dataclasses.dataclass(frozen=True)
 class SparseBinaryLR:
@@ -233,6 +249,13 @@ class SparseBinaryLR:
         cols, vals, y, mask = batch
         correct = (self.predict(w, cols, vals) == y).astype(jnp.float32)
         return _masked_mean(correct, mask)
+
+    def logloss(self, w, batch):
+        """Mean test logloss, no L2 (see BinaryLR.logloss)."""
+        cols, vals, y, mask = batch
+        z = self.logits(w, cols, vals)
+        ll = jax.nn.softplus(z) - y.astype(jnp.float32) * z
+        return _masked_mean(ll, mask)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -291,6 +314,13 @@ class BlockedSparseLR:
         blocks, lane_vals, y, mask = batch
         correct = (self.predict(t, blocks, lane_vals) == y).astype(jnp.float32)
         return _masked_mean(correct, mask)
+
+    def logloss(self, t, batch):
+        """Mean test logloss, no L2 (see BinaryLR.logloss)."""
+        blocks, lane_vals, y, mask = batch
+        z = self.logits(t, blocks, lane_vals)
+        ll = jax.nn.softplus(z) - y.astype(jnp.float32) * z
+        return _masked_mean(ll, mask)
 
 
 def get_model(cfg: Config):

@@ -77,18 +77,27 @@ def make_sync_train_step(model, cfg: Config, mesh: Mesh, *, with_metrics: bool =
 
 
 def make_eval_step(model, mesh: Mesh):
-    """Jitted global accuracy over a data-sharded eval batch.
+    """Jitted global eval over a data-sharded eval batch:
+    ``step(w, batch) -> {"accuracy": a, "logloss": l}``.
 
-    Sums correct-prediction counts and mask counts with ``psum`` so the
-    result is the exact global masked accuracy (the reference evaluates on
-    rank 0 only over the full test set, ``src/lr.cc:47-63``)."""
+    Sums correct-prediction counts, per-sample loglosses and mask counts
+    with ``psum`` so both results are exact global masked means.  The
+    reference evaluates accuracy only, on rank 0, over the full test set
+    (``src/lr.cc:47-63``); test logloss is the driver's parity metric
+    (BASELINE.json epochs-to-logloss) so it is first-class here."""
 
     def local_eval(w, batch):
         *inputs, y, mask = batch
         pred = model.predict(w, *inputs)
         correct = lax.psum(jnp.sum((pred == y) * mask), DATA_AXIS)
-        total = lax.psum(jnp.sum(mask), DATA_AXIS)
-        return correct.astype(jnp.float32) / jnp.maximum(total, 1)
+        # per-shard logloss SUM (masked mean would double-normalize)
+        ll_mean = model.logloss(w, batch)
+        ll_sum = lax.psum(ll_mean * jnp.sum(mask), DATA_AXIS)
+        total = jnp.maximum(lax.psum(jnp.sum(mask), DATA_AXIS), 1)
+        return {
+            "accuracy": correct.astype(jnp.float32) / total,
+            "logloss": ll_sum / total,
+        }
 
     def evaluate(w, batch):
         return shard_map(

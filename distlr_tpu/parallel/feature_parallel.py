@@ -49,6 +49,15 @@ def _check_mesh(mesh: Mesh, num_features: int) -> None:
         )
 
 
+def _per_sample_logloss(z, y, is_softmax: bool):
+    """Per-sample logloss from global logits (shared by the train-metrics
+    and eval paths; the canonical definition lives on the model classes —
+    tests pin these against model.logloss)."""
+    if is_softmax:
+        return -jax.nn.log_softmax(z)[jnp.arange(z.shape[0]), y]
+    return jax.nn.softplus(z) - y.astype(jnp.float32) * z
+
+
 def _local_forward(model, w_shard, X_shard):
     """Partial logits from this device's feature shard, then psum."""
     cdt = jnp.dtype(model.compute_dtype)
@@ -80,11 +89,10 @@ def make_feature_sharded_train_step(model, cfg: Config, mesh: Mesh, *, with_metr
             onehot = jax.nn.one_hot(y, model.num_classes, dtype=jnp.float32)
             resid = (p - onehot) * mask[:, None]
             g = jnp.dot(X.astype(cdt).T, resid.astype(cdt), preferred_element_type=jnp.float32) / n
-            ll = -jax.nn.log_softmax(z)[jnp.arange(z.shape[0]), y]
         else:
             resid = (jax.nn.sigmoid(z) - y.astype(jnp.float32)) * mask
             g = jnp.dot(resid.astype(cdt), X.astype(cdt), preferred_element_type=jnp.float32) / n
-            ll = jax.nn.softplus(z) - y.astype(jnp.float32) * z
+        ll = _per_sample_logloss(z, y, is_softmax)
         if model.feature_scale != 1.0:  # d/dw of (X*scale) @ w
             g = g * model.feature_scale
         # L2 on the local shard (gradient of 0.5*C*|w|^2 is shard-local)
@@ -121,7 +129,8 @@ def make_feature_sharded_train_step(model, cfg: Config, mesh: Mesh, *, with_metr
 
 
 def make_feature_sharded_eval_step(model, mesh: Mesh):
-    """Global masked accuracy with model-axis-sharded weights."""
+    """Global masked eval (``{"accuracy", "logloss"}`` like
+    :func:`make_eval_step`) with model-axis-sharded weights."""
     _check_mesh(mesh, model.num_features)
     is_softmax = isinstance(model, SoftmaxRegression)
 
@@ -132,9 +141,14 @@ def make_feature_sharded_eval_step(model, mesh: Mesh):
             if is_softmax
             else (z > 0).astype(jnp.int32)
         )
+        ll = _per_sample_logloss(z, y, is_softmax)
         correct = lax.psum(jnp.sum((pred == y) * mask), DATA_AXIS)
-        total = lax.psum(jnp.sum(mask), DATA_AXIS)
-        return correct.astype(jnp.float32) / jnp.maximum(total, 1)
+        ll_sum = lax.psum(jnp.sum(ll * mask), DATA_AXIS)
+        total = jnp.maximum(lax.psum(jnp.sum(mask), DATA_AXIS), 1)
+        return {
+            "accuracy": correct.astype(jnp.float32) / total,
+            "logloss": ll_sum / total,
+        }
 
     w_spec = P(MODEL_AXIS) if not is_softmax else P(MODEL_AXIS, None)
 

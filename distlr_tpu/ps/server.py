@@ -272,6 +272,17 @@ class ServerSupervisor:
     def _try_snapshot(self) -> None:
         try:
             with self._probe() as kv:
+                # An UNINITIALIZED server serves zeros from HandlePull; a
+                # snapshot taken before the workers' init push would then
+                # become "authoritative" and a crash within
+                # snapshot_interval would re-seed zeros over real
+                # (possibly checkpoint-restored) weights.  Gate on every
+                # rank's kStats initialized flag.
+                if not all(
+                    kv.stats(r)["initialized"]
+                    for r in range(self._group.num_servers)
+                ):
+                    return
                 snap = kv.pull()
         except Exception:
             # some rank is down or wedged; the respawn pass handles it —
@@ -307,6 +318,12 @@ class ServerSupervisor:
         self._try_snapshot()
         while not self._stop.wait(self._poll_interval):
             now = time.monotonic()
+            if self._group._stopped:
+                # intentional teardown (group.stop(), e.g. run_ps_workers'
+                # on_error): SIGTERMed ranks exit nonzero but are not
+                # crashes — respawning/logging here would burn the budget
+                # and emit spurious gave-up errors during shutdown
+                continue
             procs = list(self._group.procs)
             if not procs or all(p.poll() == 0 for p in procs):
                 # group retired (or torn down): every process exited

@@ -104,9 +104,14 @@ def _compiled_fns(model, l2_c: float, l2_scale_by_batch: bool):
 
 @functools.lru_cache(maxsize=None)
 def _compiled_acc(model):
-    """Accuracy takes no cfg, so its cache is keyed on the model alone
-    (an L2 sweep must not recompile the full-test-set eval program)."""
-    return jax.jit(lambda w, X, y, mask: model.accuracy(w, (X, y, mask)))
+    """Eval takes no cfg, so its cache is keyed on the model alone
+    (an L2 sweep must not recompile the full-test-set eval program).
+    Returns ``(accuracy, test_logloss)`` — logloss is the driver's
+    parity metric (BASELINE.json epochs-to-logloss)."""
+    return jax.jit(lambda w, X, y, mask: (
+        model.accuracy(w, (X, y, mask)),
+        model.logloss(w, (X, y, mask)),
+    ))
 
 
 def _sparse_batch_grad(w_u, pos, vals, y, mask, l2_c, l2_scale_by_batch):
@@ -491,15 +496,17 @@ class PSWorker:
                 and (epoch + 1) % cfg.test_interval == 0
             ):
                 if sparse:
-                    acc = self._sparse_eval(test)
+                    acc, test_ll = self._sparse_eval(test)
                 elif blocked:
-                    acc = self._blocked_eval(test)
+                    acc, test_ll = self._blocked_eval(test)
                 else:
                     w = self.kv.pull()
                     test.reset()
                     Xt, yt, mt = test.next_batch()
-                    acc = float(self._acc_fn(*self._place(eval_dev, self._shape_params(w), Xt, yt, mt)))
-                self.metrics.log(epoch=epoch + 1, accuracy=acc)
+                    a, ll = self._acc_fn(*self._place(eval_dev, self._shape_params(w), Xt, yt, mt))
+                    acc, test_ll = float(a), float(ll)
+                self.metrics.log(epoch=epoch + 1, accuracy=acc,
+                                 test_logloss=test_ll)
                 if eval_fn is not None:
                     eval_fn(epoch + 1, acc)
                 else:
@@ -534,28 +541,42 @@ class PSWorker:
             self.kv.shutdown_servers()
         return self.final_weights
 
-    def _blocked_eval(self, test) -> float:
-        """Full-test-set accuracy: keyed pull of the test set's unique
-        block rows, scattered into a full (num_blocks, R) table."""
+    @staticmethod
+    def _eval_from_logits(z, y, mask) -> tuple[float, float]:
+        """(accuracy, logloss) from ONE forward pass's logits — numpy,
+        host-side (the keyed eval paths are exactly the small-step regime
+        where a second full-test-set forward would double the eval cost)."""
+        z = np.asarray(z, np.float64)
+        m = np.asarray(mask, np.float64)
+        n = max(m.sum(), 1.0)
+        acc = float((((z > 0).astype(np.int64) == y) * m).sum() / n)
+        ll = float(((np.logaddexp(0.0, z) - y * z) * m).sum() / n)
+        return acc, ll
+
+    def _blocked_eval(self, test) -> tuple[float, float]:
+        """Full-test-set ``(accuracy, logloss)``: keyed pull of the test
+        set's unique block rows, scattered into a full (num_blocks, R)
+        table."""
         test.reset()
         blocks, lane_vals, y, mask = test.next_batch()
         R = self.cfg.block_size
         ub = np.unique(blocks)
         t = np.zeros((self.cfg.num_feature_dim // R, R), np.float32)
         t[ub] = self.kv.pull(keys=_expand_block_keys(ub, R)).reshape(len(ub), R)
-        return float(self.model.accuracy(t, (blocks, lane_vals, y, mask.astype(np.float32))))
+        z = (t[blocks] * lane_vals).sum(axis=(-1, -2))
+        return self._eval_from_logits(z, y, mask)
 
-    def _sparse_eval(self, test) -> float:
-        """Full-test-set accuracy: keyed pull of the test set's unique
-        columns, then the model's own accuracy math (no duplicated
-        forward — the pulled slice is scattered into a full-width vector
-        first)."""
+    def _sparse_eval(self, test) -> tuple[float, float]:
+        """Full-test-set ``(accuracy, logloss)``: keyed pull of the test
+        set's unique columns scattered into a full-width vector, then one
+        forward pass for both metrics."""
         test.reset()
         cols, vals, y, mask = test.next_batch()
         keys = np.unique(cols).astype(np.uint64)
         w = np.zeros(self.cfg.num_feature_dim, np.float32)
         w[keys] = self.kv.pull(keys=keys)
-        return float(self.model.accuracy(w, (cols, vals, y, mask.astype(np.float32))))
+        z = (w[cols] * vals).sum(axis=-1)
+        return self._eval_from_logits(z, y, mask)
 
     @staticmethod
     def _place(device, *arrays):

@@ -456,10 +456,14 @@ class Trainer:
                     jax.block_until_ready(self.weights)
                     self.timer.stop(int(host_batch[-1].sum()))
                 if test_batch is not None and cfg.test_interval > 0 and (epoch + 1) % cfg.test_interval == 0:
-                    acc = float(self.eval_step(self.weights, test_batch))
+                    em = self.eval_step(self.weights, test_batch)
+                    acc = float(em["accuracy"])
                     self.metrics.log(
                         epoch=epoch + 1,
                         accuracy=acc,
+                        # the driver's parity metric (BASELINE.json
+                        # epochs-to-logloss), logged at every eval
+                        test_logloss=float(em["logloss"]),
                         loss=float(step_metrics["loss"]),
                         samples_per_sec=self.timer.samples_per_sec,
                     )
@@ -479,8 +483,13 @@ class Trainer:
         return self.weights
 
     def evaluate(self) -> float:
+        return self.evaluate_metrics()["accuracy"]
+
+    def evaluate_metrics(self) -> dict:
+        """Full-test-set ``{"accuracy", "logloss"}`` as Python floats."""
         test_batch = self._shard_batch(self._test_data.full_batch())
-        return float(self.eval_step(self.weights, test_batch))
+        em = self.eval_step(self.weights, test_batch)
+        return {k: float(v) for k, v in em.items()}
 
     def save_model(self, path: str | None = None) -> str:
         """Text export, reference format & layout (``models/part-001``)."""

@@ -64,12 +64,14 @@ class TestFeatureShardedBinaryLR:
         mask[-6:] = 0.0
         w = np.random.default_rng(2).standard_normal(16).astype(np.float32)
         evaluate = make_feature_sharded_eval_step(model, mesh42)
-        acc = float(
-            evaluate(
-                shard_weights(jnp.asarray(w), mesh42),
-                shard_batch_2d((jnp.asarray(X), jnp.asarray(y), jnp.asarray(mask)), mesh42),
-            )
+        em = evaluate(
+            shard_weights(jnp.asarray(w), mesh42),
+            shard_batch_2d((jnp.asarray(X), jnp.asarray(y), jnp.asarray(mask)), mesh42),
         )
+        acc = float(em["accuracy"])
+        ll = float(em["logloss"])
+        expect_ll = float(model.logloss(jnp.asarray(w), (jnp.asarray(X), jnp.asarray(y), jnp.asarray(mask))))
+        assert ll == pytest.approx(expect_ll, abs=1e-5)
         expect = float(model.accuracy(jnp.asarray(w), (jnp.asarray(X), jnp.asarray(y), jnp.asarray(mask))))
         assert acc == pytest.approx(expect, abs=1e-6)
 
@@ -87,7 +89,7 @@ class TestFeatureShardedBinaryLR:
             w, m = step(w, b)
             jax.block_until_ready(w)
         evaluate = make_feature_sharded_eval_step(model, mesh42)
-        assert float(evaluate(w, b)) > 0.95
+        assert float(evaluate(w, b)["accuracy"]) > 0.95
 
 
 class TestFeatureShardedSoftmax:

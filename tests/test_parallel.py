@@ -118,6 +118,7 @@ class TestEvalStep:
         mask = np.ones(n, dtype=np.float32)
         mask[-8:] = 0.0
         evaluate = make_eval_step(model, mesh8)
-        acc = float(evaluate(w, shard_batch((jnp.asarray(X), jnp.asarray(y), jnp.asarray(mask)), mesh8)))
+        em = evaluate(w, shard_batch((jnp.asarray(X), jnp.asarray(y), jnp.asarray(mask)), mesh8))
+        acc = float(em["accuracy"])
         expect = ((X[:, 0] > 0).astype(int) == y)[:-8].mean()
         assert acc == pytest.approx(expect, abs=1e-6)
