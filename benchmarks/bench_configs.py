@@ -191,19 +191,24 @@ def bench_config_4(quick: bool) -> dict:
     step = _scan_step(model, cfg)
     sps = _steady_state_sps(step, jnp.zeros(d, jnp.float32), batch, steps, b)
 
-    # convergence (small): recover hashed signal to near-oracle accuracy
-    dc, nc = 512, 6000
-    _, ccols, cvals, cy, w_true = make_ctr_dataset(nc, 8, 5000, dc, seed=1)
-    oracle = float(((np.sum(w_true[ccols] * cvals, -1) > 0).astype(int) == cy).mean())
+    # convergence (small): recover hashed signal to near-oracle accuracy;
+    # metrics are HELD-OUT (first n_te rows never trained on)
+    dc, nc, n_te = 512, 6000, 1500
+    _, ccols, cvals, cy, w_true = make_ctr_dataset(nc + n_te, 8, 5000, dc, seed=1)
+    oracle = float(((np.sum(w_true[ccols[n_te:]] * cvals[n_te:], -1) > 0
+                     ).astype(int) == cy[n_te:]).mean())
     ccfg = Config(num_feature_dim=dc, learning_rate=1.0, l2_c=0.0, model="sparse_lr")
     cmodel = SparseBinaryLR(dc)
     cstep = _scan_step(cmodel, ccfg)
-    cbatch = (jnp.asarray(ccols), jnp.asarray(cvals), jnp.asarray(cy), jnp.ones(nc, jnp.float32))
+    cbatch = (jnp.asarray(ccols[n_te:]), jnp.asarray(cvals[n_te:]),
+              jnp.asarray(cy[n_te:]), jnp.ones(nc, jnp.float32))
+    tbatch = (jnp.asarray(ccols[:n_te]), jnp.asarray(cvals[:n_te]),
+              jnp.asarray(cy[:n_te]), jnp.ones(n_te, jnp.float32))
     w = jnp.zeros(dc, jnp.float32)
     for _ in range(120):
         w = cstep(w, cbatch)
-    acc = float(cmodel.accuracy(w, cbatch))
-    test_ll = float(cmodel.logloss(w, cbatch))
+    acc = float(cmodel.accuracy(w, tbatch))
+    test_ll = float(cmodel.logloss(w, tbatch))
     return {
         "config": 4,
         "name": f"sparse one-hot LR (Avazu-style), D={d}, {fields} fields, segment_sum",
@@ -225,19 +230,21 @@ def bench_config_5(quick: bool) -> dict:
     from distlr_tpu.models import SoftmaxRegression
 
     d, k, n = 784, 10, (4096 if quick else 60_000)
+    n_te = max(n // 5, 512)
     steps = 10 if quick else 30
-    X, y, _ = make_synthetic_dataset(n, d, seed=0, num_classes=k)
+    X, y, _ = make_synthetic_dataset(n + n_te, d, seed=0, num_classes=k)
     cfg = Config(num_feature_dim=d, num_classes=k, model="softmax",
                  learning_rate=0.3, l2_c=0.0)
     model = SoftmaxRegression(d, k)
-    batch = (jnp.asarray(X), jnp.asarray(y), jnp.ones(n, jnp.float32))
+    batch = (jnp.asarray(X[n_te:]), jnp.asarray(y[n_te:]), jnp.ones(n, jnp.float32))
+    tbatch = (jnp.asarray(X[:n_te]), jnp.asarray(y[:n_te]), jnp.ones(n_te, jnp.float32))
     step = _scan_step(model, cfg)
     W = jnp.zeros((d, k), jnp.float32)
     sps = _steady_state_sps(step, W, batch, steps, n)
     for _ in range(60):
         W = step(W, batch)
-    acc = float(model.accuracy(W, batch))
-    test_ll = float(model.logloss(W, batch))
+    acc = float(model.accuracy(W, tbatch))
+    test_ll = float(model.logloss(W, tbatch))
     return {
         "config": 5,
         "name": "multinomial softmax regression, D=784 K=10 (MNIST-shaped)",

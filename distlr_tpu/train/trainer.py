@@ -492,9 +492,16 @@ class Trainer:
         return {k: float(v) for k, v in em.items()}
 
     def save_model(self, path: str | None = None) -> str:
-        """Text export, reference format & layout (``models/part-001``)."""
+        """Text export, reference format & layout: ``models/part-00{i+1}``
+        with i = this host's process index — the reference's per-worker
+        model files (Q8, ``src/main.cc:168-169``; single-process runs
+        write ``part-001`` as before).  In a ``jax.distributed`` run each
+        process exports the same replicated weights to its own file, so
+        cross-process agreement is checkable from the artifacts."""
         if path is None:
-            path = os.path.join(self.cfg.data_dir, "models", part_name(0))
+            path = os.path.join(
+                self.cfg.data_dir, "models", part_name(jax.process_index())
+            )
             os.makedirs(os.path.dirname(path), exist_ok=True)
         save_model_text(path, np.asarray(self.weights))
         return path

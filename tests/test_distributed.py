@@ -1,0 +1,83 @@
+"""Two-process ``jax.distributed`` smoke test (VERDICT r2 #4).
+
+The multi-host claim in ``launch.py`` (``--coordinator`` /
+``--num-processes`` / ``--process-id`` bootstrapping one global mesh) is
+exercised as real code: two localhost CPU processes join one
+coordinator, run a sync data-parallel training job over a 2-device
+global mesh (one device per process), and must (a) both exit cleanly,
+(b) export bitwise-identical weights (the replicated weight vector is
+the same on every process — the collective path worked), and (c) match
+a single-process 2-virtual-device run of the same job to float
+tolerance (process boundaries change nothing about the math).
+
+This is the JAX analogue of the reference's multi-node-without-a-cluster
+trick (``examples/local.sh:22-33``, SURVEY.md §4): cluster shape faked
+on one machine, full distributed code path for real.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_two_process_sync_run_agrees(tmp_path):
+    d = str(tmp_path / "data")
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    env.pop("XLA_FLAGS", None)  # children set their own device counts
+    gen = subprocess.run(
+        [sys.executable, "-m", "distlr_tpu.launch", "gen-data",
+         "--data-dir", d, "--num-samples", "1200",
+         "--num-feature-dim", "24", "--num-parts", "2", "--seed", "7"],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=120,
+    )
+    assert gen.returncode == 0, gen.stderr
+
+    port = _free_port()
+    common = [
+        sys.executable, "-m", "distlr_tpu.launch", "sync",
+        "--data-dir", d, "--num-feature-dim", "24", "--num-iteration", "5",
+        "--batch-size", "-1", "--learning-rate", "0.5", "--l2-c", "0",
+        "--test-interval", "5", "--coordinator", f"127.0.0.1:{port}",
+        "--num-processes", "2", "--cpu-devices", "1",
+    ]
+    procs = [
+        subprocess.Popen(common + ["--process-id", str(i)], cwd=REPO, env=env,
+                         stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                         text=True)
+        for i in range(2)
+    ]
+    outs = [p.communicate(timeout=240)[0] for p in procs]
+    for p, out in zip(procs, outs):
+        assert p.returncode == 0, out
+
+    from distlr_tpu.train.export import load_model_text
+
+    w0 = load_model_text(os.path.join(d, "models", "part-001"))
+    w1 = load_model_text(os.path.join(d, "models", "part-002"))
+    # replicated weights: every process exports the identical vector
+    np.testing.assert_array_equal(w0, w1)
+
+    # oracle: the same job in ONE process over 2 virtual devices
+    # (conftest already gives this process an 8-device CPU mesh)
+    from distlr_tpu import Config
+    from distlr_tpu.train import Trainer
+
+    cfg = Config(data_dir=d, num_feature_dim=24, num_iteration=5,
+                 batch_size=-1, learning_rate=0.5, l2_c=0.0,
+                 test_interval=0, mesh_shape={"data": 2})
+    w_ref = np.asarray(Trainer(cfg).load_data().fit())
+    np.testing.assert_allclose(w0, w_ref, rtol=1e-5, atol=1e-6)
