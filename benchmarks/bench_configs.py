@@ -195,8 +195,8 @@ def bench_config_4(quick: bool) -> dict:
     # metrics are HELD-OUT (first n_te rows never trained on)
     dc, nc, n_te = 512, 6000, 1500
     _, ccols, cvals, cy, w_true = make_ctr_dataset(nc + n_te, 8, 5000, dc, seed=1)
-    oracle = float(((np.sum(w_true[ccols[n_te:]] * cvals[n_te:], -1) > 0
-                     ).astype(int) == cy[n_te:]).mean())
+    oracle = float(((np.sum(w_true[ccols[:n_te]] * cvals[:n_te], -1) > 0
+                     ).astype(int) == cy[:n_te]).mean())
     ccfg = Config(num_feature_dim=dc, learning_rate=1.0, l2_c=0.0, model="sparse_lr")
     cmodel = SparseBinaryLR(dc)
     cstep = _scan_step(cmodel, ccfg)

@@ -27,12 +27,19 @@
 //
 // Usage: reference_oracle --data_dir=D [--dim=16] [--workers=1]
 //          [--iters=20] [--batch=100] [--test_interval=5] [--lr=0.1]
-//          [--C=1] [--sync=1] [--seed=0]
+//          [--C=1] [--sync=1] [--seed=0] [--save_model=PATH]
+//
+// --save_model additionally writes the final weights in the reference's
+// exact SaveModel layout (src/lr.cc:73-82: line 1 = dim via
+// `fout << dim << endl`, line 2 = each weight via default-precision
+// `fout << w << ' '`, then endl) so the framework's text import/export
+// can be golden-tested byte-for-byte against reference-written bytes.
 
 #include <cmath>
 #include <cstdio>
 #include <cstdlib>
 #include <cstring>
+#include <fstream>
 #include <string>
 #include <vector>
 
@@ -217,5 +224,15 @@ int main(int argc, char** argv) {
   std::printf("WEIGHTS");
   for (int j = 0; j < dim; ++j) std::printf(" %.9g", w[j]);
   std::printf("\n");
+
+  const std::string save_model = ArgS(argc, argv, "save_model", "");
+  if (!save_model.empty()) {
+    // Reference SaveModel layout, reproduced stream-op for stream-op
+    // (src/lr.cc:73-82) — default ostream precision (6 sig. digits).
+    std::ofstream fout(save_model.c_str());
+    fout << dim << std::endl;
+    for (int j = 0; j < dim; ++j) fout << w[j] << ' ';
+    fout << std::endl;
+  }
   return 0;
 }

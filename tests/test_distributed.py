@@ -60,7 +60,15 @@ def test_two_process_sync_run_agrees(tmp_path):
                          text=True)
         for i in range(2)
     ]
-    outs = [p.communicate(timeout=240)[0] for p in procs]
+    try:
+        outs = [p.communicate(timeout=240)[0] for p in procs]
+    finally:
+        # a crashed rank leaves its peer blocked in the collective —
+        # never orphan children holding the coordinator port
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.communicate()
     for p, out in zip(procs, outs):
         assert p.returncode == 0, out
 

@@ -280,3 +280,73 @@ class TestEvalLogloss:
         assert len(lls) == 2
         assert lls[-1] == pytest.approx(self._offline_ll(data_dir, ws[0], 32),
                                         rel=2e-2)
+
+
+class TestGoldenModelFormat:
+    """Byte-level cross-validation of the text model format against a
+    REFERENCE-WRITTEN file (VERDICT r2 #8): the oracle binary reproduces
+    ``LR::SaveModel``'s exact ofstream layout (reference src/lr.cc:73-82),
+    and the framework must round-trip those bytes — load the file, then
+    re-serialize to the identical byte string."""
+
+    def test_roundtrip_reference_written_file(self, tmp_path):
+        import subprocess
+
+        bench = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "benchmarks")
+        # build into tmp_path: never touch the tracked binary in-place,
+        # and a missing compiler skips instead of erroring
+        oracle = str(tmp_path / "reference_oracle")
+        try:
+            r = subprocess.run(
+                ["g++", "-O2", "-std=c++17", "-o", oracle,
+                 os.path.join(bench, "reference_oracle.cc")],
+                capture_output=True, text=True,
+            )
+        except OSError as e:
+            pytest.skip(f"no C++ compiler: {e}")
+        if r.returncode != 0 or not os.path.exists(oracle):
+            pytest.skip(f"cannot build reference_oracle: {r.stderr[-300:]}")
+
+        from distlr_tpu.data.synthetic import write_synthetic_shards
+        from distlr_tpu.train.export import load_model_text, save_model_text
+
+        d = str(tmp_path / "data")
+        write_synthetic_shards(d, 400, 24, num_parts=1, seed=3, sparsity=0.0)
+        golden = str(tmp_path / "ref_model.txt")
+        out = subprocess.run(
+            [oracle, f"--data_dir={d}", "--dim=24", "--iters=8",
+             "--batch=100", "--lr=0.3", "--C=1", "--test_interval=0",
+             f"--save_model={golden}"],
+            capture_output=True, text=True, check=True,
+        ).stdout
+        golden_bytes = open(golden, "rb").read()
+        # layout: line 1 = dim, line 2 = weights + trailing space
+        lines = golden_bytes.decode().split("\n")
+        assert lines[0] == "24" and lines[1].endswith(" ")
+
+        # framework load: values match the oracle's full-precision stdout
+        # within the file format's 6-significant-digit text precision
+        w = load_model_text(golden)
+        stdout_w = np.array(
+            [float(v) for ln in out.splitlines() if ln.startswith("WEIGHTS")
+             for v in ln.split()[1:]], dtype=np.float32)
+        assert w.shape == (24,)
+        np.testing.assert_allclose(w, stdout_w, rtol=1e-5)
+
+        # framework save: BYTE-identical re-serialization (%g == default
+        # ostream precision; 6 sig digits round-trip through float32)
+        ours = str(tmp_path / "ours.txt")
+        save_model_text(ours, w)
+        assert open(ours, "rb").read() == golden_bytes
+
+    def test_trainer_export_is_reference_loadable_layout(self, data_dir):
+        """Trainer.save_model output obeys the same two-line contract the
+        reference reader-side (and the golden file) pin."""
+        cfg = Config(data_dir=data_dir, num_feature_dim=32, num_iteration=2,
+                     learning_rate=0.5, l2_c=0.0, test_interval=0)
+        tr = Trainer(cfg).load_data()
+        tr.fit(eval_fn=lambda *_: None)
+        path = tr.save_model()
+        raw = open(path).read().split("\n")
+        assert raw[0] == "32" and raw[1].endswith(" ")
