@@ -120,13 +120,25 @@ class Config:
     # ---- PS / async mode ----
     ps_host: str = "127.0.0.1"        # DMLC_PS_ROOT_URI
     ps_port: int = 8001               # DMLC_PS_ROOT_PORT
-    # Where PS workers run their gradient/eval steps. "auto" picks the
-    # host CPU backend when the per-batch workload (param_dim x batch
-    # elements) is small enough that accelerator dispatch latency would
-    # dominate the step (tiny reference-scale models: D=123, B=256 is
-    # ~0.1 ms of math but ~1-80 ms of dispatch), and the default backend
-    # otherwise. "cpu" / "default" force the choice.
-    ps_compute_backend: str = "auto"  # auto | cpu | default
+    # Where PS workers run their gradient/eval steps. "auto" picks plain
+    # host numpy/BLAS when the per-batch workload (param_dim x batch
+    # elements) is tiny (jax dispatch itself dominates: measured 213 us
+    # dispatch vs 44 us math at D=123 B=256, and dispatch is GIL-bound so
+    # threaded workers serialize on it), the jitted host CPU backend for
+    # small workloads (accelerator round trips dominate), and the
+    # default backend otherwise. "numpy" / "cpu" / "default" force.
+    ps_compute_backend: str = "auto"  # auto | numpy | cpu | default
+    # Dense PS protocol optimization: replace the reference's two round
+    # trips per batch (pull -> grad -> push, src/lr.cc:116-132) with ONE
+    # fused push_pull (the reply carries the post-update weights), and in
+    # async mode additionally double-buffer — compute batch k+1's
+    # gradient while batch k's round trip is in flight (self-staleness
+    # bounded by 1 in-flight push; Hogwild-legal).  Sync trajectories are
+    # bit-identical (BSP rounds are totally ordered, so the fused reply
+    # equals the next pull); set False for the reference-faithful op
+    # sequence.  Keyed models (sparse/blocked) ignore this (their pull
+    # and push key sets differ per batch).
+    ps_pipeline: bool = True
     # Per-op receive timeout. A dead peer otherwise deadlocks the sync
     # BSP barrier forever (the reference's named straggler failure,
     # SURVEY.md §5.3), so detection is ON by default — but with a 10 min
@@ -181,9 +193,10 @@ class Config:
             # caught here as a config error, not an OverflowError deep in
             # splitmix64's uint64 arithmetic after data already parsed
             raise ValueError(f"hash_seed must be in [0, 2^64), got {self.hash_seed}")
-        if self.ps_compute_backend not in ("auto", "cpu", "default"):
+        if self.ps_compute_backend not in ("auto", "numpy", "cpu", "default"):
             raise ValueError(
-                f"ps_compute_backend must be auto|cpu|default, got {self.ps_compute_backend!r}"
+                "ps_compute_backend must be auto|numpy|cpu|default, "
+                f"got {self.ps_compute_backend!r}"
             )
 
     # -- reference env-var shim ------------------------------------------------

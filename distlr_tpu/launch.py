@@ -72,10 +72,11 @@ def _add_config_flags(p: argparse.ArgumentParser) -> None:
     p.add_argument("--process-id", dest="process_id", type=int)
     p.add_argument(
         "--ps-compute-backend", dest="ps_compute_backend",
-        choices=["auto", "cpu", "default"],
-        help="where PS workers run their jitted steps: auto (host CPU for "
-        "tiny per-batch workloads where dispatch latency dominates, "
-        "accelerator otherwise), or force cpu/default",
+        choices=["auto", "numpy", "cpu", "default"],
+        help="where PS workers run their dense steps: auto (plain numpy "
+        "for tiny per-batch workloads where jax dispatch dominates, "
+        "jitted host CPU for small ones, accelerator otherwise), or "
+        "force numpy/cpu/default",
     )
     p.add_argument(
         "--cpu-devices", dest="cpu_devices", type=int,
@@ -97,6 +98,7 @@ def _config_from_args(args: argparse.Namespace) -> Config:
             "nnz_max", "compat_mode", "checkpoint_dir", "checkpoint_interval",
             "profile_dir", "num_workers", "num_servers", "ps_compute_backend",
             "feature_dtype", "block_size", "ctr_fields", "hash_seed",
+            "ps_pipeline",
         }
     }
     cfg = Config.from_env(**overrides)
@@ -345,6 +347,11 @@ def main(argv=None) -> int:
                    help="async local mode: respawn dead server ranks and "
                    "re-seed them from a rolling snapshot (pair with "
                    "--max-worker-restarts)")
+    p.add_argument("--no-ps-pipeline", dest="ps_pipeline",
+                   action="store_false", default=None,
+                   help="disable the fused/pipelined dense PS protocol "
+                   "(fall back to the reference's serialized two-round-"
+                   "trips-per-batch sequence)")
     p.set_defaults(fn=cmd_ps)
 
     v = sub.add_parser("ps-server", help="host a KV server group (multi-host PS)")

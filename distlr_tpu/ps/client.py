@@ -44,6 +44,11 @@ def _load():
         lib.kv_push.argtypes = [ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p, ctypes.c_uint64]
         lib.kv_pull.restype = ctypes.c_int
         lib.kv_pull.argtypes = [ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p, ctypes.c_uint64]
+        lib.kv_push_pull.restype = ctypes.c_int
+        lib.kv_push_pull.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+            ctypes.c_void_p, ctypes.c_uint64,
+        ]
         lib.kv_push_init.restype = ctypes.c_int
         lib.kv_push_init.argtypes = [
             ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
@@ -155,6 +160,29 @@ class KVWorker:
             1 if force else 0,
         )
         return self._check(ts, "push_init")
+
+    def push_pull(self, vals: np.ndarray,
+                  keys: np.ndarray | None = None) -> np.ndarray:
+        """Fused push+pull: push a gradient and receive the post-update
+        weights for the same keys in ONE round trip per server (the
+        reference protocol spends two per batch, ``src/lr.cc:116-132``).
+        Sync mode: blocks through the BSP round like a push, and the
+        returned weights are the post-round state — bit-identical to the
+        pull that would have followed."""
+        vals = np.ascontiguousarray(vals, dtype=np.float32)
+        keys = self._all_keys if keys is None else self._validate_keys(keys)
+        if vals.shape[0] != keys.shape[0]:
+            raise ValueError(f"{vals.shape[0]} vals vs {keys.shape[0]} keys")
+        out = np.empty(keys.shape[0], dtype=np.float32)
+        ts = self._lib.kv_push_pull(
+            self._h,
+            keys.ctypes.data_as(ctypes.c_void_p),
+            vals.ctypes.data_as(ctypes.c_void_p),
+            out.ctypes.data_as(ctypes.c_void_p),
+            keys.shape[0],
+        )
+        self._check(ts, "push_pull")
+        return out
 
     def pull(self, keys: np.ndarray | None = None) -> np.ndarray:
         keys = self._all_keys if keys is None else self._validate_keys(keys)

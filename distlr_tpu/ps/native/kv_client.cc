@@ -138,8 +138,10 @@ int RoundTrip(Client* c, Op op, const Key* keys, const float* vals,
   // for a push that never comes, then mix gradients across rounds when
   // the next batch happens to touch that range.  The empty push is the
   // worker's "present" vote; it merges nothing.  (PULLs may still skip:
-  // replies are immediate, no barrier semantics.)
-  const bool visit_all = op == Op::kPush && c->push_visit_all;
+  // replies are immediate, no barrier semantics.)  Fused kPushPull
+  // carries push barrier semantics, so it votes too.
+  const bool is_push = op == Op::kPush || op == Op::kPushPull;
+  const bool visit_all = is_push && c->push_visit_all;
 
   // Phase 1: send the sliced request to every involved server.
   std::vector<std::vector<Key>> local_keys(c->servers.size());
@@ -155,7 +157,7 @@ int RoundTrip(Client* c, Op op, const Key* keys, const float* vals,
     const int fd = c->servers[s].fd;
     if (!WriteFull(fd, &h, sizeof(h)) ||
         (h.num_keys && !WriteFull(fd, lk.data(), lk.size() * sizeof(Key))) ||
-        (op == Op::kPush && h.num_keys &&
+        (is_push && h.num_keys &&
          !WriteFull(fd, vals + b, (e - b) * sizeof(Val)))) {
       c->poisoned = true;  // peers already received slices of this ts
       snprintf(c->err, sizeof(c->err), "send to server %zu failed", s);
@@ -198,7 +200,7 @@ int RoundTrip(Client* c, Op op, const Key* keys, const float* vals,
         snprintf(c->err, sizeof(c->err), "short response from server %zu", s);
         return -1;
       }
-      if (op == Op::kPull && out_vals != nullptr) {
+      if ((op == Op::kPull || op == Op::kPushPull) && out_vals != nullptr) {
         if (rh.num_keys != e - b) {
           c->poisoned = true;
           snprintf(c->err, sizeof(c->err),
@@ -275,6 +277,17 @@ int kv_push_init(void* handle, const uint64_t* keys, const float* vals,
 int kv_pull(void* handle, const uint64_t* keys, float* out_vals, uint64_t n) {
   auto* c = static_cast<distlr::Client*>(handle);
   return distlr::RoundTrip(c, distlr::Op::kPull, keys, nullptr, out_vals, n);
+}
+
+// Fused push+pull (kv_protocol.h kPushPull): pushes `vals` and receives
+// the post-update weights for the same keys into out_vals — ONE round
+// trip per server where the reference protocol takes two per batch.  In
+// sync mode the reply is deferred with the BSP round and carries the
+// post-round weights (trajectory-identical to pull-then-push).
+int kv_push_pull(void* handle, const uint64_t* keys, const float* vals,
+                 float* out_vals, uint64_t n) {
+  auto* c = static_cast<distlr::Client*>(handle);
+  return distlr::RoundTrip(c, distlr::Op::kPushPull, keys, vals, out_vals, n);
 }
 
 // Receive timeout for every pending/future op, in milliseconds; 0

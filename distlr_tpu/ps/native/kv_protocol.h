@@ -39,6 +39,16 @@ enum class Op : uint8_t {
   kShutdown = 4,
   kHello = 5,   // worker registration: client_id announces itself
   kStats = 6,   // health probe: response vals = server counters (see below)
+  // Fused push+pull: the request carries gradient vals like kPush; the
+  // reply carries the post-update weights for the SAME keys like a
+  // kPull.  One round trip replaces the reference's two per batch
+  // (src/lr.cc:116-132 pulls then pushes the full vector every step).
+  // Async: apply immediately, reply fresh weights.  Sync: the reply is
+  // deferred with the BSP round like any push — and when the barrier
+  // releases, the payload is the post-round weights, which is exactly
+  // what the worker's NEXT pull would have returned (rounds are totally
+  // ordered), so the fused trajectory is bit-identical to pull+push.
+  kPushPull = 7,
 };
 
 // kStats response payload, in order: dim, initialized,

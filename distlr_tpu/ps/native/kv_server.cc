@@ -68,6 +68,9 @@ struct PendingPush {
   // the reference has no such path — SURVEY.md §5.3).
   std::vector<Key> keys;
   std::vector<Val> vals;
+  // kPushPull: the deferred reply carries the post-round weights for
+  // this push's keys (the fused pull half) instead of an empty frame.
+  bool want_vals = false;
 };
 
 class KVServer {
@@ -164,10 +167,10 @@ class KVServer {
       keys.resize(h.num_keys);
       if (h.num_keys && !ReadFull(fd, keys.data(), h.num_keys * sizeof(Key))) break;
       const Op op = static_cast<Op>(h.op);
-      if (op == Op::kPush) {
+      if (op == Op::kPush || op == Op::kPushPull) {
         vals.resize(h.num_keys);
         if (h.num_keys && !ReadFull(fd, vals.data(), h.num_keys * sizeof(Val))) break;
-        HandlePush(fd, h, keys, vals);
+        HandlePush(fd, h, keys, vals, op == Op::kPushPull);
       } else if (op == Op::kPull) {
         HandlePull(fd, h, keys);
       } else if (op == Op::kBarrier) {
@@ -217,11 +220,22 @@ class KVServer {
     }
   }
 
-  // --- PUSH: the reference DataHandle push branch (src/main.cc:48-84) ---
+  // Gather the current weights for a key set (caller holds mu_) — the
+  // payload of a fused kPushPull reply.
+  std::vector<Val> WeightsFor(const std::vector<Key>& keys) {
+    std::vector<Val> out(keys.size());
+    for (size_t i = 0; i < keys.size(); ++i) out[i] = weights_[keys[i]];
+    return out;
+  }
+
+  // --- PUSH: the reference DataHandle push branch (src/main.cc:48-84).
+  // reply_weights = fused kPushPull: the reply carries the post-update
+  // weights for the pushed keys (see kv_protocol.h). ---
   void HandlePush(int fd, const MsgHeader& h, const std::vector<Key>& keys,
-                  const std::vector<Val>& vals) {
+                  const std::vector<Val>& vals, bool reply_weights = false) {
     std::unique_lock<std::mutex> lock(mu_);
     ++n_push_;
+    if (reply_weights) ++n_pull_;  // it serves the next pull too
     if (!keys.empty()) EnsureCapacity(keys.back());
 
     if (h.flags & kInitPush) {
@@ -233,8 +247,9 @@ class KVServer {
         for (size_t i = 0; i < keys.size(); ++i) weights_[keys[i]] = vals[i];
         initialized_ = true;
       }
+      const auto out = reply_weights ? WeightsFor(keys) : std::vector<Val>();
       lock.unlock();
-      Respond(fd, h, nullptr, 0);
+      Respond(fd, h, out.data(), out.size());
       return;
     }
 
@@ -245,8 +260,9 @@ class KVServer {
       // sync/async handling so it still counts toward the BSP barrier.
       for (size_t i = 0; i < keys.size(); ++i) weights_[keys[i]] = vals[i];
       initialized_ = true;
+      const auto out = reply_weights ? WeightsFor(keys) : std::vector<Val>();
       lock.unlock();
-      Respond(fd, h, nullptr, 0);
+      Respond(fd, h, out.data(), out.size());
       return;
     }
 
@@ -254,15 +270,16 @@ class KVServer {
       // Async/Hogwild: apply immediately (src/main.cc:79-84).
       for (size_t i = 0; i < keys.size(); ++i)
         weights_[keys[i]] -= lr_ * vals[i];
+      const auto out = reply_weights ? WeightsFor(keys) : std::vector<Val>();
       lock.unlock();
-      Respond(fd, h, nullptr, 0);
+      Respond(fd, h, out.data(), out.size());
       return;
     }
 
     // Sync/BSP: merge and defer the response (src/main.cc:57-78).
     if (merge_.size() < weights_.size()) merge_.resize(weights_.size(), 0.0f);
     for (size_t i = 0; i < keys.size(); ++i) merge_[keys[i]] += vals[i];
-    pending_.push_back({fd, h, keys, vals});
+    pending_.push_back({fd, h, keys, vals, reply_weights});
 
     if (static_cast<int>(pending_.size()) == num_workers_) {
       const float w = static_cast<float>(num_workers_);
@@ -296,10 +313,19 @@ class KVServer {
       std::vector<PendingPush> release;
       release.swap(pending_);
       // Releasing every deferred reply at once IS the BSP barrier.
-      // Written under mu_ (replies are header-only): a racing kShutdown
-      // holds mu_ while severing other connections, so it cannot cut a
-      // release loop midway and strand a peer without its reply.
-      for (auto& p : release) Respond(p.fd, p.header, nullptr, 0);
+      // Written under mu_ (weights are read for fused replies): a racing
+      // kShutdown holds mu_ while severing other connections, so it
+      // cannot cut a release loop midway and strand a peer without its
+      // reply.  Fused (kPushPull) pushes get the post-round weights for
+      // their keys — exactly what their next pull would have returned.
+      for (auto& p : release) {
+        if (p.want_vals) {
+          const auto out = WeightsFor(p.keys);
+          Respond(p.fd, p.header, out.data(), out.size());
+        } else {
+          Respond(p.fd, p.header, nullptr, 0);
+        }
+      }
     }
   }
 

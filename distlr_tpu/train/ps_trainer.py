@@ -55,13 +55,26 @@ log = get_logger(__name__)
 # PS bench went dispatch-bound without this).  2^25 elements ≈ 5-10 ms of
 # CPU math — the crossover against typical remote-dispatch cost.
 _PS_AUTO_CPU_THRESHOLD = 1 << 25
+# Below this, even the jitted host-CPU step is dominated by jax dispatch
+# overhead (measured 213 us dispatch vs 44 us of numpy math at D=123,
+# B=256 — and dispatch is GIL-bound, so threaded workers serialize on
+# it): "auto" drops to plain numpy/BLAS.  f32 numpy is also CLOSER to
+# the f32 reference trajectory than the bf16-matmul jax step.
+_PS_AUTO_NUMPY_THRESHOLD = 1 << 20
+
+
 def ps_compute_device(cfg: Config, rows: int | None = None):
-    """Device PS workers run their jitted steps on (None = default backend).
+    """Where PS workers run their dense step: the string ``"numpy"``
+    (host numpy/BLAS, no jax dispatch), a jax device, or None (default
+    backend).
 
     The reference's workers are host-CPU programs (``src/lr.cc:35-41``);
     our PS mode jits the same math, but for tiny models the accelerator
-    round trip per minibatch dwarfs the math, so "auto" keeps small steps
-    on the host CPU backend and sends big ones to the accelerator.
+    round trip per minibatch dwarfs the math, so "auto" keeps small
+    steps on the host — below ``_PS_AUTO_NUMPY_THRESHOLD`` as plain
+    numpy (jit dispatch itself dominates there), below
+    ``_PS_AUTO_CPU_THRESHOLD`` on the jitted CPU backend — and sends big
+    ones to the accelerator.
 
     ``rows`` is the actual per-step row count (minibatch size, full train
     shard, or full test set — the train and eval steps each pass their
@@ -71,13 +84,20 @@ def ps_compute_device(cfg: Config, rows: int | None = None):
     choice = cfg.ps_compute_backend
     if choice == "default":
         return None
+    if choice == "numpy":
+        return "numpy"
     if choice == "cpu":
         return jax.devices("cpu")[0]
-    if jax.default_backend() == "cpu":
+    if jax.default_backend() == "cpu" and rows is None:
         return None
     if rows is None:
         rows = cfg.batch_size
-    if rows <= 0 or ps_param_dim(cfg) * rows >= _PS_AUTO_CPU_THRESHOLD:
+    if rows <= 0:
+        return None
+    work = ps_param_dim(cfg) * rows
+    if work < _PS_AUTO_NUMPY_THRESHOLD:
+        return "numpy"
+    if jax.default_backend() == "cpu" or work >= _PS_AUTO_CPU_THRESHOLD:
         return None
     try:
         return jax.devices("cpu")[0]
@@ -85,6 +105,58 @@ def ps_compute_device(cfg: Config, rows: int | None = None):
         # JAX_PLATFORMS=tpu (no cpu backend initialized): degrade to the
         # default backend rather than abort — "auto" is best-effort.
         return None
+
+
+def _np_dense_grad(w, X, y, mask, l2_c, l2_scale_by_batch, num_classes=None):
+    """f32 numpy mirror of BinaryLR.grad / SoftmaxRegression.grad
+    (models/linear.py) for the tiny-step regime where jax dispatch
+    dominates; quirk gates (Q4 L2/B) identical."""
+    y = np.asarray(y)
+    mask = np.asarray(mask, np.float32)
+    n = np.float32(max(mask.sum(), 1.0))
+    if num_classes is None:
+        z = X @ w
+        sig = (0.5 * (1.0 + np.tanh(0.5 * z))).astype(np.float32)
+        resid = (sig - y.astype(np.float32)) * mask
+        g = resid @ X / n
+    else:
+        z = X @ w  # (B, K)
+        z -= z.max(axis=1, keepdims=True)
+        p = np.exp(z)
+        p /= p.sum(axis=1, keepdims=True)
+        p[np.arange(len(y)), y] -= 1.0
+        g = X.T @ (p * mask[:, None]) / n
+    if l2_c:
+        term = np.float32(l2_c) * w
+        g = g + (term / n if l2_scale_by_batch else term)
+    return np.asarray(g, dtype=np.float32)
+
+
+def _binary_eval_from_logits(z, y, mask) -> tuple[float, float]:
+    """(accuracy, logloss) of binary logits — THE masked-mean definition,
+    shared by the numpy dense eval and the keyed (sparse/blocked) evals
+    so the metrics cannot silently diverge."""
+    z = np.asarray(z, np.float64)
+    m = np.asarray(mask, np.float64)
+    n = max(m.sum(), 1.0)
+    acc = float((((z > 0).astype(np.int64) == y) * m).sum() / n)
+    ll = float(((np.logaddexp(0.0, z) - y * z) * m).sum() / n)
+    return acc, ll
+
+
+def _np_dense_eval(w, X, y, mask, num_classes=None):
+    """f32 numpy ``(accuracy, logloss)`` for the dense models — one
+    forward pass, no jax dispatch."""
+    z = np.asarray(X @ w, np.float64)
+    if num_classes is None:
+        return _binary_eval_from_logits(z, y, mask)
+    m = np.asarray(mask, np.float64)
+    n = max(m.sum(), 1.0)
+    pred = z.argmax(axis=1)
+    zs = z - z.max(axis=1, keepdims=True)
+    ll = np.log(np.exp(zs).sum(axis=1)) - zs[np.arange(len(y)), y]
+    acc = float(((pred == y) * m).sum() / n)
+    return acc, float((ll * m).sum() / n)
 
 
 @functools.lru_cache(maxsize=None)
@@ -312,6 +384,10 @@ class PSWorker:
         self.final_weights: np.ndarray | None = None
         self._barrier_base = 0
         self._sidecar_attempt = 0
+        # pipelined dense path state: last fused-reply weights, and a
+        # single comm thread (KV ops must never overlap on one connection)
+        self._w_cache: np.ndarray | None = None
+        self._comm = None
         if cfg.model in ("sparse_lr", "blocked_lr") and cfg.l2_c > 0:
             # Keyed PS applies L2 lazily (only a batch's touched keys/rows
             # decay, scaled by touch frequency) while the sync trainer
@@ -453,6 +529,17 @@ class PSWorker:
             train_rows = cfg.batch_size if cfg.batch_size > 0 else train.num_samples
             step_dev = ps_compute_device(cfg, train_rows)
             eval_dev = ps_compute_device(cfg, test.num_samples) if test is not None else None
+            K = cfg.num_classes if cfg.model == "softmax" else None
+            if step_dev == "numpy":
+                def compute_g(wf, X, y, mask):
+                    W = wf.reshape(cfg.num_feature_dim, K) if K else wf
+                    return _np_dense_grad(
+                        W, X, y, mask, cfg.l2_c, bool(cfg.l2_scale_by_batch), K
+                    ).reshape(-1)
+            else:
+                def compute_g(wf, X, y, mask):
+                    return np.asarray(self._grad_fn(*self._place(
+                        step_dev, self._shape_params(wf), X, y, mask))).reshape(-1)
         w = w0
         for epoch in range(start_epoch, cfg.num_iteration):
             train.reset()
@@ -484,11 +571,39 @@ class PSWorker:
                         cfg.l2_c, bool(cfg.l2_scale_by_batch),
                     )
                     self.kv.wait(self.kv.push(g_u.reshape(-1), keys=keys))
-            else:
+            elif not cfg.ps_pipeline:
+                # Reference-faithful serialized protocol: two blocking
+                # round trips per batch (src/lr.cc:116-132).
                 for X, y, mask in train:
                     w = self.kv.pull()
-                    g = self._grad_fn(*self._place(step_dev, self._shape_params(w), X, y, mask))
-                    self.kv.wait(self.kv.push(np.asarray(g).reshape(-1)))
+                    self.kv.wait(self.kv.push(compute_g(w, X, y, mask)))
+            elif cfg.sync_mode:
+                # Fused BSP: ONE deferred round trip per batch; the reply
+                # is the post-round weights = what the next pull would
+                # return (rounds totally ordered -> bit-identical
+                # trajectory, pinned by the oracle parity tests).
+                if self._w_cache is None:
+                    self._w_cache = self.kv.pull()
+                for X, y, mask in train:
+                    self._w_cache = self.kv.push_pull(
+                        compute_g(self._w_cache, X, y, mask))
+            else:
+                # Pipelined async (Hogwild): fused round trips double-
+                # buffered against compute — batch k+1's gradient is
+                # computed while batch k's push_pull is in flight.  The
+                # weights used are stale by exactly the one in-flight
+                # push; KV ops stay serialized on the comm thread (one
+                # connection, never two ops concurrently).
+                if self._w_cache is None:
+                    self._w_cache = self.kv.pull()
+                fut = None
+                for X, y, mask in train:
+                    g = compute_g(self._w_cache, X, y, mask)
+                    if fut is not None:
+                        self._w_cache = fut.result()
+                    fut = self._comm_pool().submit(self.kv.push_pull, g)
+                if fut is not None:
+                    self._w_cache = fut.result()
             if (
                 self.rank == 0
                 and test is not None
@@ -503,8 +618,13 @@ class PSWorker:
                     w = self.kv.pull()
                     test.reset()
                     Xt, yt, mt = test.next_batch()
-                    a, ll = self._acc_fn(*self._place(eval_dev, self._shape_params(w), Xt, yt, mt))
-                    acc, test_ll = float(a), float(ll)
+                    if eval_dev == "numpy":
+                        acc, test_ll = _np_dense_eval(
+                            w.reshape(cfg.num_feature_dim, K) if K else w,
+                            Xt, yt, mt.astype(np.float32), K)
+                    else:
+                        a, ll = self._acc_fn(*self._place(eval_dev, self._shape_params(w), Xt, yt, mt))
+                        acc, test_ll = float(a), float(ll)
                 self.metrics.log(epoch=epoch + 1, accuracy=acc,
                                  test_logloss=test_ll)
                 if eval_fn is not None:
@@ -546,12 +666,7 @@ class PSWorker:
         """(accuracy, logloss) from ONE forward pass's logits — numpy,
         host-side (the keyed eval paths are exactly the small-step regime
         where a second full-test-set forward would double the eval cost)."""
-        z = np.asarray(z, np.float64)
-        m = np.asarray(mask, np.float64)
-        n = max(m.sum(), 1.0)
-        acc = float((((z > 0).astype(np.int64) == y) * m).sum() / n)
-        ll = float(((np.logaddexp(0.0, z) - y * z) * m).sum() / n)
-        return acc, ll
+        return _binary_eval_from_logits(z, y, mask)
 
     def _blocked_eval(self, test) -> tuple[float, float]:
         """Full-test-set ``(accuracy, logloss)``: keyed pull of the test
@@ -589,7 +704,19 @@ class PSWorker:
             return flat.reshape(self.cfg.num_feature_dim, self.cfg.num_classes)
         return flat
 
+    def _comm_pool(self):
+        if self._comm is None:
+            from concurrent.futures import ThreadPoolExecutor  # noqa: PLC0415
+
+            self._comm = ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix=f"ps-comm-{self.rank}"
+            )
+        return self._comm
+
     def close(self):
+        if self._comm is not None:
+            self._comm.shutdown(wait=True)
+            self._comm = None
         self.kv.close()
 
 

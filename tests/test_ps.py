@@ -250,9 +250,12 @@ class TestPSComputeDevice:
         from distlr_tpu.train import ps_trainer
 
         monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
-        # tiny step -> host CPU
+        # tiny step -> plain numpy (jit dispatch itself dominates)
         small = Config(num_feature_dim=123, batch_size=256)
-        assert ps_trainer.ps_compute_device(small).platform == "cpu"
+        assert ps_trainer.ps_compute_device(small) == "numpy"
+        # mid-size step -> jitted host CPU backend
+        mid = Config(num_feature_dim=20_000, batch_size=256)
+        assert ps_trainer.ps_compute_device(mid).platform == "cpu"
         # big step -> default (accelerator) backend
         big = Config(num_feature_dim=1_000_000, batch_size=4096)
         assert ps_trainer.ps_compute_device(big) is None
@@ -260,9 +263,13 @@ class TestPSComputeDevice:
         full = Config(num_feature_dim=1_000_000, batch_size=-1)
         assert ps_trainer.ps_compute_device(full) is None
         # ...but the actual row count decides when known: a small shard
-        # stays on CPU, a huge eval set goes to the accelerator
-        assert ps_trainer.ps_compute_device(small.replace(batch_size=-1), rows=2000).platform == "cpu"
+        # stays on host, a huge eval set goes to the accelerator
+        assert ps_trainer.ps_compute_device(small.replace(batch_size=-1), rows=2000) == "numpy"
+        assert ps_trainer.ps_compute_device(mid.replace(batch_size=-1), rows=1000).platform == "cpu"
         assert ps_trainer.ps_compute_device(small, rows=5_000_000) is None
+        # forced numpy
+        assert ps_trainer.ps_compute_device(
+            big.replace(ps_compute_backend="numpy")) == "numpy"
 
     def test_auto_on_cpu_platform_is_default(self):
         # Under the test conftest the default backend IS cpu: auto must
@@ -503,3 +510,67 @@ class TestPSSoftmax:
         accs = []
         run_ps_local(cfg, eval_fn=lambda _e, a: accs.append(a), save=False)
         assert accs[-1] > 0.6, f"softmax PS accuracy {accs}"
+
+
+class TestFusedPushPull:
+    """kPushPull: one round trip per batch replaces the reference's two
+    (src/lr.cc:116-132).  Sync: the deferred reply carries the post-round
+    weights = bit-identical to the pull that would have followed."""
+
+    def test_async_applies_and_returns_fresh_weights(self):
+        with ServerGroup(2, 1, dim=8, sync=False, learning_rate=1.0) as g:
+            with KVWorker(g.hosts, 8, timeout_ms=20_000, sync_group=False) as kv:
+                kv.wait(kv.push_init(np.arange(8, dtype=np.float32)))
+                w = kv.push_pull(np.ones(8, np.float32))
+                np.testing.assert_allclose(w, np.arange(8) - 1.0)
+                # and the state is durable (a plain pull agrees)
+                np.testing.assert_allclose(kv.pull(), w)
+                kv.shutdown_servers()
+
+    def test_sync_defers_and_returns_post_round_weights(self):
+        import threading
+
+        with ServerGroup(2, 2, dim=8, sync=True, learning_rate=0.5) as g:
+            kv0 = KVWorker(g.hosts, 8, client_id=0, timeout_ms=20_000)
+            kv1 = KVWorker(g.hosts, 8, client_id=1, timeout_ms=20_000)
+            kv0.wait(kv0.push_init(np.zeros(8, np.float32)))
+            out = {}
+
+            def other():
+                out[1] = kv1.push_pull(np.full(8, 3.0, np.float32))
+
+            t = threading.Thread(target=other)
+            t.start()
+            out[0] = kv0.push_pull(np.full(8, 1.0, np.float32))
+            t.join()
+            # one mean BSP update: -0.5 * (1+3)/2 = -1; both workers see it
+            np.testing.assert_allclose(out[0], -np.ones(8), rtol=1e-6)
+            np.testing.assert_array_equal(out[0], out[1])
+            kv0.shutdown_servers()
+            kv0.close()
+            kv1.close()
+
+    def test_fused_sync_trajectory_equals_serialized(self, ps_data_dir):
+        """ps_pipeline=True must not change sync results at all — same
+        shards, same init, bitwise-equal final weights."""
+        common = dict(
+            data_dir=ps_data_dir, num_feature_dim=16, num_iteration=6,
+            learning_rate=0.3, l2_c=0.0, batch_size=100, test_interval=0,
+            compat_mode="reference", sync_last_gradient=False,
+            num_workers=2, num_servers=2, sync_mode=True,
+        )
+        w_fused = run_ps_local(Config(ps_pipeline=True, **common))[0]
+        w_serial = run_ps_local(Config(ps_pipeline=False, **common))[0]
+        np.testing.assert_array_equal(w_fused, w_serial)
+
+    def test_pipelined_async_converges(self, ps_data_dir):
+        """Double-buffered Hogwild (staleness <= 1 in-flight push) still
+        converges on the standard shards."""
+        evals = []
+        cfg = Config(
+            data_dir=ps_data_dir, num_feature_dim=16, num_iteration=20,
+            learning_rate=0.1, l2_c=0.0, batch_size=100, test_interval=10,
+            sync_mode=False, num_workers=2, num_servers=2, ps_pipeline=True,
+        )
+        run_ps_local(cfg, eval_fn=lambda ep, a: evals.append((ep, a)))
+        assert evals and evals[-1][1] >= 0.80, evals

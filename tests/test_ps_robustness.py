@@ -223,23 +223,26 @@ class TestMidTrainingRestart:
         rank 0's exit vote (generation 1) can never pair with it."""
         from distlr_tpu.config import Config
         from distlr_tpu.data.synthetic import write_synthetic_shards
-        from distlr_tpu.train.ps_trainer import PSWorker, run_ps_local
+        from distlr_tpu.train import ps_trainer
+        from distlr_tpu.train.ps_trainer import run_ps_local
 
         d = str(tmp_path / "data")
         write_synthetic_shards(d, 1200, 16, num_parts=2, seed=9, sparsity=0.0)
 
-        real_place = PSWorker._place
+        # inject at the dense hot path (the numpy fast-path grad — tiny
+        # D=16 steps route there, not through _place)
+        real_grad = ps_trainer._np_dense_grad
         state = {"calls": 0, "crashed": False}
 
-        def flaky_place(device, *arrays):
+        def flaky_grad(*args, **kw):
             # rank-agnostic but only one crash: trip after a few batches
             state["calls"] += 1
             if not state["crashed"] and state["calls"] == 5:
                 state["crashed"] = True
                 raise RuntimeError("injected mid-training crash")
-            return real_place(device, *arrays)
+            return real_grad(*args, **kw)
 
-        monkeypatch.setattr(PSWorker, "_place", staticmethod(flaky_place))
+        monkeypatch.setattr(ps_trainer, "_np_dense_grad", flaky_grad)
         cfg = Config(
             data_dir=d, num_feature_dim=16, num_workers=2, num_servers=2,
             num_iteration=8, learning_rate=0.2, l2_c=0.0, batch_size=100,
@@ -416,17 +419,19 @@ class TestSurvivingGroupResume:
             checkpoint_interval=0, ps_timeout_ms=4000,
         )
 
-        real_place = PSWorker._place
+        from distlr_tpu.train import ps_trainer
+
+        real_grad = ps_trainer._np_dense_grad
         state = {"calls": 0, "crashed": False}
 
-        def flaky_place(device, *arrays):
+        def flaky_grad(*args, **kw):
             state["calls"] += 1
             if not state["crashed"] and state["calls"] == 3:
                 state["crashed"] = True
                 raise RuntimeError("injected crash before first checkpoint")
-            return real_place(device, *arrays)
+            return real_grad(*args, **kw)
 
-        monkeypatch.setattr(PSWorker, "_place", staticmethod(flaky_place))
+        monkeypatch.setattr(ps_trainer, "_np_dense_grad", flaky_grad)
         group = ServerGroup(2, 2, ps_param_dim(cfg), learning_rate=0.5, sync=True)
         with group:
             with pytest.raises(Exception):
@@ -435,7 +440,7 @@ class TestSurvivingGroupResume:
             sidecar = os.path.join(ck, "ps_latest.json")
             assert not os.path.exists(sidecar)  # crash predates any ckpt
 
-            monkeypatch.setattr(PSWorker, "_place", staticmethod(real_place))
+            monkeypatch.setattr(ps_trainer, "_np_dense_grad", real_grad)
             resumed = run_ps_workers(
                 cfg, group.hosts, range(2), save=False, resume=True,
             )

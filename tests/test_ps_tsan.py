@@ -55,9 +55,14 @@ def test_server_race_free_under_tsan(tmp_path, sync, monkeypatch):
                 if rank == 0:
                     kv.wait(kv.push_init(np.zeros(dim, np.float32)))
                 kv.barrier(0)   # startup generation
-                for _ in range(steps):
+                for i in range(steps):
                     w = kv.pull()
-                    kv.wait(kv.push(w * 0.01 + 1.0))
+                    if i % 2:
+                        # fused op: exercises deferred-with-payload (sync)
+                        # and apply-and-reply (async) under TSan too
+                        kv.push_pull(w * 0.01 + 1.0)
+                    else:
+                        kv.wait(kv.push(w * 0.01 + 1.0))
                 kv.barrier(1)   # exit generation
                 if rank == 0:
                     # stats probe runs concurrently-shaped code paths too
