@@ -32,6 +32,32 @@ export BATCH_SIZE=${BATCH_SIZE:--1}
 export DMLC_NUM_SERVER=$NUM_SERVERS
 export DMLC_NUM_WORKER=$NUM_WORKERS
 
+# Validate the mode/env shape BEFORE any side effect (a misconfigured
+# run must fail instantly, not after generating a 40k-sample dataset).
+case "$MODE" in
+  sync)
+    # The S servers' role is played by the device mesh in sync mode: the
+    # process count does not change with NUM_SERVERS.  Say so instead of
+    # silently accepting a shape this mode does not honor.
+    if [ "$NUM_SERVERS" -gt 1 ]; then
+      echo "note: sync mode runs ONE SPMD process; num_servers=$NUM_SERVERS" \
+           "only shapes PS mode (use './local.sh $NUM_SERVERS $NUM_WORKERS ps')" >&2
+    fi
+    if [ "$SYNC_MODE" != "1" ]; then
+      echo "error: mode 'sync' with SYNC_MODE=$SYNC_MODE — use 'ps-async'" \
+           "for asynchronous training" >&2
+      exit 1
+    fi ;;
+  ps)
+    if [ "$SYNC_MODE" != "1" ]; then
+      echo "error: mode 'ps' with SYNC_MODE=$SYNC_MODE would train async" \
+           "silently — use 'ps-async' to ask for that explicitly" >&2
+      exit 1
+    fi ;;
+  ps-async) ;;
+  *) echo "mode must be sync|ps|ps-async" >&2; exit 1 ;;
+esac
+
 # Seeded synthetic data in the reference's directory layout (replaces
 # gen_data.py's unseeded a9a shuffle-and-shard; zero-egress: no download).
 # Regenerate unless every one of this run's W shards already exists.
@@ -46,5 +72,4 @@ case "$MODE" in
   sync)      exec python -m distlr_tpu.launch sync ;;
   ps)        exec python -m distlr_tpu.launch ps ;;
   ps-async)  exec python -m distlr_tpu.launch ps --async ;;
-  *) echo "mode must be sync|ps|ps-async" >&2; exit 1 ;;
 esac
