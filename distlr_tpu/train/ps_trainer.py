@@ -543,34 +543,45 @@ class PSWorker:
         w = w0
         for epoch in range(start_epoch, cfg.num_iteration):
             train.reset()
-            if sparse:
+            if sparse or blocked:
                 # Keyed Push/Pull: only the batch's unique touched columns
-                # travel (ps-lite's sliced-key capability, SURVEY.md §2.2
-                # E1.d/g — the reference app itself never exercises it).
-                for cols, vals, y, mask in train:
-                    keys, pos = np.unique(cols, return_inverse=True)
-                    keys = keys.astype(np.uint64)
+                # (sparse) / R-wide block-row key ranges (blocked) travel —
+                # ps-lite's sliced-key capability, SURVEY.md §2.2 E1.d/g,
+                # which the reference app itself never exercises.
+                def prep(b):
+                    ids = b[0]
+                    ub, pos = np.unique(ids, return_inverse=True)
+                    keys = (_expand_block_keys(ub, cfg.block_size) if blocked
+                            else ub.astype(np.uint64))
+                    return keys, (pos.reshape(ids.shape), *b[1:])
+
+                def kgrad(w_u, rest):
+                    if blocked:
+                        pos, lane_vals, y, mask = rest
+                        return _blocked_batch_grad(
+                            w_u.reshape(-1, cfg.block_size), pos, lane_vals,
+                            y, mask, cfg.l2_c, bool(cfg.l2_scale_by_batch),
+                        ).reshape(-1)
+                    pos, vals, y, mask = rest
+                    return _sparse_batch_grad(
+                        w_u, pos, vals, y, mask,
+                        cfg.l2_c, bool(cfg.l2_scale_by_batch),
+                    )
+
+                # Keyed rounds stay serialized in BOTH modes.  Sync: a
+                # pull issued before the round's push would read pre-round
+                # weights and change the BSP trajectory.  Async: a
+                # comm-thread pipeline (pull k+1 overlapping grad k) was
+                # measured ~10% SLOWER at CTR scale (4 workers, D=200k,
+                # B=512: 560-570k serialized vs ~490-520k pipelined) — the
+                # per-op executor handoff under GIL contention costs more
+                # than the ~50us localhost round trip it hides; unlike the
+                # dense path, there is no fused op here to REMOVE a round
+                # trip (pull and push key sets differ per batch).
+                for b in train:
+                    keys, rest = prep(b)
                     w_u = self.kv.pull(keys=keys)
-                    g_u = _sparse_batch_grad(
-                        w_u, pos.reshape(cols.shape), vals, y, mask,
-                        cfg.l2_c, bool(cfg.l2_scale_by_batch),
-                    )
-                    self.kv.wait(self.kv.push(g_u, keys=keys))
-            elif blocked:
-                # Keyed at ROW granularity: a batch's unique block rows
-                # travel as R-wide contiguous key ranges — same sliced-key
-                # machinery, amortized per-key bookkeeping (the KV analogue
-                # of the on-chip row gather, benchmarks/ROOFLINE.md).
-                R = cfg.block_size
-                for blocks, lane_vals, y, mask in train:
-                    ub, pos = np.unique(blocks, return_inverse=True)
-                    keys = _expand_block_keys(ub, R)
-                    t_u = self.kv.pull(keys=keys).reshape(len(ub), R)
-                    g_u = _blocked_batch_grad(
-                        t_u, pos.reshape(blocks.shape), lane_vals, y, mask,
-                        cfg.l2_c, bool(cfg.l2_scale_by_batch),
-                    )
-                    self.kv.wait(self.kv.push(g_u.reshape(-1), keys=keys))
+                    self.kv.wait(self.kv.push(kgrad(w_u, rest), keys=keys))
             elif not cfg.ps_pipeline:
                 # Reference-faithful serialized protocol: two blocking
                 # round trips per batch (src/lr.cc:116-132).
