@@ -15,6 +15,7 @@ numbers are NOT comparable to BENCH_CONFIGS.json.)
 
 from __future__ import annotations
 
+import argparse
 import functools
 import os
 import sys
@@ -36,7 +37,7 @@ import numpy as np  # noqa: E402
 from distlr_tpu.config import Config  # noqa: E402
 from distlr_tpu.models import BlockedSparseLR, SparseBinaryLR  # noqa: E402
 
-D, B, FIELDS, R, STEPS = 1_000_000, 65536, 21, 8, 20
+D, B, FIELDS, STEPS = 1_000_000, 65536, 21, 20
 LR = 0.5
 
 
@@ -55,8 +56,22 @@ def timeit(name, step, w, batch, steps=STEPS):
     return rate
 
 
-def main():
-    print(f"backend={jax.default_backend()} D={D} B={B} fields={FIELDS} R={R}")
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--block-sizes", default="8",
+                    help="comma-separated R sweep, e.g. 8,16,32 (bigger R "
+                    "= fewer gathers but more padded lanes AND a steeper "
+                    "statistical trade: fewer, larger conjunction groups)")
+    args = ap.parse_args(argv)
+    r_values = [int(s) for s in args.block_sizes.split(",")]
+    bad = [r for r in r_values if r <= 0 or D % r]
+    if bad:
+        # the framework proper rejects non-divisible block sizes
+        # (models/linear.py get_model) — don't silently bench a smaller
+        # table than the model the framework would build
+        raise SystemExit(f"--block-sizes must divide D={D}; bad: {bad}")
+
+    print(f"backend={jax.default_backend()} D={D} B={B} fields={FIELDS}")
     rng = np.random.default_rng(0)
     y = jnp.asarray(rng.integers(0, 2, B), jnp.int32)
     mask = jnp.ones(B, jnp.float32)
@@ -76,27 +91,30 @@ def main():
     r_scalar = timeit("scalar gathers (21 idx/sample)", step_scalar, w0,
                       (cols, vals, y, mask))
 
-    # --- blocked path: 3 row gathers of 8 lanes per sample ------------
-    g_count = -(-FIELDS // R)  # 3 groups (last padded)
-    nb = D // R
-    cfg_b = Config(num_feature_dim=D, model="blocked_lr", block_size=R, l2_c=0.0)
-    blocked = BlockedSparseLR(nb, R)
-    blocks = jnp.asarray(rng.integers(0, nb, size=(B, g_count)), jnp.int32)
-    lane_vals = np.ones((B, g_count, R), np.float32)
-    lane_vals[:, -1, FIELDS - (g_count - 1) * R:] = 0.0  # padded lanes
-    lane_vals = jnp.asarray(lane_vals)
+    for R in r_values:
+        # --- blocked path: ceil(F/R) row gathers of R lanes/sample ----
+        g_count = -(-FIELDS // R)
+        nb = D // R
+        cfg_b = Config(num_feature_dim=D, model="blocked_lr", block_size=R,
+                       l2_c=0.0)
+        blocked = BlockedSparseLR(nb, R)
+        blocks = jnp.asarray(rng.integers(0, nb, size=(B, g_count)), jnp.int32)
+        lane_vals = np.ones((B, g_count, R), np.float32)
+        pad = g_count * R - FIELDS
+        if pad:
+            lane_vals[:, -1, R - pad:] = 0.0  # padded lanes
+        lane_vals = jnp.asarray(lane_vals)
 
-    @functools.partial(jax.jit, donate_argnums=0)
-    def step_blocked(t, batch):
-        g = blocked.grad(t, batch, cfg_b)
-        return t - LR * g
+        @functools.partial(jax.jit, donate_argnums=0)
+        def step_blocked(t, batch, blocked=blocked, cfg_b=cfg_b):
+            g = blocked.grad(t, batch, cfg_b)
+            return t - LR * g
 
-    t0 = jnp.zeros((nb, R), jnp.float32)
-    r_blocked = timeit(f"blocked rows ({g_count} idx/sample, R={R})",
-                       step_blocked, t0, (blocks, lane_vals, y, mask))
-
-    print(f"speedup: {r_blocked / r_scalar:.2f}x "
-          f"(backend={jax.default_backend()})")
+        t0 = jnp.zeros((nb, R), jnp.float32)
+        r_blocked = timeit(f"blocked rows ({g_count} idx/sample, R={R})",
+                           step_blocked, t0, (blocks, lane_vals, y, mask))
+        print(f"  R={R}: speedup {r_blocked / r_scalar:.2f}x vs scalar "
+              f"(backend={jax.default_backend()})")
 
 
 if __name__ == "__main__":
