@@ -191,6 +191,26 @@ def bench_config_4(quick: bool) -> dict:
     step = _scan_step(model, cfg)
     sps = _steady_state_sps(step, jnp.zeros(d, jnp.float32), batch, steps, b)
 
+    # row-blocked variants of the same workload shape (the trainable
+    # blocked_lr path; statistical trade per benchmarks/ROOFLINE.md —
+    # bigger R = fewer gathers but coarser conjunction groups)
+    from distlr_tpu.data.hashing import make_uniform_blocked_batch
+    from distlr_tpu.models import BlockedSparseLR
+
+    blocked_sps = {}
+    rng_b = np.random.default_rng(1)
+    for r in (8, 32):
+        nb = d // r
+        cfg_b = Config(num_feature_dim=d, model="blocked_lr", block_size=r,
+                       learning_rate=0.5, l2_c=0.0)
+        bmodel = BlockedSparseLR(nb, r)
+        blocks_np, lv = make_uniform_blocked_batch(rng_b, b, fields, nb, r)
+        bbatch = (jnp.asarray(blocks_np), jnp.asarray(lv), jnp.asarray(y),
+                  jnp.ones(b, jnp.float32))
+        bstep = _scan_step(bmodel, cfg_b)
+        blocked_sps[r] = round(_steady_state_sps(
+            bstep, jnp.zeros((nb, r), jnp.float32), bbatch, steps, b), 1)
+
     # convergence (small): recover hashed signal to near-oracle accuracy;
     # metrics are HELD-OUT (first n_te rows never trained on)
     dc, nc, n_te = 512, 6000, 1500
@@ -213,6 +233,7 @@ def bench_config_4(quick: bool) -> dict:
         "config": 4,
         "name": f"sparse one-hot LR (Avazu-style), D={d}, {fields} fields, segment_sum",
         "samples_per_sec": round(sps, 1),
+        "blocked_samples_per_sec": blocked_sps,
         "accuracy": round(acc, 4),
         "test_logloss": round(test_ll, 5),
         "oracle_accuracy": round(oracle, 4),

@@ -3,12 +3,15 @@
 ROOFLINE.md measured rows-of-8 gathers at 3.4x the bytes/s of scalar
 gathers (amortized per-index cost).  The blocked CTR path
 (data/hashing.hash_group_blocks + models.BlockedSparseLR) exploits that:
-21 fields grouped into 3 blocks of 8 -> 3 row gathers + 3 row
-scatter-adds per sample instead of 21 + 21 scalars.  This measures the
-full train step (grad + SGD update, donated weights) for both layouts at
-config-4 scale (D=1M params, B=65536).
+F fields grouped into ceil(F/R) blocks of R lanes -> ceil(F/R) row
+gathers + scatter-adds per sample instead of F + F scalars.  This
+measures the full train step (grad + SGD update, donated weights) for
+the scalar layout and a sweep of block sizes (``--block-sizes 8,16,32``)
+at config-4 scale (D=1M params, B=65536, 21 fields).  Bigger R = fewer
+gathers (on-chip: R=32 measured 16M samples/s, 5.6x scalar) but a
+steeper statistical trade — see ROOFLINE.md's block-size frontier.
 
-Run on the real chip: python benchmarks/exp_blocked.py
+Run on the real chip: python benchmarks/exp_blocked.py [--block-sizes 8,16,32]
 (On a dead/absent accelerator it falls back to CPU and says so — CPU
 numbers are NOT comparable to BENCH_CONFIGS.json.)
 """
@@ -35,6 +38,7 @@ import jax.numpy as jnp  # noqa: E402
 import numpy as np  # noqa: E402
 
 from distlr_tpu.config import Config  # noqa: E402
+from distlr_tpu.data.hashing import make_uniform_blocked_batch  # noqa: E402
 from distlr_tpu.models import BlockedSparseLR, SparseBinaryLR  # noqa: E402
 
 D, B, FIELDS, STEPS = 1_000_000, 65536, 21, 20
@@ -63,7 +67,12 @@ def main(argv=None):
                     "= fewer gathers but more padded lanes AND a steeper "
                     "statistical trade: fewer, larger conjunction groups)")
     args = ap.parse_args(argv)
-    r_values = [int(s) for s in args.block_sizes.split(",")]
+    try:
+        r_values = [int(tok) for tok in args.block_sizes.split(",") if tok.strip()]
+    except ValueError as e:
+        raise SystemExit(f"--block-sizes must be comma-separated ints: {e}") from e
+    if not r_values:
+        raise SystemExit("--block-sizes is empty")
     bad = [r for r in r_values if r <= 0 or D % r]
     if bad:
         # the framework proper rejects non-divisible block sizes
@@ -98,12 +107,9 @@ def main(argv=None):
         cfg_b = Config(num_feature_dim=D, model="blocked_lr", block_size=R,
                        l2_c=0.0)
         blocked = BlockedSparseLR(nb, R)
-        blocks = jnp.asarray(rng.integers(0, nb, size=(B, g_count)), jnp.int32)
-        lane_vals = np.ones((B, g_count, R), np.float32)
-        pad = g_count * R - FIELDS
-        if pad:
-            lane_vals[:, -1, R - pad:] = 0.0  # padded lanes
-        lane_vals = jnp.asarray(lane_vals)
+        blocks_np, lane_vals_np = make_uniform_blocked_batch(rng, B, FIELDS, nb, R)
+        blocks = jnp.asarray(blocks_np)
+        lane_vals = jnp.asarray(lane_vals_np)
 
         @functools.partial(jax.jit, donate_argnums=0)
         def step_blocked(t, batch, blocked=blocked, cfg_b=cfg_b):

@@ -355,6 +355,22 @@ def read_ctr_meta(data_dir: str) -> dict | None:
         return json.load(f)
 
 
+def make_uniform_blocked_batch(rng, n: int, num_fields: int,
+                               num_blocks: int, block_size: int):
+    """Uniform-random one-hot blocked batch ``(blocks, lane_vals)`` for
+    benchmarks/tests: ``ceil(F/R)`` groups with the last group's padded
+    lanes zeroed — the layout ``default_field_groups`` +
+    ``hash_group_blocks`` produce for one-hot data, without the hashing
+    (bench workloads want uniform row access, not a data distribution)."""
+    g_count = -(-num_fields // block_size)
+    blocks = rng.integers(0, num_blocks, size=(n, g_count)).astype(np.int32)
+    lane_vals = np.ones((n, g_count, block_size), np.float32)
+    pad = g_count * block_size - num_fields
+    if pad:
+        lane_vals[:, -1, block_size - pad:] = 0.0
+    return blocks, lane_vals
+
+
 def resolve_ctr_fields(data_dir: str, ctr_fields: int) -> int:
     """The raw field count for blocked loading: an explicit
     ``cfg.ctr_fields`` wins; otherwise the data dir's manifest."""

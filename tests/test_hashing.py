@@ -142,3 +142,26 @@ class TestTrainerSparsePath:
         cfg = Config(num_feature_dim=64, model="sparse_lr")
         with pytest.raises(NotImplementedError):
             Trainer(cfg, mesh=mesh)
+
+
+class TestUniformBlockedBatch:
+    def test_layout_matches_hash_group_blocks_padding(self):
+        """The bench batch builder must produce the same (G, R) grouping
+        and zeroed-pad-lane layout the real pipeline
+        (default_field_groups + hash_group_blocks) produces."""
+        from distlr_tpu.data.hashing import (
+            default_field_groups,
+            hash_group_blocks,
+            make_uniform_blocked_batch,
+        )
+
+        rng = np.random.default_rng(0)
+        f, r, nb, n = 21, 8, 64, 32
+        blocks, lanes = make_uniform_blocked_batch(rng, n, f, nb, r)
+        ids = rng.integers(0, 5, size=(n, f))
+        _, ref_lanes = hash_group_blocks(ids, default_field_groups(f, r), nb)
+        assert blocks.shape == ref_lanes.shape[:2] == lanes.shape[:2]
+        assert lanes.shape == ref_lanes.shape
+        # identical pad-lane mask (one-hot data: real lanes 1.0, pads 0.0)
+        np.testing.assert_array_equal(lanes, ref_lanes)
+        assert (blocks >= 0).all() and (blocks < nb).all()
