@@ -372,11 +372,24 @@ def make_uniform_blocked_batch(rng, n: int, num_fields: int,
 
 
 def resolve_ctr_fields(data_dir: str, ctr_fields: int) -> int:
-    """The raw field count for blocked loading: an explicit
-    ``cfg.ctr_fields`` wins; otherwise the data dir's manifest."""
-    if ctr_fields:
-        return int(ctr_fields)
+    """The raw field count for blocked loading: from the data dir's
+    manifest, or from an explicit ``cfg.ctr_fields`` when there is no
+    manifest.  When BOTH exist they must agree — a conflict raises here
+    (config error) rather than surfacing later as a per-row parse
+    failure."""
     meta = read_ctr_meta(data_dir)
+    if ctr_fields:
+        if meta is not None and int(meta["num_fields"]) != int(ctr_fields):
+            # Surface the config-vs-manifest conflict here, where both
+            # sources are visible — not later as a baffling per-row
+            # "row has N fields, expected M" parse error.
+            raise ValueError(
+                f"cfg.ctr_fields={int(ctr_fields)} conflicts with "
+                f"{os.path.join(data_dir, _CTR_META)} num_fields="
+                f"{int(meta['num_fields'])} — drop ctr_fields to trust the "
+                "manifest, or regenerate the shards"
+            )
+        return int(ctr_fields)
     if meta is None:
         raise FileNotFoundError(
             f"{data_dir} has no {_CTR_META} manifest and cfg.ctr_fields is 0 "
@@ -495,6 +508,15 @@ def read_raw_ctr_file(path: str, num_fields: int):
     if (vals != np.floor(vals)).any():
         raise ValueError(
             f"{path}: raw-CTR ids must be integers (found fractional value)"
+        )
+    if (vals >= float(1 << 24)).any():
+        # Mirror write_raw_ctr_shards' bound: an id >= 2^24 has already
+        # been rounded in the float32 value slot, so casting it to int64
+        # would yield a silently-corrupted id, not the one on disk.
+        raise ValueError(
+            f"{path}: raw-CTR id exceeds float32's exact-integer range "
+            "(2^24); the id was already corrupted when the shard was "
+            "written"
         )
     # rows may list fields in any order; cols give the 0-based field slot.
     # -1 fill + post-check: a duplicated field number passes the length

@@ -724,11 +724,31 @@ class PSWorker:
             )
         return self._comm
 
-    def close(self):
-        if self._comm is not None:
-            self._comm.shutdown(wait=True)
-            self._comm = None
-        self.kv.close()
+    def close(self, *, wait: bool = True):
+        comm, self._comm = self._comm, None
+        if comm is None:
+            self.kv.close()
+            return
+        if wait:
+            comm.shutdown(wait=True)
+            self.kv.close()
+            return
+        # wait=False: the failure/restart path must not block behind an
+        # in-flight push_pull to a dead server (its ps_timeout_ms is
+        # minutes — far past the 5 s server-respawn reconnect window);
+        # the rebuilt PSWorker creates a fresh executor.  But the native
+        # handle must NOT be freed under a live ctypes call (the GIL is
+        # released inside it — kv_close then is a use-after-free), so
+        # the handle close rides a reaper thread that first drains the
+        # executor.
+        comm.shutdown(wait=False, cancel_futures=True)
+
+        def _reap():
+            comm.shutdown(wait=True)
+            self.kv.close()
+
+        threading.Thread(target=_reap, daemon=True,
+                         name=f"ps-close-{self.rank}").start()
 
 
 def run_ps_workers(cfg: Config, hosts: str, ranks, *, eval_fn=None, save=False,
@@ -774,7 +794,7 @@ def run_ps_workers(cfg: Config, hosts: str, ranks, *, eval_fn=None, save=False,
                                             rejoin=attempts > 0)
                 return
             except Exception as e:  # surface worker failures to the caller
-                workers[i].close()
+                workers[i].close(wait=False)
                 attempts += 1
                 if cfg.sync_mode or attempts > max_restarts:
                     errors.append(e)

@@ -228,7 +228,14 @@ class TestRawCtrShards:
         assert m["meta"]["num_fields"] == 6
         assert read_ctr_meta(d)["seed"] == 9
         assert resolve_ctr_fields(d, 0) == 6
-        assert resolve_ctr_fields(d, 11) == 11  # explicit cfg wins
+        assert resolve_ctr_fields(d, 6) == 6  # explicit cfg, agreeing
+        # an explicit cfg.ctr_fields that CONTRADICTS the manifest is a
+        # config error, surfaced here — not a downstream per-row parse
+        # failure (ADVICE r3)
+        with pytest.raises(ValueError, match="conflicts with"):
+            resolve_ctr_fields(d, 11)
+        # without a manifest the explicit value is the only source: wins
+        assert resolve_ctr_fields(str(tmp_path / "nometa"), 11) == 11
         ids, y = read_raw_ctr_file(m["train_parts"][0], 6)
         assert ids.shape[1] == 6 and ids.dtype == np.int64
         assert (ids >= 0).all() and (ids < 40).all()
@@ -276,6 +283,16 @@ class TestRawCtrShards:
         frac.write_text("1 1:3.7 2:4 3:7\n")
         with pytest.raises(ValueError, match="integers"):
             read_raw_ctr_file(str(frac), 3)
+        # ids at/above 2^24 were already rounded in the float32 value
+        # slot — the reader must mirror the writer's bound (ADVICE r3)
+        big = tmp_path / "big"
+        big.write_text(f"1 1:3 2:4 3:{1 << 24}\n")
+        with pytest.raises(ValueError, match="exact-integer range"):
+            read_raw_ctr_file(str(big), 3)
+        ok = tmp_path / "ok"
+        ok.write_text(f"1 1:3 2:4 3:{(1 << 24) - 1}\n")
+        ids, _ = read_raw_ctr_file(str(ok), 3)
+        assert ids[0, 2] == (1 << 24) - 1
 
     def test_negative_hash_seed_rejected_at_config(self):
         with pytest.raises(ValueError, match="hash_seed"):
