@@ -94,7 +94,13 @@ class Config:
     # per-dataset quantization; the scale folds into the model as
     # feature_scale, measured +11% step rate here and 2x the max resident
     # dataset).  Dense models only; sparse vals stay float32.
-    feature_dtype: str = "float32"    # float32 | bfloat16 | int8
+    # "int8_dot" additionally keeps BOTH matmul operands int8 (native
+    # int8 x int8 -> int32 MXU contraction with dynamic per-step scales
+    # for w and the residual) instead of converting the (B, D) tile to
+    # bfloat16 — the convert is the measured wall (~165k samples/s at
+    # D=1M); the native dot measured ~170k, 1.55x bf16
+    # (benchmarks/exp_int8_dot.py).  binary_lr only.
+    feature_dtype: str = "float32"    # float32 | bfloat16 | int8 | int8_dot
 
     # ---- parity / compat with reference quirks (SURVEY.md §3.5) ----
     # "reference" reproduces documented quirks (Q1 last-gradient sync update,
@@ -149,6 +155,15 @@ class Config:
     # for fast failure detection on small steps.
     ps_timeout_ms: int = 600_000
 
+    # ---- input pipeline ----
+    # Host->device streaming depth in Trainer.fit: with prefetch=N, up
+    # to N-1 batches are host-sliced and device_put ahead of the running
+    # step from a background thread (double buffering at 2 — the
+    # trajectory is identical, only the host work overlaps the device
+    # step).  1 = strictly serial (the reference's DataIter shape,
+    # include/data_iter.h:40-55).
+    prefetch: int = 2
+
     # ---- checkpoint / obs ----
     checkpoint_dir: str | None = None
     checkpoint_interval: int = 0      # epochs; 0 = only final save
@@ -174,9 +189,24 @@ class Config:
             raise ValueError("num_feature_dim must be positive")
         if self.batch_size == 0 or self.batch_size < -1:
             raise ValueError("batch_size must be -1 (full shard) or positive")
-        if self.feature_dtype not in ("float32", "bfloat16", "int8"):
+        if self.feature_dtype not in ("float32", "bfloat16", "int8", "int8_dot"):
             raise ValueError(
-                f"feature_dtype must be float32|bfloat16|int8, got {self.feature_dtype!r}"
+                "feature_dtype must be float32|bfloat16|int8|int8_dot, "
+                f"got {self.feature_dtype!r}"
+            )
+        if self.feature_dtype == "int8_dot" and self.model != "binary_lr":
+            raise ValueError(
+                "feature_dtype='int8_dot' (native int8 MXU contraction) "
+                f"requires model='binary_lr'; got model={self.model!r}"
+            )
+        if self.feature_dtype == "int8_dot" and self.feature_shards > 1:
+            # The feature-sharded / ring steps compute partial logits with
+            # the bf16 convert formulation; running them on an int8_dot
+            # model would silently fall back to the convert path.  Reject
+            # until the sharded steps grow a native-int8 formulation.
+            raise ValueError(
+                "feature_dtype='int8_dot' is single-shard only "
+                "(feature_shards must be 1)"
             )
         if self.model in ("sparse_lr", "blocked_lr") and self.feature_dtype != "float32":
             # Quantized resident feature storage is a dense-matrix
@@ -187,6 +217,8 @@ class Config:
                 f"{self.model} stores feature values as float32 "
                 "(set feature_dtype='float32')"
             )
+        if self.prefetch < 1:
+            raise ValueError("prefetch must be >= 1 (1 = no prefetch)")
         if self.ctr_fields < 0:
             raise ValueError("ctr_fields must be >= 0 (0 = read from manifest)")
         if not 0 <= self.hash_seed < 1 << 64:

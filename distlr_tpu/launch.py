@@ -54,10 +54,12 @@ def _add_config_flags(p: argparse.ArgumentParser) -> None:
                    help="seed of the load-time feature hash")
     p.add_argument("--compat-mode", dest="compat_mode", choices=["correct", "reference"])
     p.add_argument("--feature-dtype", dest="feature_dtype",
-                   choices=["float32", "bfloat16", "int8"],
+                   choices=["float32", "bfloat16", "int8", "int8_dot"],
                    help="device-resident storage dtype for dense features "
                    "(int8: symmetric per-dataset quantization; halves/quarters "
-                   "the HBM stream the dense step is bound by)")
+                   "the HBM stream the dense step is bound by; int8_dot: "
+                   "int8 storage plus the native int8 MXU contraction — "
+                   "skips the bf16 convert wall, binary_lr only)")
     p.add_argument("--checkpoint-dir", dest="checkpoint_dir")
     p.add_argument("--checkpoint-interval", dest="checkpoint_interval", type=int)
     p.add_argument("--profile-dir", dest="profile_dir")

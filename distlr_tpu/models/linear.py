@@ -35,6 +35,74 @@ from distlr_tpu.config import Config
 from distlr_tpu.utils.reference_rng import reference_init_weights
 
 
+# Longest int8 x int8 contraction whose worst case (every product
+# +/-127*127, same sign) still fits int32: floor((2^31-1) / 127^2).
+_INT8_ACC_MAX = (2**31 - 1) // (127 * 127)
+
+
+# Chunks below this are useless on the MXU (every k divides by 1, so a
+# floor is what actually forces awkward lengths onto the convert path).
+_INT8_MIN_CHUNK = 1024
+
+
+def _int8_chunk_len(k: int) -> int | None:
+    """Largest divisor of ``k`` that keeps a worst-case int8 x int8
+    contraction inside int32 (``None``: no divisor of useful size — the
+    caller must take the convert path).  Trace-time only (static
+    shapes)."""
+    if k <= _INT8_ACC_MAX:
+        return k
+    best = None
+    for d in range(1, int(k**0.5) + 1):
+        if k % d:
+            continue
+        for c in (d, k // d):
+            if c <= _INT8_ACC_MAX and (best is None or c > best):
+                best = c
+    return best if best is not None and best >= _INT8_MIN_CHUNK else None
+
+
+def _int8_contract(a, b, a_axis: int) -> jnp.ndarray:
+    """Overflow-safe ``a . b`` over ``a``'s axis ``a_axis`` and ``b``'s
+    leading axis, both int8, on the MXU -> float32 (unscaled).
+
+    A single int32 accumulation wraps once the contraction length
+    exceeds ``_INT8_ACC_MAX`` (~133k) in the worst case — reachable for
+    the backward at ``batch_size=-1`` on a big shard, and for the
+    forward at north-star D.  The contraction is therefore split into
+    the largest dividing chunks that cannot wrap, with the cross-chunk
+    reduction in float32 (chunk partials are < 2^31, so the f32
+    rounding there is ~1e-9 relative — far below the int8 quantization
+    noise).  When the length is awkward (no divisor <= the bound) the
+    bfloat16-convert formulation is used instead: slower, never wrong.
+    """
+    k = a.shape[a_axis]
+    n_c = _int8_chunk_len(k)
+    if n_c == k:
+        out = jax.lax.dot_general(
+            a, b, (((a_axis % a.ndim,), (0,)), ((), ())),
+            preferred_element_type=jnp.int32,
+        )
+        return out.astype(jnp.float32)
+    if n_c is None:  # no safe chunking: correct-but-slower convert path
+        out = jax.lax.dot_general(
+            a.astype(jnp.bfloat16), b.astype(jnp.bfloat16),
+            (((a_axis % a.ndim,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return out
+    c = k // n_c
+    # split the contraction axis into (c, n_c) and batch over c
+    a_axis = a_axis % a.ndim
+    ar = a.reshape(a.shape[:a_axis] + (c, n_c) + a.shape[a_axis + 1:])
+    br = b.reshape((c, n_c) + b.shape[1:])
+    partial = jax.lax.dot_general(
+        ar, br, (((a_axis + 1,), (1,)), ((a_axis,), (0,))),
+        preferred_element_type=jnp.int32,
+    )  # (c, *rest)
+    return jnp.sum(partial.astype(jnp.float32), axis=0)
+
+
 def _masked_mean(values, mask):
     denom = jnp.maximum(jnp.sum(mask), 1)
     return jnp.sum(values * mask) / denom
@@ -60,6 +128,16 @@ class BinaryLR:
     # into the matmul read; applied to the (B,)/(D,) RESULT vectors, not
     # the (B, D) matrix.  1.0 = features are already real-valued.
     feature_scale: float = 1.0
+    # Native int8 x int8 -> int32 MXU contraction (cfg.feature_dtype=
+    # "int8_dot").  The plain int8 storage path converts the whole (B, D)
+    # tile to bfloat16 before the dot — a VPU-bound convert wall measured
+    # at ~151-165k samples/s at D=1M (benchmarks/ROOFLINE.md,
+    # exp_int8_dot.py).  This path instead quantizes the SMALL per-step
+    # operands — w over D for the forward, the residual over B for the
+    # backward — with dynamic symmetric scales and feeds both dots int8
+    # operands end to end (~170k measured, 1.55x bf16).  Requires X to
+    # be int8 (the trainer's feature quantization guarantees it).
+    int8_dot: bool = False
 
     def init(self, cfg: Config) -> jnp.ndarray:
         if cfg.reference_rng_init:
@@ -70,6 +148,11 @@ class BinaryLR:
         return jax.random.uniform(key, (self.num_features,), dtype=jnp.float32)
 
     def logits(self, w, X):
+        if self.int8_dot:
+            s_w = jnp.maximum(jnp.max(jnp.abs(w)), 1e-8) * (1.0 / 127.0)
+            wq = jnp.clip(jnp.round(w / s_w), -127, 127).astype(jnp.int8)
+            z = _int8_contract(X, wq, X.ndim - 1)
+            return z * (s_w * self.feature_scale)
         cdt = jnp.dtype(self.compute_dtype)
         z = jnp.dot(
             X.astype(cdt),
@@ -93,6 +176,15 @@ class BinaryLR:
         z = self.logits(w, X)
         resid = (jax.nn.sigmoid(z) - y.astype(jnp.float32)) * mask
         n = jnp.maximum(jnp.sum(mask), 1).astype(jnp.float32)
+        if self.int8_dot:
+            # Residuals live in (-1, 1): a dynamic symmetric scale keeps
+            # full int8 resolution on whatever range this batch actually
+            # spans (near convergence |r| shrinks, and a fixed scale
+            # would quantize everything to 0).
+            s_r = jnp.maximum(jnp.max(jnp.abs(resid)), 1e-8) * (1.0 / 127.0)
+            rq = jnp.clip(jnp.round(resid / s_r), -127, 127).astype(jnp.int8)
+            g = _int8_contract(rq, X, 0) * (s_r * self.feature_scale) / n
+            return g + _l2_grad(w, cfg, n)
         cdt = jnp.dtype(self.compute_dtype)
         g = (
             jnp.dot(
@@ -325,7 +417,8 @@ class BlockedSparseLR:
 
 def get_model(cfg: Config):
     if cfg.model == "binary_lr":
-        return BinaryLR(cfg.num_feature_dim, compute_dtype=cfg.compute_dtype)
+        return BinaryLR(cfg.num_feature_dim, compute_dtype=cfg.compute_dtype,
+                        int8_dot=cfg.feature_dtype == "int8_dot")
     if cfg.model == "softmax":
         return SoftmaxRegression(cfg.num_feature_dim, cfg.num_classes, compute_dtype=cfg.compute_dtype)
     if cfg.model == "sparse_lr":

@@ -6,6 +6,7 @@ via symmetric per-dataset quantization with the scale folded into the
 model (``feature_scale``).  These tests pin the numerics.
 """
 
+import jax.numpy as jnp
 import numpy as np
 import pytest
 
@@ -62,6 +63,102 @@ class TestFeatureScaleModel:
         g_f = np.asarray(exact.grad(w, (X, y, mask), cfg))
         g_q = np.asarray(quant.grad(w, (Xq, y, mask), cfg))
         np.testing.assert_allclose(g_f, g_q, atol=5e-2)
+
+
+class TestInt8Dot:
+    """feature_dtype='int8_dot': native int8 x int8 -> int32 contraction
+    with dynamic per-step scales for w and the residual — the formulation
+    benchmarks/exp_int8_dot.py measured past the bf16-convert wall
+    (VERDICT r3 item 4: ship it, don't leave it an experiment)."""
+
+    def _quantized(self, rng, b=64, d=16):
+        X = rng.standard_normal((b, d)).astype(np.float32)
+        scale = float(np.abs(X).max()) / 127.0
+        Xq = np.clip(np.rint(X / scale), -127, 127).astype(np.int8)
+        return X, Xq, scale
+
+    def test_logits_error_bounded(self):
+        rng = np.random.default_rng(2)
+        X, Xq, scale = self._quantized(rng)
+        w = 0.3 * rng.standard_normal(16).astype(np.float32)
+
+        exact = BinaryLR(16, compute_dtype="float32")
+        native = BinaryLR(16, feature_scale=scale, int8_dot=True)
+        z_f = np.asarray(exact.logits(w, X))
+        z_q = np.asarray(native.logits(w, Xq))
+        # two quantization sources: X rounding (<= scale/2 per element,
+        # weighted by |w|) and w rounding (<= s_w/2 per weight, weighted
+        # by the dequantized |x|)
+        s_w = max(np.abs(w).max(), 1e-8) / 127.0
+        bound = (
+            scale / 2 * np.abs(w).sum()
+            + s_w / 2 * (np.abs(Xq.astype(np.float32)) * scale).sum(axis=1).max()
+        )
+        assert np.max(np.abs(z_f - z_q)) <= bound * 1.01, (
+            np.max(np.abs(z_f - z_q)), bound)
+
+    def test_grad_tracks_float32(self):
+        rng = np.random.default_rng(3)
+        X, Xq, scale = self._quantized(rng)
+        y = rng.integers(0, 2, 64).astype(np.int32)
+        mask = np.ones(64, np.float32)
+        w = 0.1 * rng.standard_normal(16).astype(np.float32)
+        cfg = Config(num_feature_dim=16, l2_c=0.0)
+
+        exact = BinaryLR(16, compute_dtype="float32")
+        native = BinaryLR(16, feature_scale=scale, int8_dot=True)
+        g_f = np.asarray(exact.grad(w, (X, y, mask), cfg))
+        g_q = np.asarray(native.grad(w, (Xq, y, mask), cfg))
+        np.testing.assert_allclose(g_f, g_q, atol=5e-2)
+
+    def test_trainer_end_to_end_tracks_float32(self, data_dir):
+        acc_f = _fit(data_dir).evaluate()
+        tr = _fit(data_dir, feature_dtype="int8_dot")
+        assert tr.model.int8_dot
+        assert tr.model.feature_scale != 1.0
+        assert tr._train_data._feats[0].dtype == np.int8
+        acc_q = tr.evaluate()
+        assert abs(acc_f - acc_q) < 0.02, (acc_f, acc_q)
+
+    def test_rejected_outside_dense_binary_lr(self):
+        with pytest.raises(ValueError, match="binary_lr"):
+            Config(model="softmax", feature_dtype="int8_dot", num_classes=3)
+        with pytest.raises(ValueError, match="binary_lr"):
+            Config(model="sparse_lr", feature_dtype="int8_dot",
+                   num_feature_dim=64)
+        with pytest.raises(ValueError, match="single-shard"):
+            Config(feature_dtype="int8_dot", feature_shards=2)
+
+    def test_long_contraction_does_not_wrap_int32(self):
+        """Worst-case same-sign int8 contractions longer than
+        ~133k products wrap a single int32 accumulator (code-review r4
+        finding); the chunked formulation must stay exact."""
+        from distlr_tpu.models.linear import _INT8_ACC_MAX, _int8_contract
+
+        d = 150_000  # > _INT8_ACC_MAX, divisor 75k fits
+        assert d > _INT8_ACC_MAX
+        X = np.full((2, d), 127, np.int8)
+        w = np.full(d, 127, np.int8)
+        want = 127.0 * 127.0 * d  # = 2.42e9 > 2^31: naive int32 wraps
+        z = np.asarray(_int8_contract(jnp.asarray(X), jnp.asarray(w), 1))
+        np.testing.assert_allclose(z, [want, want], rtol=1e-6)
+        # backward shape: contraction over the batch axis
+        r = np.full(d, 127, np.int8)
+        Xb = np.full((d, 3), 127, np.int8)
+        g = np.asarray(_int8_contract(jnp.asarray(r), jnp.asarray(Xb), 0))
+        np.testing.assert_allclose(g, [want] * 3, rtol=1e-6)
+
+    def test_awkward_length_falls_back_exactly(self):
+        """A contraction length with no divisor <= the int32 bound (a
+        prime > 133k) must take the convert path, not wrap."""
+        from distlr_tpu.models.linear import _int8_chunk_len, _int8_contract
+
+        p = 150_001  # prime
+        assert _int8_chunk_len(p) is None
+        X = np.full((2, p), 127, np.int8)
+        w = np.full(p, 127, np.int8)
+        z = np.asarray(_int8_contract(jnp.asarray(X), jnp.asarray(w), 1))
+        np.testing.assert_allclose(z, [127.0 * 127.0 * p] * 2, rtol=1e-2)
 
 
 class TestTrainerQuantized:
