@@ -74,6 +74,114 @@ def _bench_tpu(d: int, b: int, steps: int, lr: float, l2: float) -> float:
     return b * steps / dt
 
 
+def _bench_dense_int8dot(d: int, b: int, steps: int, lr: float) -> float:
+    """Dense step with feature_dtype='int8_dot': int8-resident X and the
+    native int8 x int8 -> int32 MXU contraction (no bf16 convert of the
+    (B, D) tile).  Model built exactly as the Trainer builds it."""
+    import dataclasses
+
+    from distlr_tpu.config import Config
+    from distlr_tpu.models import get_model
+
+    cfg = Config(num_feature_dim=d, learning_rate=lr, l2_c=0.0,
+                 feature_dtype="int8_dot")
+    # feature_scale folded in as Trainer._quantize_features does
+    model = dataclasses.replace(get_model(cfg), feature_scale=1.0 / 127.0)
+
+    @jax.jit
+    def make_data(key):
+        kx, ky = jax.random.split(key)
+        X = jax.random.randint(kx, (b, d), -127, 128, dtype=jnp.int8)
+        y = jax.random.bernoulli(ky, 0.5, (b,)).astype(jnp.int32)
+        return X, y, jnp.ones((b,), jnp.float32)
+
+    batch = jax.block_until_ready(make_data(jax.random.PRNGKey(0)))
+
+    @jax.jit
+    def run(w, batch):
+        def one_step(w, _):
+            return w - cfg.learning_rate * model.grad(w, batch, cfg), None
+
+        w, _ = jax.lax.scan(one_step, w, None, length=steps)
+        return w
+
+    w = run(jnp.zeros(d, jnp.float32), batch)
+    assert np.isfinite(float(jnp.sum(w)))
+    t0 = time.perf_counter()
+    w = run(w, batch)
+    checksum = float(jnp.sum(w))
+    dt = time.perf_counter() - t0
+    assert np.isfinite(checksum)
+    return b * steps / dt
+
+
+def _bench_sparse(d: int, b: int, fields: int, steps: int, lr: float) -> float:
+    """Sparse one-hot LR step (config-4 style): F scalar gathers/sample,
+    segment_sum scatter gradient.  Device-resident batch, donated weights."""
+    import functools
+
+    from distlr_tpu.config import Config
+    from distlr_tpu.models import SparseBinaryLR
+
+    cfg = Config(num_feature_dim=d, model="sparse_lr", l2_c=0.0)
+    model = SparseBinaryLR(d)
+    rng = np.random.default_rng(0)
+    cols = jnp.asarray(rng.integers(0, d, size=(b, fields)), jnp.int32)
+    vals = jnp.ones((b, fields), jnp.float32)
+    y = jnp.asarray(rng.integers(0, 2, b), jnp.int32)
+    mask = jnp.ones(b, jnp.float32)
+    batch = (cols, vals, y, mask)
+
+    @functools.partial(jax.jit, donate_argnums=0)
+    def step(w, batch):
+        return w - lr * model.grad(w, batch, cfg)
+
+    w = step(jnp.zeros(d, jnp.float32), batch)
+    assert np.isfinite(float(jnp.sum(w)))  # readback = honest sync
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        w = step(w, batch)
+    checksum = float(jnp.sum(w))
+    dt = time.perf_counter() - t0
+    assert np.isfinite(checksum)
+    return b * steps / dt
+
+
+def _bench_blocked(d: int, b: int, fields: int, r: int, steps: int,
+                   lr: float) -> float:
+    """Row-blocked CTR step: ceil(F/R) row gathers of R lanes/sample —
+    the path whose R=32 sweep cleared the per-chip north-star rate
+    (benchmarks/ROOFLINE.md block-size frontier)."""
+    import functools
+
+    from distlr_tpu.config import Config
+    from distlr_tpu.data.hashing import make_uniform_blocked_batch
+    from distlr_tpu.models import BlockedSparseLR
+
+    nb = d // r
+    cfg = Config(num_feature_dim=d, model="blocked_lr", block_size=r, l2_c=0.0)
+    model = BlockedSparseLR(nb, r)
+    rng = np.random.default_rng(0)
+    blocks_np, lane_vals_np = make_uniform_blocked_batch(rng, b, fields, nb, r)
+    y = jnp.asarray(rng.integers(0, 2, b), jnp.int32)
+    mask = jnp.ones(b, jnp.float32)
+    batch = (jnp.asarray(blocks_np), jnp.asarray(lane_vals_np), y, mask)
+
+    @functools.partial(jax.jit, donate_argnums=0)
+    def step(t, batch):
+        return t - lr * model.grad(t, batch, cfg)
+
+    t = step(jnp.zeros((nb, r), jnp.float32), batch)
+    assert np.isfinite(float(jnp.sum(t)))
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        t = step(t, batch)
+    checksum = float(jnp.sum(t))
+    dt = time.perf_counter() - t0
+    assert np.isfinite(checksum)
+    return b * steps / dt
+
+
 def _bench_cpu_baseline(d: int, b: int, steps: int, lr: float, l2: float) -> float:
     """Same math, vectorized numpy on host CPU (O(B*D), BLAS-parallel)."""
     rng = np.random.default_rng(0)
@@ -194,6 +302,33 @@ def main():
     value = _bench_tpu(d, b, steps, lr, l2)
     baseline = _bench_cpu_baseline(d, min(b, 256), 2, lr, l2)
 
+    # Sparse + blocked sub-rows at config-4 shape (D=1M, 21 CTR fields).
+    # These are where the north-star-class rates live (the dense D=1M step
+    # is platform-capped far below them — benchmarks/ROOFLINE.md); the
+    # driver artifact must carry them, not just the dense headline.
+    fields = 21
+    sub_b = 4096 if on_cpu else 65536
+    sub_steps = 3 if on_cpu else 20
+    subs: dict[str, float | None] = {}
+    for name, fn in [
+        ("dense_int8dot_samples_per_sec",
+         lambda: _bench_dense_int8dot(d, b, steps, lr)),
+        ("sparse_samples_per_sec",
+         lambda: _bench_sparse(d, sub_b, fields, sub_steps, lr)),
+        ("blocked_r8_samples_per_sec",
+         lambda: _bench_blocked(d, sub_b, fields, 8, sub_steps, lr)),
+        ("blocked_r32_samples_per_sec",
+         lambda: _bench_blocked(d, sub_b, fields, 32, sub_steps, lr)),
+    ]:
+        try:
+            subs[name] = round(fn(), 1)
+        except Exception as e:  # a sub-bench must never cost the headline
+            print(f"[bench] {name} failed: {e!r}", file=sys.stderr)
+            subs[name] = None
+
+    best = max(
+        [value] + [v for v in subs.values() if v is not None]
+    )
     row = {
         "metric": f"samples/sec, dense binary LR, D={d}, sync step, 1 chip",
         "value": round(value, 1),
@@ -203,6 +338,13 @@ def main():
         "D": d,
         "B": b,
         "steps": steps,
+        # best rate across model families this run (blocked R=32 is the
+        # north-star-class path: >=12.5M/chip target, BASELINE.md)
+        "best_samples_per_sec": round(best, 1),
+        "north_star_per_chip": 12_500_000,
+        "sub_B": sub_b,
+        "sub_fields": fields,
+        **subs,
     }
     if not on_cpu:
         _record_last_known_good(

@@ -33,6 +33,15 @@ HERE = os.path.dirname(os.path.abspath(__file__))
 REPO = os.path.dirname(HERE)
 sys.path.insert(0, REPO)
 
+from distlr_tpu.utils.backend import force_cpu, probe_default_backend  # noqa: E402
+
+# Decide CPU-vs-accelerator without touching the backend in-process: a
+# wedged tunnel hangs any init (JAX_PLATFORMS env is ineffective here —
+# the sitecustomize pre-imports jax; see utils/backend.py).
+_probed = probe_default_backend()
+if _probed is None or _probed[0] == "cpu":
+    force_cpu()
+
 
 def _steady_state_sps(step, w, batch, steps: int, batch_samples: int) -> float:
     """samples/sec of ``w = step(w, batch)`` iterated ``steps`` times.
@@ -165,10 +174,27 @@ def bench_config_3(quick: bool) -> dict:
     step = _scan_step(model, cfg)
     w = jnp.zeros(d, jnp.float32)
     sps = _steady_state_sps(step, w, batch, steps, b)
+
+    # feature_dtype="int8_dot" variant: int8-resident X and the native
+    # int8 x int8 -> int32 MXU contraction (the shipped formulation that
+    # beat the bf16-convert wall in exp_int8_dot.py).  One-hot features
+    # quantize exactly: scale = 1/127, lanes {0, 127}.
+    import dataclasses
+
+    from distlr_tpu.models import get_model
+
+    cfg_q = Config(num_feature_dim=d, learning_rate=0.2, l2_c=0.0,
+                   feature_dtype="int8_dot")
+    model_q = dataclasses.replace(get_model(cfg_q), feature_scale=1.0 / 127.0)
+    batch_q = ((batch[0].astype(jnp.float32) * 127).astype(jnp.int8),
+               batch[1], batch[2])
+    sps_q = _steady_state_sps(_scan_step(model_q, cfg_q),
+                              jnp.zeros(d, jnp.float32), batch_q, steps, b)
     return {
         "config": 3,
         "name": f"Criteo-style hashed-to-dense CTR, D={d}, dense MXU path",
         "samples_per_sec": round(sps, 1),
+        "int8_dot_samples_per_sec": round(sps_q, 1),
     }
 
 

@@ -21,6 +21,8 @@ from __future__ import annotations
 import contextlib
 import dataclasses
 import os
+import queue
+import threading
 
 import jax
 import numpy as np
@@ -256,6 +258,57 @@ class GlobalShardedData:
         )
 
 
+def _prefetch_to_device(shard_fn, host_batches, depth: int):
+    """Double-buffered host->device streaming: yield
+    ``(host_batch, device_batch)`` pairs with up to ``depth`` batches
+    sliced + ``device_put`` ahead of the consumer, from a background
+    thread.
+
+    The reference's ``DataIter`` role streams shards to the compute each
+    epoch on the worker's own thread (``include/data_iter.h:16-35``);
+    here the host-side work (numpy slice/pad of batch k+1 + the transfer
+    dispatch) overlaps step k's device compute — H2D DMA rides its own
+    stream, so the copy itself also overlaps.  Without this, every
+    step paid the slice + dispatch latency serially
+    (SURVEY.md §7 hard part (d); VERDICT r3 item 3).
+
+    Safe because :meth:`GlobalShardedData.batches` yields independent
+    arrays (fancy-indexed / reshaped slices, never a reused buffer).
+    """
+    q: queue.Queue = queue.Queue(maxsize=depth)
+    stop = threading.Event()
+    end = object()
+    errs: list[BaseException] = []
+
+    def produce():
+        try:
+            for hb in host_batches:
+                if stop.is_set():
+                    return
+                q.put((hb, shard_fn(hb)))
+        except BaseException as e:  # propagate to the consumer
+            errs.append(e)
+        q.put(end)
+
+    t = threading.Thread(target=produce, daemon=True,
+                         name="distlr-prefetch")
+    t.start()
+    try:
+        while True:
+            item = q.get()
+            if item is end:
+                if errs:
+                    raise errs[0]
+                return
+            yield item
+    finally:
+        # Consumer may exit early (exception mid-epoch): unblock a
+        # producer stuck in q.put so the thread can observe `stop`.
+        stop.set()
+        with contextlib.suppress(queue.Empty):
+            q.get_nowait()
+
+
 class Trainer:
     """End-to-end sync training: data -> mesh -> SPMD steps -> eval -> export."""
 
@@ -447,14 +500,27 @@ class Trainer:
                 stack.callback(ckpt.close)
 
             for epoch in range(start_epoch, epochs):
-                for host_batch in self._train_data.batches(
+                host_iter = self._train_data.batches(
                     cfg.batch_size, wrap=bool(cfg.wrap_final_batch)
-                ):
-                    batch = self._shard_batch(host_batch)
-                    self.timer.start()
-                    self.weights, step_metrics = self.train_step(self.weights, batch)
-                    jax.block_until_ready(self.weights)
-                    self.timer.stop(int(host_batch[-1].sum()))
+                )
+                if cfg.prefetch > 1:
+                    pairs = _prefetch_to_device(
+                        self._shard_batch, host_iter, cfg.prefetch - 1
+                    )
+                else:  # prefetch=1: the strictly-serial reference shape
+                    pairs = ((hb, self._shard_batch(hb)) for hb in host_iter)
+                # closing() runs the generator's finally DETERMINISTICALLY
+                # when a step raises — relying on GC leaves the producer
+                # thread blocked on the queue for as long as the caller
+                # retains the exception traceback (which run_ps_workers
+                # does), and a retried fit() would stack a second
+                # producer on top.
+                with contextlib.closing(pairs):
+                    for host_batch, batch in pairs:
+                        self.timer.start()
+                        self.weights, step_metrics = self.train_step(self.weights, batch)
+                        jax.block_until_ready(self.weights)
+                        self.timer.stop(int(host_batch[-1].sum()))
                 if test_batch is not None and cfg.test_interval > 0 and (epoch + 1) % cfg.test_interval == 0:
                     em = self.eval_step(self.weights, test_batch)
                     acc = float(em["accuracy"])
