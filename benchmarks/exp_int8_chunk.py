@@ -1,0 +1,189 @@
+"""Roofline experiment 5: why did the SHIPPED int8_dot path (60k
+samples/s in LAST_TPU.json) lose 3x against exp_int8_dot.py's 170k?
+
+exp_int8_dot.py's winning variant used a SINGLE int8 x int8 -> int32
+dot over the full D=1M contraction — which can wrap int32 in the worst
+case (133k-product bound), so models/linear.py as of round 3 shipped a
+chunked formulation instead: reshape X (B, D) -> (B, c, n) and batch
+the dot over c (variant 3 here).  This experiment isolated where that
+form loses the time; its outcome is that models/linear.py NOW ships
+variant 6 (unrolled column-slice dots, at parity with the unsafe
+single dot).  Variants measured:
+
+  1. convert path (int8 -> bf16 matmul)        — the 151-165k wall
+  2. UNSAFE single int8 dot (exp_int8_dot #3)  — the 170k target
+  3. shipped chunked: X (B, c, n) per-step reshape, batch dim middle
+  4. forward-only chunked, backward unchunked  (isolates fwd vs bwd)
+  5. X pre-stored (c, B, n) batch-major: one layout choice at batch
+     build time, zero per-step reshapes; backward contracts over B
+     giving (c, n) = g reshaped
+  6. like 5 but forward via int32 accumulation of c partial dots
+     (loop-free einsum formulation)
+
+All variants share the dynamic per-step w/r quantization of the
+shipped path, so any delta is the contraction formulation alone.
+
+Run on the real chip: python benchmarks/exp_int8_chunk.py
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.dirname(HERE))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+B, D, STEPS = 2048, 1_000_000, 10
+LR = 0.2
+N_C = 125_000          # largest divisor of D under the int32-safety bound
+C = D // N_C
+
+
+def _time_steps(run, w, *args):
+    w2 = run(w, *args)
+    assert np.isfinite(float(jnp.sum(w2)))
+    t0 = time.perf_counter()
+    w2 = run(w, *args)
+    float(jnp.sum(w2))
+    return time.perf_counter() - t0
+
+
+def _report(name, dt):
+    print(f"{name}: {B*STEPS/dt:12,.0f} samples/s")
+
+
+def scan_steps(step):
+    @jax.jit
+    def run(w, *args):
+        def body(w, _):
+            return step(w, *args), None
+        w, _ = jax.lax.scan(body, w, None, length=STEPS)
+        return w
+    return run
+
+
+def quantize(x):
+    s = jnp.maximum(jnp.max(jnp.abs(x)), 1e-8) / 127
+    q = jnp.clip(jnp.round(x / s), -127, 127).astype(jnp.int8)
+    return q, s
+
+
+def main():
+    print(f"backend={jax.default_backend()} B={B} D={D} steps={STEPS} "
+          f"chunks={C}x{N_C}")
+    k = jax.random.PRNGKey(0)
+    kx, ky = jax.random.split(k)
+    Xi = jax.block_until_ready(
+        jax.random.randint(kx, (B, D), -127, 128, dtype=jnp.int8))
+    y = jax.block_until_ready(
+        jax.random.bernoulli(ky, 0.5, (B,)).astype(jnp.float32))
+    w0 = jnp.zeros(D, jnp.float32)
+
+    # 1. convert path calibration
+    def step1(w, X, y):
+        Xf = X.astype(jnp.bfloat16)
+        z = (Xf @ w.astype(jnp.bfloat16)).astype(jnp.float32) / 127
+        r = jax.nn.sigmoid(z) - y
+        g = (r.astype(jnp.bfloat16) @ Xf).astype(jnp.float32) / (127 * B)
+        return w - LR * g
+    _report("1 convert (bf16) calibration", _time_steps(scan_steps(step1), w0, Xi, y))
+
+    # 2. UNSAFE single int8 dot (the 170k target)
+    def step2(w, X, y):
+        wq, s_w = quantize(w)
+        z = jax.lax.dot_general(
+            X, wq, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.int32).astype(jnp.float32) * (s_w / 127)
+        r = jax.nn.sigmoid(z) - y
+        rq, s_r = quantize(r)
+        g = jax.lax.dot_general(
+            rq, X, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.int32).astype(jnp.float32) * (s_r / (127 * B))
+        return w - LR * g
+    _report("2 UNSAFE single int8 dot    ", _time_steps(scan_steps(step2), w0, Xi, y))
+
+    # 3. shipped chunked form (per-step reshape, batch dim middle)
+    def step3(w, X, y):
+        wq, s_w = quantize(w)
+        Xr = X.reshape(B, C, N_C)
+        wr = wq.reshape(C, N_C)
+        zp = jax.lax.dot_general(
+            Xr, wr, (((2,), (1,)), ((1,), (0,))),
+            preferred_element_type=jnp.int32)          # (C, B)
+        z = jnp.sum(zp.astype(jnp.float32), axis=0) * (s_w / 127)
+        r = jax.nn.sigmoid(z) - y
+        rq, s_r = quantize(r)
+        g = jax.lax.dot_general(
+            rq, X, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.int32).astype(jnp.float32) * (s_r / (127 * B))
+        return w - LR * g
+    _report("3 shipped chunked fwd       ", _time_steps(scan_steps(step3), w0, Xi, y))
+
+    # 5. batch-major pre-stored layout (c, B, n): zero per-step reshapes
+    Xc = jax.block_until_ready(
+        jnp.transpose(Xi.reshape(B, C, N_C), (1, 0, 2)).copy())  # (C, B, N_C)
+
+    def step5(w, Xc, y):
+        wq, s_w = quantize(w)
+        wr = wq.reshape(C, N_C)
+        zp = jax.lax.dot_general(
+            Xc, wr, (((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.int32)          # (C, B)
+        z = jnp.sum(zp.astype(jnp.float32), axis=0) * (s_w / 127)
+        r = jax.nn.sigmoid(z) - y
+        rq, s_r = quantize(r)
+        gp = jax.lax.dot_general(
+            rq, Xc, (((0,), (1,)), ((), ())),
+            preferred_element_type=jnp.int32)          # (C, N_C)
+        g = gp.reshape(D).astype(jnp.float32) * (s_r / (127 * B))
+        return w - LR * g
+    _report("5 batch-major (c,B,n) layout", _time_steps(scan_steps(step5), w0, Xc, y))
+
+    # 4. chunked forward only, UNSAFE backward (isolate which dot pays)
+    def step4(w, X, y):
+        wq, s_w = quantize(w)
+        Xr = X.reshape(B, C, N_C)
+        wr = wq.reshape(C, N_C)
+        zp = jax.lax.dot_general(
+            Xr, wr, (((2,), (1,)), ((1,), (0,))),
+            preferred_element_type=jnp.int32)
+        z = jnp.sum(zp.astype(jnp.float32), axis=0) * (s_w / 127)
+        r = jax.nn.sigmoid(z) - y
+        rq, s_r = quantize(r)
+        g = jax.lax.dot_general(
+            rq, X, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.int32).astype(jnp.float32) * (s_r / (127 * B))
+        return w - LR * g
+    # NOTE: step3 and step4 are the same program today (backward is
+    # already unchunked at B=2048); kept separate in case B grows.
+
+    # 6. unrolled per-chunk dots on the flat (B, D) layout: column
+    # slices, no batch dimension in any dot
+    def step6(w, X, y):
+        wq, s_w = quantize(w)
+        z32 = jnp.zeros(B, jnp.float32)
+        for i in range(C):
+            sl = X[:, i * N_C:(i + 1) * N_C]
+            wi = jax.lax.dynamic_slice_in_dim(wq, i * N_C, N_C)
+            zp = jax.lax.dot_general(
+                sl, wi, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.int32)
+            z32 = z32 + zp.astype(jnp.float32)
+        z = z32 * (s_w / 127)
+        r = jax.nn.sigmoid(z) - y
+        rq, s_r = quantize(r)
+        g = jax.lax.dot_general(
+            rq, X, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.int32).astype(jnp.float32) * (s_r / (127 * B))
+        return w - LR * g
+    _report("6 unrolled column-slice dots", _time_steps(scan_steps(step6), w0, Xi, y))
+
+
+if __name__ == "__main__":
+    main()

@@ -44,12 +44,19 @@ _INT8_ACC_MAX = (2**31 - 1) // (127 * 127)
 # floor is what actually forces awkward lengths onto the convert path).
 _INT8_MIN_CHUNK = 1024
 
+# Each chunk is an unrolled dot_general in the traced step; divisor-poor
+# dims (e.g. k = 1024 * 131^2 -> best divisor 4*131^2, 256 chunks) would
+# blow up HLO size and compile time, so past this many chunks the
+# convert path wins.
+_INT8_MAX_CHUNKS = 32
+
 
 def _int8_chunk_len(k: int) -> int | None:
     """Largest divisor of ``k`` that keeps a worst-case int8 x int8
-    contraction inside int32 (``None``: no divisor of useful size — the
-    caller must take the convert path).  Trace-time only (static
-    shapes)."""
+    contraction inside int32.  ``None`` — caller must take the convert
+    path — when no divisor of useful size exists OR the resulting chunk
+    count would exceed ``_INT8_MAX_CHUNKS`` unrolled dots.  Trace-time
+    only (static shapes)."""
     if k <= _INT8_ACC_MAX:
         return k
     best = None
@@ -59,7 +66,9 @@ def _int8_chunk_len(k: int) -> int | None:
         for c in (d, k // d):
             if c <= _INT8_ACC_MAX and (best is None or c > best):
                 best = c
-    return best if best is not None and best >= _INT8_MIN_CHUNK else None
+    if best is None or best < _INT8_MIN_CHUNK or k // best > _INT8_MAX_CHUNKS:
+        return None
+    return best
 
 
 def _int8_contract(a, b, a_axis: int) -> jnp.ndarray:
@@ -70,37 +79,46 @@ def _int8_contract(a, b, a_axis: int) -> jnp.ndarray:
     exceeds ``_INT8_ACC_MAX`` (~133k) in the worst case — reachable for
     the backward at ``batch_size=-1`` on a big shard, and for the
     forward at north-star D.  The contraction is therefore split into
-    the largest dividing chunks that cannot wrap, with the cross-chunk
-    reduction in float32 (chunk partials are < 2^31, so the f32
-    rounding there is ~1e-9 relative — far below the int8 quantization
-    noise).  When the length is awkward (no divisor <= the bound) the
+    the largest dividing chunks that cannot wrap: one plain dot_general
+    per chunk over a contraction-axis slice, accumulated in float32
+    (chunk partials are < 2^31, so the f32 rounding there is ~1e-9
+    relative — far below the int8 quantization noise).  The unrolled
+    slice-per-chunk form matters: expressing the same split as a single
+    reshape + c-batched dot_general measured 55k samples/s on the
+    D=1M step vs ~165k for both the unrolled form and the (unsafe)
+    unchunked dot — the batched dot forces a bad layout on the (B, D)
+    operand, while column slices keep each chunk a plain MXU matmul
+    (benchmarks/exp_int8_chunk.py, on-chip).  When the length is
+    awkward — no divisor <= the bound, or only divisors small enough
+    that the unroll would exceed ``_INT8_MAX_CHUNKS`` dots — the
     bfloat16-convert formulation is used instead: slower, never wrong.
     """
     k = a.shape[a_axis]
+    a_axis = a_axis % a.ndim
     n_c = _int8_chunk_len(k)
     if n_c == k:
         out = jax.lax.dot_general(
-            a, b, (((a_axis % a.ndim,), (0,)), ((), ())),
+            a, b, (((a_axis,), (0,)), ((), ())),
             preferred_element_type=jnp.int32,
         )
         return out.astype(jnp.float32)
     if n_c is None:  # no safe chunking: correct-but-slower convert path
         out = jax.lax.dot_general(
             a.astype(jnp.bfloat16), b.astype(jnp.bfloat16),
-            (((a_axis % a.ndim,), (0,)), ((), ())),
+            (((a_axis,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
         return out
-    c = k // n_c
-    # split the contraction axis into (c, n_c) and batch over c
-    a_axis = a_axis % a.ndim
-    ar = a.reshape(a.shape[:a_axis] + (c, n_c) + a.shape[a_axis + 1:])
-    br = b.reshape((c, n_c) + b.shape[1:])
-    partial = jax.lax.dot_general(
-        ar, br, (((a_axis + 1,), (1,)), ((a_axis,), (0,))),
-        preferred_element_type=jnp.int32,
-    )  # (c, *rest)
-    return jnp.sum(partial.astype(jnp.float32), axis=0)
+    acc = None
+    for i in range(k // n_c):
+        a_i = jax.lax.slice_in_dim(a, i * n_c, (i + 1) * n_c, axis=a_axis)
+        b_i = jax.lax.slice_in_dim(b, i * n_c, (i + 1) * n_c, axis=0)
+        p = jax.lax.dot_general(
+            a_i, b_i, (((a_axis,), (0,)), ((), ())),
+            preferred_element_type=jnp.int32,
+        ).astype(jnp.float32)
+        acc = p if acc is None else acc + p
+    return acc
 
 
 def _masked_mean(values, mask):

