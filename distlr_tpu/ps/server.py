@@ -229,8 +229,19 @@ class ServerSupervisor:
         self._snapshot_interval = snapshot_interval
         self._max_respawns = max_respawns
         self._timeout_ms = timeout_ms
+        # Keyed rolling snapshot: one full-dim buffer, but captured and
+        # tracked PER KEY RANGE (valid flag, last-seen push counter,
+        # capture time per rank).  A range whose server-side
+        # total_pushes counter hasn't moved since its last capture is
+        # skipped — no pull, no bytes — so snapshot cost scales with
+        # write traffic, not key-space size (a full-vector pull per
+        # interval is 4 MB at D=1M but quadratically painful at the
+        # key-space sizes keyed PS exists for).
         self._snapshot: np.ndarray | None = None
         self._snapshot_at = 0.0
+        self._snap_valid = [False] * group.num_servers
+        self._snap_pushes = [-1] * group.num_servers
+        self._snap_at = [0.0] * group.num_servers
         self._respawns = [0] * group.num_servers
         self._needs_reseed: set[int] = set()
         self._stop = threading.Event()
@@ -260,40 +271,65 @@ class ServerSupervisor:
         self.stop()
 
     # -- internals --------------------------------------------------------
-    def _probe(self):
+    def _probe_rank(self, rank: int):
         from distlr_tpu.ps.client import KVWorker  # noqa: PLC0415  (cycle)
 
-        # A fresh connection per use: the supervisor's ops must not share
-        # a stream with anything, and a server death poisons open
-        # streams — reconnect-per-cycle makes every cycle independent.
-        return KVWorker(self._group.hosts, self._group.dim, client_id=0xFFFE,
+        # A fresh SINGLE-RANK connection per use: the supervisor's ops
+        # must not share a stream with anything, a server death poisons
+        # open streams, and — critically — per-rank connections keep
+        # every rank's snapshot/reseed independent.  A group-wide
+        # connection would make one dead rank fail the whole cycle and
+        # silently freeze the HEALTHY ranks' slices, unbounding the
+        # advertised snapshot_interval loss guarantee.  The server
+        # stores its range rebased to local keys, so a 1-host client of
+        # dim (hi-lo) addresses exactly that slice.
+        lo, hi = self._group.key_range(rank)
+        host = f"127.0.0.1:{self._group.ports[rank]}"
+        return KVWorker(host, hi - lo, client_id=0xFFFE,
                         timeout_ms=self._timeout_ms, sync_group=False)
 
     def _try_snapshot(self) -> None:
-        try:
-            with self._probe() as kv:
-                # An UNINITIALIZED server serves zeros from HandlePull; a
-                # snapshot taken before the workers' init push would then
-                # become "authoritative" and a crash within
-                # snapshot_interval would re-seed zeros over real
-                # (possibly checkpoint-restored) weights.  Gate on every
-                # rank's kStats initialized flag.
-                if not all(
-                    kv.stats(r)["initialized"]
-                    for r in range(self._group.num_servers)
-                ):
-                    return
-                snap = kv.pull()
-        except Exception:
-            # some rank is down or wedged; the respawn pass handles it —
-            # the previous snapshot stays authoritative
-            return
-        self._snapshot = snap
+        if self._snapshot is None:
+            self._snapshot = np.zeros(self._group.dim, np.float32)
+        for r in range(self._group.num_servers):
+            try:
+                with self._probe_rank(r) as kv:
+                    # An UNINITIALIZED server serves zeros from
+                    # HandlePull; a snapshot taken before this rank's
+                    # init (worker push or supervisor re-seed) would
+                    # become "authoritative" and a crash within
+                    # snapshot_interval would re-seed zeros over real
+                    # (possibly checkpoint-restored) weights.
+                    s = kv.stats(0)
+                    if not s["initialized"]:
+                        continue
+                    if (self._snap_valid[r]
+                            and s["total_pushes"] == self._snap_pushes[r]):
+                        # untouched since its last capture: the stored
+                        # slice is still the live state — refresh its
+                        # timestamp without moving any bytes
+                        self._snap_at[r] = time.monotonic()
+                        continue
+                    vals = kv.pull()
+                    lo, hi = self._group.key_range(r)
+                    self._snapshot[lo:hi] = vals
+                    # The counter was read BEFORE the pull, so it may
+                    # undercount what the pull captured — the safe
+                    # direction (worst case: one redundant re-pull next
+                    # cycle, never a stale slice treated as current).
+                    self._snap_pushes[r] = s["total_pushes"]
+                    self._snap_valid[r] = True
+                    self._snap_at[r] = time.monotonic()
+            except Exception:
+                # this rank is down or wedged; the respawn pass handles
+                # it — its previously captured slice stays authoritative,
+                # and OTHER ranks' captures proceed regardless
+                continue
         self._snapshot_at = time.monotonic()
 
     def _reseed(self, rank: int) -> bool:
         lo, hi = self._group.key_range(rank)
-        if self._snapshot is not None:
+        if self._snapshot is not None and self._snap_valid[rank]:
             vals, event = self._snapshot[lo:hi], "reseeded"
         else:
             # died before the first snapshot: zeros keep the server
@@ -301,9 +337,8 @@ class ServerSupervisor:
             # the slice's training progress is lost
             vals, event = np.zeros(hi - lo, np.float32), "seeded-zeros"
         try:
-            with self._probe() as kv:
-                kv.push_init(vals, keys=np.arange(lo, hi, dtype=np.uint64),
-                             force=True)
+            with self._probe_rank(rank) as kv:
+                kv.push_init(vals, force=True)
         except Exception as e:
             # retried next poll (_needs_reseed): an unseeded-but-alive
             # server would otherwise install the first gradient push AS
@@ -311,6 +346,10 @@ class ServerSupervisor:
             log.warning("supervisor: re-seed of server %d failed: %s", rank, e)
             return False
         self.events.append((time.monotonic(), rank, event))
+        # The respawned process restarted its push counter; forget the
+        # old count so the next snapshot cycle always re-pulls this range
+        # (a coincidental count match must not skip it).
+        self._snap_pushes[rank] = -1
         return True
 
     def _run(self) -> None:
@@ -365,7 +404,11 @@ class ServerSupervisor:
                 self.events.append((now, rank, "respawned"))
                 if not self._reseed(rank):
                     self._needs_reseed.add(rank)
-            if not dead and not self._needs_reseed and (
-                now - self._snapshot_at >= self._snapshot_interval
-            ):
+            if now - self._snapshot_at >= self._snapshot_interval:
+                # Runs even while some rank is dead or awaiting re-seed:
+                # captures are per-rank (dead -> connect fails, skipped;
+                # respawned-but-unseeded -> uninitialized, skipped), so a
+                # crashed or given-up rank must not freeze the healthy
+                # ranks' slices — that would quietly unbound the
+                # snapshot_interval loss guarantee.
                 self._try_snapshot()
