@@ -225,7 +225,7 @@ def bench_config_4(quick: bool) -> dict:
 
     blocked_sps = {}
     rng_b = np.random.default_rng(1)
-    for r in (8, 32):
+    for r in (8, 16, 32):
         nb = d // r
         cfg_b = Config(num_feature_dim=d, model="blocked_lr", block_size=r,
                        learning_rate=0.5, l2_c=0.0)
@@ -238,7 +238,17 @@ def bench_config_4(quick: bool) -> dict:
             bstep, jnp.zeros((nb, r), jnp.float32), bbatch, steps, b), 1)
 
     # convergence (small): recover hashed signal to near-oracle accuracy;
-    # metrics are HELD-OUT (first n_te rows never trained on)
+    # metrics are HELD-OUT (first n_te rows never trained on).
+    #
+    # Oracle-gap accounting (measured r4, on-chip probe): at the round-3
+    # protocol (120 steps) test acc was 0.7967 vs oracle 0.8427 — 1.7pt
+    # of that was under-convergence (1000 steps reaches 0.8133, train
+    # acc 0.859) and the rest is finite-sample estimation error (512
+    # params fit on 6000 Bernoulli rows): the same model on 4x the
+    # train rows reaches 0.8507, ABOVE the oracle draw.  Collisions
+    # cost nothing here by construction — the ground truth lives in
+    # bucket space, so the learner sees the exact feature map the
+    # labels were generated from.
     dc, nc, n_te = 512, 6000, 1500
     _, ccols, cvals, cy, w_true = make_ctr_dataset(nc + n_te, 8, 5000, dc, seed=1)
     oracle = float(((np.sum(w_true[ccols[:n_te]] * cvals[:n_te], -1) > 0
@@ -251,7 +261,7 @@ def bench_config_4(quick: bool) -> dict:
     tbatch = (jnp.asarray(ccols[:n_te]), jnp.asarray(cvals[:n_te]),
               jnp.asarray(cy[:n_te]), jnp.ones(n_te, jnp.float32))
     w = jnp.zeros(dc, jnp.float32)
-    for _ in range(120):
+    for _ in range(1000):
         w = cstep(w, cbatch)
     acc = float(cmodel.accuracy(w, tbatch))
     test_ll = float(cmodel.logloss(w, tbatch))
@@ -263,7 +273,109 @@ def bench_config_4(quick: bool) -> dict:
         "accuracy": round(acc, 4),
         "test_logloss": round(test_ll, 5),
         "oracle_accuracy": round(oracle, 4),
+        "oracle_gap_note": "remaining gap is finite-sample estimation "
+                           "error (512 params / 6000 train rows; 4x rows "
+                           "reaches 0.851, above the oracle draw) — see "
+                           "the measured decomposition in bench_config_4",
+        "blocked_frontier": _blocked_frontier(quick, blocked_sps, sps),
     }
+
+
+def _blocked_frontier(quick: bool, blocked_sps: dict, scalar_sps: float) -> dict:
+    """Rate-vs-quality frontier for the row-blocked hashing path.
+
+    The R=32 blocked rate (~15M samples/s on-chip) is only a real
+    training-throughput claim if a model at that R still LEARNS — at
+    R=32 all 21 fields form one conjunction group, so rows are trained
+    per exact value tuple and the scheme degrades to tuple memorization
+    when tuples don't recur (benchmarks/ROOFLINE.md).  This sweeps
+    R in {8, 16, 32} against scalar hashing on three data regimes at
+    EQUAL parameter count (blocked table nb = D/R rows of R lanes):
+
+      high_card_iid      vocab 10M, fields i.i.d. — tuples never recur
+      low_card_iid       vocab 2, fields i.i.d. — R=8 group tuples
+                         (2^8 = 256) recur ~190x at full scale; R=16
+                         (65k) and R=32 (2^21) essentially do not
+      correlated_tuples  512 distinct field tuples (one latent factor,
+                         e.g. device model, fixes all fields) — every
+                         group tuple recurs ~96x at any R
+
+    Labels are mean-centered (``center_logits``) so the class marginal
+    stays near 0.5 — at low vocab an uncentered logistic model hands
+    every predictor a ~90% majority-class accuracy and the comparison
+    measures nothing.
+
+    Each regime row reports held-out accuracy/logloss per R, the scalar
+    baseline, and ``largest_r_within_1pt`` — the biggest R whose
+    accuracy is within 1pt of scalar (None if none is), i.e. the R at
+    which the measured blocked rate is claimable for that regime.
+    """
+    import jax.numpy as jnp
+
+    from distlr_tpu import Config
+    from distlr_tpu.data.hashing import encode_blocked, make_ctr_dataset
+    from distlr_tpu.models import BlockedSparseLR, SparseBinaryLR
+
+    fields = 21
+    dc, n_tr, n_te, steps_cv = ((1024, 4000, 1000, 120) if quick
+                                else (16384, 49152, 8192, 250))
+    lr = 1.0
+    r_values = (8, 16, 32)
+    regimes = {
+        "high_card_iid": dict(vocab_size=10_000_000),
+        "low_card_iid": dict(vocab_size=2),
+        "correlated_tuples": dict(vocab_size=50, num_distinct_tuples=512),
+    }
+    out = {}
+    for name, kw in regimes.items():
+        raw, cols, vals, y, _w = make_ctr_dataset(
+            n_tr + n_te, fields, num_buckets=dc, seed=7,
+            center_logits=True, **kw)
+        # scalar baseline (SparseBinaryLR over dc buckets)
+        cfg_s = Config(num_feature_dim=dc, learning_rate=lr, l2_c=0.0,
+                       model="sparse_lr")
+        smodel = SparseBinaryLR(dc)
+        sstep = _scan_step(smodel, cfg_s)
+        tr_b = (jnp.asarray(cols[n_te:]), jnp.asarray(vals[n_te:]),
+                jnp.asarray(y[n_te:]), jnp.ones(n_tr, jnp.float32))
+        te_b = (jnp.asarray(cols[:n_te]), jnp.asarray(vals[:n_te]),
+                jnp.asarray(y[:n_te]), jnp.ones(n_te, jnp.float32))
+        w = jnp.zeros(dc, jnp.float32)
+        for _ in range(steps_cv):
+            w = sstep(w, tr_b)
+        acc_s = float(smodel.accuracy(w, te_b))
+        row = {
+            "scalar": {"accuracy": round(acc_s, 4),
+                       "test_logloss": round(float(smodel.logloss(w, te_b)), 5),
+                       "samples_per_sec": round(scalar_sps, 1)},
+        }
+        largest_ok = None
+        for r in r_values:
+            nb = dc // r
+            blocks, lane_vals = encode_blocked(raw, nb, r, seed=7)
+            cfg_b = Config(num_feature_dim=dc, model="blocked_lr",
+                           block_size=r, learning_rate=lr, l2_c=0.0)
+            bmodel = BlockedSparseLR(nb, r)
+            bstep = _scan_step(bmodel, cfg_b)
+            btr = (jnp.asarray(blocks[n_te:]), jnp.asarray(lane_vals[n_te:]),
+                   jnp.asarray(y[n_te:]), jnp.ones(n_tr, jnp.float32))
+            bte = (jnp.asarray(blocks[:n_te]), jnp.asarray(lane_vals[:n_te]),
+                   jnp.asarray(y[:n_te]), jnp.ones(n_te, jnp.float32))
+            t = jnp.zeros((nb, r), jnp.float32)
+            for _ in range(steps_cv):
+                t = bstep(t, btr)
+            acc_r = float(bmodel.accuracy(t, bte))
+            row[f"r{r}"] = {
+                "accuracy": round(acc_r, 4),
+                "test_logloss": round(float(bmodel.logloss(t, bte)), 5),
+                "delta_vs_scalar_pts": round((acc_r - acc_s) * 100, 2),
+                "samples_per_sec": blocked_sps.get(r),
+            }
+            if acc_r >= acc_s - 0.01:
+                largest_ok = r
+        row["largest_r_within_1pt"] = largest_ok
+        out[name] = row
+    return out
 
 
 def bench_config_5(quick: bool) -> dict:
@@ -279,7 +391,13 @@ def bench_config_5(quick: bool) -> dict:
     d, k, n = 784, 10, (4096 if quick else 60_000)
     n_te = max(n // 5, 512)
     steps = 10 if quick else 30
-    X, y, _ = make_synthetic_dataset(n + n_te, d, seed=0, num_classes=k)
+    X, y, w_true = make_synthetic_dataset(n + n_te, d, seed=0, num_classes=k)
+    # Quality ceilings for this workload: the generator's own weights
+    # (Bayes-style oracle — labels carry Gumbel noise, so < 1.0), and a
+    # train-to-convergence run of the same model (the reachable ceiling).
+    # (argmax is scale-invariant, so the generator's 3.0 logit
+    # temperature doesn't enter the oracle prediction)
+    oracle = float((np.argmax(X[:n_te] @ w_true, axis=1) == y[:n_te]).mean())
     cfg = Config(num_feature_dim=d, num_classes=k, model="softmax",
                  learning_rate=0.3, l2_c=0.0)
     model = SoftmaxRegression(d, k)
@@ -292,12 +410,21 @@ def bench_config_5(quick: bool) -> dict:
         W = step(W, batch)
     acc = float(model.accuracy(W, tbatch))
     test_ll = float(model.logloss(W, tbatch))
+    conv_steps = 100 if quick else 1500
+    for _ in range(conv_steps - 60):
+        W = step(W, batch)
+    conv_acc = float(model.accuracy(W, tbatch))
+    conv_ll = float(model.logloss(W, tbatch))
     return {
         "config": 5,
         "name": "multinomial softmax regression, D=784 K=10 (MNIST-shaped)",
         "samples_per_sec": round(sps, 1),
         "accuracy": round(acc, 4),
         "test_logloss": round(test_ll, 5),
+        "converged_accuracy": round(conv_acc, 4),
+        "converged_test_logloss": round(conv_ll, 5),
+        "converged_steps": conv_steps,
+        "oracle_accuracy": round(oracle, 4),
     }
 
 
@@ -311,15 +438,45 @@ def main(argv=None) -> int:
     ap.add_argument("--configs", default="1,2,3,4,5",
                     help="comma-separated subset, e.g. 1,3,5")
     ap.add_argument("--out", default=os.path.join(REPO, "BENCH_CONFIGS.json"))
+    ap.add_argument("--isolate", action="store_true",
+                    help="run each config in its own subprocess so device "
+                         "memory is fully released between configs (the "
+                         "full-size suite can otherwise accumulate HBM "
+                         "across configs and die RESOURCE_EXHAUSTED)")
     args = ap.parse_args(argv)
+    default_out = os.path.join(REPO, "BENCH_CONFIGS.json")
+    if args.quick and os.path.abspath(args.out) == default_out:
+        # A quick probe must never clobber the canonical full-size
+        # artifact (it did once — r4 review finding); quick results
+        # always go to a sibling scratch file.
+        args.out = os.path.join(REPO, "BENCH_CONFIGS_quick.json")
+        print(f"[bench_configs] --quick: writing to {args.out}",
+              file=sys.stderr)
 
     import jax
 
     rows = []
-    for i in (int(s) for s in args.configs.split(",")):
-        row = BENCHES[i](args.quick)
-        rows.append(row)
-        print(json.dumps(row))
+    if args.isolate:
+        import subprocess
+        import tempfile
+        for i in (int(s) for s in args.configs.split(",")):
+            with tempfile.NamedTemporaryFile(suffix=".json") as tmp:
+                cmd = [sys.executable, os.path.abspath(__file__),
+                       "--configs", str(i), "--out", tmp.name]
+                if args.quick:
+                    cmd.append("--quick")
+                proc = subprocess.run(cmd)
+                if proc.returncode != 0:
+                    print(f"[bench_configs] config {i} failed "
+                          f"(rc={proc.returncode}); skipping", file=sys.stderr)
+                    continue
+                with open(tmp.name) as f:
+                    rows.extend(json.load(f)["rows"])
+    else:
+        for i in (int(s) for s in args.configs.split(",")):
+            row = BENCHES[i](args.quick)
+            rows.append(row)
+            print(json.dumps(row))
     payload = {
         "backend": jax.default_backend(),
         "quick": args.quick,

@@ -254,6 +254,8 @@ def make_ctr_dataset(
     seed: int = 0,
     signed: bool = False,
     noise: float = 0.0,
+    num_distinct_tuples: int | None = None,
+    center_logits: bool = False,
 ):
     """Deterministic synthetic CTR data: ``num_fields`` categorical fields,
     each drawing one value from ``vocab_size``, labels from a logistic
@@ -263,12 +265,31 @@ def make_ctr_dataset(
     ``(num_buckets,)``), so the learnable signal survives hash collisions
     by construction and convergence tests can assert recovery.
 
+    ``num_distinct_tuples`` models correlated fields (real CTR fields are
+    rarely independent — e.g. one device model fixes many of them): rows
+    are drawn uniformly from a fixed table of that many distinct (F,)
+    value tuples, so every tuple recurs ~N/T times regardless of
+    ``vocab_size``.  This is the recurrence regime the row-blocked
+    hashing path (:func:`hash_group_blocks`) needs; ``None`` keeps the
+    fields i.i.d. (tuples essentially never recur at realistic vocab).
+
+    ``center_logits`` subtracts the mean logit before sampling labels.
+    At low vocab the handful of occupied buckets gives the logit a
+    random O(1) mean offset, which can push the class marginal to 90%+
+    and let a majority-class predictor fake high accuracy; centering
+    keeps the base rate near 0.5 so accuracy comparisons measure signal.
+
     Returns ``(raw_ids, cols, vals, y, w_true)`` where ``raw_ids`` is the
     ``(N, F)`` categorical draw, ``(cols, vals)`` its ``(N, F)`` hashed
     padded-COO encoding, and ``y`` in {0,1}.
     """
     rng = np.random.default_rng(seed)
-    raw_ids = rng.integers(0, vocab_size, size=(num_samples, num_fields))
+    if num_distinct_tuples is not None:
+        table = rng.integers(
+            0, vocab_size, size=(num_distinct_tuples, num_fields))
+        raw_ids = table[rng.integers(0, num_distinct_tuples, size=num_samples)]
+    else:
+        raw_ids = rng.integers(0, vocab_size, size=(num_samples, num_fields))
     field_ids = np.broadcast_to(np.arange(num_fields), raw_ids.shape)
     enc = HashedFeatureEncoder(num_buckets, seed=seed, signed=signed)
     cols, vals = enc.encode_coo(field_ids, raw_ids)
@@ -276,6 +297,8 @@ def make_ctr_dataset(
         np.float32
     )
     logits = np.sum(w_true[cols] * vals, axis=-1)
+    if center_logits:
+        logits = logits - logits.mean()
     if noise > 0.0:
         logits += noise * rng.standard_normal(num_samples)
     p = 1.0 / (1.0 + np.exp(-logits))
