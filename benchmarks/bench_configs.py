@@ -467,9 +467,15 @@ def main(argv=None) -> int:
                     cmd.append("--quick")
                 proc = subprocess.run(cmd)
                 if proc.returncode != 0:
+                    # Abort WITHOUT writing: a partial row set silently
+                    # replacing the canonical artifact would drop whole
+                    # configs from the headline results (r4 review
+                    # finding — same protect-the-artifact rule as the
+                    # --quick divert above).
                     print(f"[bench_configs] config {i} failed "
-                          f"(rc={proc.returncode}); skipping", file=sys.stderr)
-                    continue
+                          f"(rc={proc.returncode}); aborting without "
+                          f"writing {args.out}", file=sys.stderr)
+                    return 1
                 with open(tmp.name) as f:
                     rows.extend(json.load(f)["rows"])
     else:
