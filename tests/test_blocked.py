@@ -391,3 +391,54 @@ class TestBlockedEndToEnd:
 
         rc = launch.main(["gen-data", "--data-dir", "/tmp/x", "--ctr-raw"])
         assert rc == 2
+
+
+class TestSuggestBlockSize:
+    """The data-driven advisor distilled from the measured frontier
+    (bench_configs.py blocked_frontier, on-chip): every case below is
+    one of the frontier's regimes, asserted to land where the
+    measurement said quality lands."""
+
+    def _regime(self, n, seed=7, **kw):
+        from distlr_tpu.data.hashing import make_ctr_dataset
+
+        raw, *_ = make_ctr_dataset(n, 21, num_buckets=64, seed=seed, **kw)
+        return raw
+
+    def test_high_cardinality_iid_gets_scalar(self):
+        from distlr_tpu.data.hashing import suggest_block_size
+
+        raw = self._regime(50_000, vocab_size=10_000_000)
+        assert suggest_block_size(raw, 1_000_000) == 1  # tuples never recur
+
+    def test_correlated_tuples_at_frontier_buckets_gets_16(self):
+        """The exact measured shape: 512 tuples, dc=16384 — R=32 lost
+        9pt there (single-group collisions at row load 1.0), R=16 held
+        within 0.4pt; the advisor must split them the same way."""
+        from distlr_tpu.data.hashing import suggest_block_size
+
+        raw = self._regime(49_152, vocab_size=50, num_distinct_tuples=512)
+        assert suggest_block_size(raw, 16384) == 16
+
+    def test_correlated_tuples_with_room_gets_32(self):
+        """Same recurrence but a 1M-bucket table: 512 tuples into
+        31250 rows is load ~0.016 — the single-group failure mode is
+        gone and the fastest R wins."""
+        from distlr_tpu.data.hashing import suggest_block_size
+
+        raw = self._regime(49_152, vocab_size=50, num_distinct_tuples=512)
+        assert suggest_block_size(raw, 1_000_000) == 32
+
+    def test_sparse_recurrence_rejected(self):
+        """~2 samples/tuple (the quick-mode frontier that degraded
+        everywhere): recurrence below threshold at every R."""
+        from distlr_tpu.data.hashing import suggest_block_size
+
+        raw = self._regime(1_000, vocab_size=50, num_distinct_tuples=512)
+        assert suggest_block_size(raw, 1_000_000) == 1
+
+    def test_thresholds_are_overridable(self):
+        from distlr_tpu.data.hashing import suggest_block_size
+
+        raw = self._regime(1_000, vocab_size=50, num_distinct_tuples=512)
+        assert suggest_block_size(raw, 1_000_000, min_recurrence=1.0) == 32
