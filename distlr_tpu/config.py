@@ -183,8 +183,13 @@ class Config:
             self.wrap_final_batch = ref
         if self.model not in ("binary_lr", "softmax", "sparse_lr", "blocked_lr"):
             raise ValueError(f"unknown model {self.model!r}")
-        if self.block_size <= 0:
-            raise ValueError("block_size must be positive")
+        if self.block_size < 0 or (
+            self.block_size == 0 and self.model != "blocked_lr"
+        ):
+            raise ValueError(
+                "block_size must be positive (0 = auto, blocked_lr only: "
+                "resolved from raw-CTR data by suggest_block_size)"
+            )
         if self.num_feature_dim <= 0:
             raise ValueError("num_feature_dim must be positive")
         if self.batch_size == 0 or self.batch_size < -1:

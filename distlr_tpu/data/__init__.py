@@ -10,6 +10,7 @@ from distlr_tpu.data.hashing import (  # noqa: F401
     make_ctr_dataset,
     read_ctr_meta,
     read_raw_ctr_file,
+    resolve_auto_block_size,
     suggest_block_size,
     write_ctr_shards,
     write_raw_ctr_shards,

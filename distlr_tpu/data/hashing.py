@@ -35,6 +35,7 @@ __all__ = [
     "default_field_groups",
     "encode_blocked",
     "suggest_block_size",
+    "resolve_auto_block_size",
     "HashedFeatureEncoder",
     "csr_to_padded_coo",
     "make_ctr_dataset",
@@ -197,6 +198,10 @@ def suggest_block_size(raw_ids, num_buckets: int,
     """
     raw_ids = np.asarray(raw_ids, dtype=np.int64)
     n, num_fields = raw_ids.shape
+    if n == 0:
+        raise ValueError(
+            "suggest_block_size needs a non-empty sample of raw rows"
+        )
     for r in sorted(candidates, reverse=True):
         groups = default_field_groups(num_fields, r)
         distinct = []
@@ -209,6 +214,44 @@ def suggest_block_size(raw_ids, num_buckets: int,
         if recurrence >= min_recurrence and load / len(groups) <= max_row_load:
             return r
     return 1
+
+
+def resolve_auto_block_size(data_dir: str, ctr_fields: int, num_buckets: int,
+                            *, sample_rows: int = 100_000) -> int:
+    """Resolve ``block_size=0`` ("auto") for a raw-CTR data dir: run
+    :func:`suggest_block_size` on a sample of the first train shard.
+    Requires raw shards on disk — auto cannot work on pre-encoded or
+    injected data (the raw categorical ids are gone by then)."""
+    path = os.path.join(data_dir, "train", "part-001")
+    if not os.path.exists(path):
+        raise FileNotFoundError(
+            f"block_size=0 (auto) needs raw-CTR shards to sample; no "
+            f"{path} — pass an explicit --block-size instead"
+        )
+    num_fields = resolve_ctr_fields(data_dir, ctr_fields)
+    # Representative sample: stride line reads across the WHOLE shard
+    # (row count estimated from file size) instead of taking the head —
+    # time-/user-ordered CTR logs cluster identical tuples, so a head
+    # sample over-counts recurrence and can green-light exactly the
+    # too-wide R the advisor exists to reject.  Striding parses only
+    # ~sample_rows rows regardless of shard size.
+    import itertools  # noqa: PLC0415
+
+    with open(path, "rb") as f:
+        probe = list(itertools.islice(f, 200))
+    if not probe:
+        raise ValueError(
+            f"{path} is empty; cannot sample for block_size auto"
+        )
+    avg_line = sum(len(ln) for ln in probe) / len(probe)
+    approx_rows = max(1, int(os.path.getsize(path) / avg_line))
+    stride = max(1, approx_rows // sample_rows)
+    raw_ids, _ = read_raw_ctr_file(path, num_fields,
+                                   max_rows=sample_rows, stride=stride)
+    # only Rs that divide the table (get_model requires it; 1M-style
+    # power-of-two bucket counts keep every candidate)
+    candidates = tuple(r for r in (32, 16, 8) if num_buckets % r == 0)
+    return suggest_block_size(raw_ids, num_buckets, candidates)
 
 
 def encode_blocked(raw_ids, num_blocks: int, block_size: int, *, seed: int = 0,
@@ -555,20 +598,38 @@ def write_raw_ctr_shards(
             "w_true_path": w_path, "meta": meta}
 
 
-def read_raw_ctr_file(path: str, num_fields: int):
+def read_raw_ctr_file(path: str, num_fields: int, *,
+                      max_rows: int | None = None, stride: int = 1):
     """Parse one raw-CTR shard -> ``(raw_ids (N, F) int64, y (N,) int32)``.
 
     Rides the existing libsvm parser (native fast path included): field
     numbers arrive as CSR columns, raw ids as float32 values (exact below
     2^24 by the writer's contract).  Every row must carry all F fields —
     raw-CTR is a dense-fields format, unlike one-hot libsvm.
+
+    ``max_rows``/``stride`` select a row subsample at the LINE level
+    (every ``stride``-th line, at most ``max_rows`` of them) without
+    parsing or materializing the rest of the shard — the advisor's
+    sampling path (:func:`resolve_auto_block_size`).
     """
-    from distlr_tpu.data.libsvm import parse_libsvm_file  # noqa: PLC0415
+    from distlr_tpu.data.libsvm import (  # noqa: PLC0415
+        parse_libsvm_file,
+        parse_libsvm_lines,
+    )
 
     # num_features=None: keep ALL columns, so a shard with MORE fields
     # than expected fails the checks below instead of being silently
     # truncated to a passing width by the parser's column filter.
-    (row_ptr, cols, vals), y = parse_libsvm_file(path, None, dense=False)
+    if max_rows is None and stride == 1:
+        (row_ptr, cols, vals), y = parse_libsvm_file(path, None, dense=False)
+    else:
+        import itertools  # noqa: PLC0415
+
+        with open(path) as f:  # text mode: the line parser wants str
+            lines = list(itertools.islice(f, 0, None, stride))
+        if max_rows is not None:
+            lines = lines[:max_rows]
+        (row_ptr, cols, vals), y = parse_libsvm_lines(lines, None, dense=False)
     n = len(y)
     lengths = np.diff(row_ptr)
     if n and not (lengths == num_fields).all():

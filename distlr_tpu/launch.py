@@ -44,9 +44,12 @@ def _add_config_flags(p: argparse.ArgumentParser) -> None:
     p.add_argument("--num-classes", dest="num_classes", type=int)
     p.add_argument("--nnz-max", dest="nnz_max", type=int,
                    help="sparse_lr: cap per-row nonzeros (pad width)")
-    p.add_argument("--block-size", dest="block_size", type=int,
+    p.add_argument("--block-size", dest="block_size",
+                   type=lambda s: 0 if s == "auto" else int(s),
                    help="blocked_lr: lanes per table row (table rows = "
-                   "num-feature-dim / block-size)")
+                   "num-feature-dim / block-size); 'auto' samples the "
+                   "raw shards and picks the largest statistically safe "
+                   "R (data.hashing.suggest_block_size)")
     p.add_argument("--ctr-fields", dest="ctr_fields", type=int,
                    help="blocked_lr: raw categorical fields per row "
                    "(default: read from the data dir's ctr_meta.json)")
@@ -109,6 +112,15 @@ def _config_from_args(args: argparse.Namespace) -> Config:
             mesh_shape={"data": cfg.num_workers, "model": args.feature_shards},
             feature_shards=args.feature_shards,
         )
+    if cfg.model == "blocked_lr" and cfg.block_size == 0:
+        from distlr_tpu.data.hashing import resolve_auto_block_size  # noqa: PLC0415
+
+        r = resolve_auto_block_size(cfg.data_dir, cfg.ctr_fields,
+                                    cfg.num_feature_dim)
+        log.info("block_size auto: resolved to R=%d%s", r,
+                 "" if r > 1 else " (scalar-equivalent: tuples in this "
+                 "data don't recur enough for wider rows)")
+        cfg = cfg.replace(block_size=r)
     return cfg
 
 

@@ -442,6 +442,12 @@ def get_model(cfg: Config):
     if cfg.model == "sparse_lr":
         return SparseBinaryLR(cfg.num_feature_dim)
     if cfg.model == "blocked_lr":
+        if cfg.block_size == 0:
+            raise ValueError(
+                "block_size=0 (auto) must be resolved before building a "
+                "model — see data.hashing.resolve_auto_block_size (the "
+                "launch CLI does this for --block-size auto)"
+            )
         if cfg.num_feature_dim % cfg.block_size:
             raise ValueError(
                 f"num_feature_dim ({cfg.num_feature_dim}) must be a multiple "

@@ -442,3 +442,34 @@ class TestSuggestBlockSize:
 
         raw = self._regime(1_000, vocab_size=50, num_distinct_tuples=512)
         assert suggest_block_size(raw, 1_000_000, min_recurrence=1.0) == 32
+
+    def test_block_size_auto_cli_end_to_end(self, tmp_path):
+        """--block-size auto: low-vocab raw shards (2^8 group tuples
+        recur ~78x at 20k rows) resolve to R=8 and train through the
+        normal sync path; Config forbids unresolved 0 elsewhere."""
+        import pytest
+
+        from distlr_tpu import Config, launch
+        from distlr_tpu.data.hashing import resolve_auto_block_size
+
+        d = str(tmp_path / "auto")
+        rc = launch.main([
+            "gen-data", "--data-dir", d, "--num-samples", "20000",
+            "--ctr-fields", "8", "--ctr-vocab", "2", "--ctr-raw",
+            "--num-parts", "1", "--seed", "5",
+        ])
+        assert rc == 0
+        assert resolve_auto_block_size(d, 0, 4096) == 8
+        rc = launch.main([
+            "sync", "--data-dir", d, "--model", "blocked_lr",
+            "--num-feature-dim", "4096", "--block-size", "auto",
+            "--num-iteration", "3", "--batch-size", "512",
+            "--learning-rate", "0.5", "--l2-c", "0", "--test-interval", "0",
+        ])
+        assert rc == 0
+        with pytest.raises(ValueError, match="auto"):
+            Config(model="sparse_lr", num_feature_dim=64, block_size=0)
+        with pytest.raises(ValueError, match="resolved"):
+            from distlr_tpu.models import get_model
+            get_model(Config(model="blocked_lr", num_feature_dim=4096,
+                             block_size=0))
