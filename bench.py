@@ -36,6 +36,29 @@ import numpy as np
 from distlr_tpu.utils.backend import force_cpu, probe_default_backend_ex
 
 
+def _median_rate(state0, advance, samples_per_window: float,
+                 windows: int = 3) -> float:
+    """Median rate of ``windows`` timed applications of
+    ``advance(state) -> state``.  The tunnel adds 1.3x-class run-to-run
+    noise to any single window (165k-222k for the same dense program
+    across LAST_TPU captures) and the driver runs bench.py exactly once
+    per round — one bad window must not become the round's official
+    number.  State is threaded through windows (donated steps consume
+    their input buffer); the device->host checksum readback is the only
+    honest sync on platforms where block_until_ready returns at
+    dispatch time."""
+    rates = []
+    state = state0
+    for _ in range(windows):
+        t0 = time.perf_counter()
+        state = advance(state)
+        checksum = float(jnp.sum(state))
+        dt = time.perf_counter() - t0
+        assert np.isfinite(checksum)
+        rates.append(samples_per_window / dt)
+    return float(np.median(rates))
+
+
 def _bench_tpu(d: int, b: int, steps: int, lr: float, l2: float) -> float:
     from distlr_tpu.config import Config
     from distlr_tpu.models import BinaryLR
@@ -62,16 +85,9 @@ def _bench_tpu(d: int, b: int, steps: int, lr: float, l2: float) -> float:
         return w
 
     w = jnp.zeros(d, jnp.float32)
-    w = run(w, batch)
-    # Device->host readback is the only honest sync on experimental
-    # platforms where block_until_ready returns at dispatch time.
+    w = run(w, batch)  # compile warmup
     assert np.isfinite(float(jnp.sum(w)))
-    t0 = time.perf_counter()
-    w = run(w, batch)
-    checksum = float(jnp.sum(w))  # forces completion
-    dt = time.perf_counter() - t0
-    assert np.isfinite(checksum)
-    return b * steps / dt
+    return _median_rate(w, lambda w: run(w, batch), b * steps)
 
 
 def _bench_dense_int8dot(d: int, b: int, steps: int, lr: float) -> float:
@@ -105,14 +121,9 @@ def _bench_dense_int8dot(d: int, b: int, steps: int, lr: float) -> float:
         w, _ = jax.lax.scan(one_step, w, None, length=steps)
         return w
 
-    w = run(jnp.zeros(d, jnp.float32), batch)
+    w = run(jnp.zeros(d, jnp.float32), batch)  # compile warmup
     assert np.isfinite(float(jnp.sum(w)))
-    t0 = time.perf_counter()
-    w = run(w, batch)
-    checksum = float(jnp.sum(w))
-    dt = time.perf_counter() - t0
-    assert np.isfinite(checksum)
-    return b * steps / dt
+    return _median_rate(w, lambda w: run(w, batch), b * steps)
 
 
 def _bench_sparse(d: int, b: int, fields: int, steps: int, lr: float) -> float:
@@ -136,15 +147,15 @@ def _bench_sparse(d: int, b: int, fields: int, steps: int, lr: float) -> float:
     def step(w, batch):
         return w - lr * model.grad(w, batch, cfg)
 
-    w = step(jnp.zeros(d, jnp.float32), batch)
-    assert np.isfinite(float(jnp.sum(w)))  # readback = honest sync
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        w = step(w, batch)
-    checksum = float(jnp.sum(w))
-    dt = time.perf_counter() - t0
-    assert np.isfinite(checksum)
-    return b * steps / dt
+    w = step(jnp.zeros(d, jnp.float32), batch)  # compile warmup
+    assert np.isfinite(float(jnp.sum(w)))
+
+    def advance(w):
+        for _ in range(steps):
+            w = step(w, batch)
+        return w
+
+    return _median_rate(w, advance, b * steps)
 
 
 def _bench_blocked(d: int, b: int, fields: int, r: int, steps: int,
@@ -171,15 +182,15 @@ def _bench_blocked(d: int, b: int, fields: int, r: int, steps: int,
     def step(t, batch):
         return t - lr * model.grad(t, batch, cfg)
 
-    t = step(jnp.zeros((nb, r), jnp.float32), batch)
+    t = step(jnp.zeros((nb, r), jnp.float32), batch)  # compile warmup
     assert np.isfinite(float(jnp.sum(t)))
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        t = step(t, batch)
-    checksum = float(jnp.sum(t))
-    dt = time.perf_counter() - t0
-    assert np.isfinite(checksum)
-    return b * steps / dt
+
+    def advance(t):
+        for _ in range(steps):
+            t = step(t, batch)
+        return t
+
+    return _median_rate(t, advance, b * steps)
 
 
 def _bench_cpu_baseline(d: int, b: int, steps: int, lr: float, l2: float) -> float:

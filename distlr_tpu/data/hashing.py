@@ -222,7 +222,9 @@ def resolve_auto_block_size(data_dir: str, ctr_fields: int, num_buckets: int,
     :func:`suggest_block_size` on a sample of the first train shard.
     Requires raw shards on disk — auto cannot work on pre-encoded or
     injected data (the raw categorical ids are gone by then)."""
-    path = os.path.join(data_dir, "train", "part-001")
+    from distlr_tpu.data.sharding import part_name  # noqa: PLC0415
+
+    path = os.path.join(data_dir, "train", part_name(0))
     if not os.path.exists(path):
         raise FileNotFoundError(
             f"block_size=0 (auto) needs raw-CTR shards to sample; no "
@@ -245,7 +247,10 @@ def resolve_auto_block_size(data_dir: str, ctr_fields: int, num_buckets: int,
         )
     avg_line = sum(len(ln) for ln in probe) / len(probe)
     approx_rows = max(1, int(os.path.getsize(path) / avg_line))
-    stride = max(1, approx_rows // sample_rows)
+    # CEIL division: a floor stride of 1 on a shard just over
+    # sample_rows would keep only the head — the bias this whole path
+    # exists to avoid; ceil guarantees the kept lines span the file.
+    stride = max(1, -(-approx_rows // sample_rows))
     raw_ids, _ = read_raw_ctr_file(path, num_fields,
                                    max_rows=sample_rows, stride=stride)
     # only Rs that divide the table (get_model requires it; 1M-style
@@ -625,10 +630,9 @@ def read_raw_ctr_file(path: str, num_fields: int, *,
     else:
         import itertools  # noqa: PLC0415
 
+        stop = None if max_rows is None else max_rows * stride
         with open(path) as f:  # text mode: the line parser wants str
-            lines = list(itertools.islice(f, 0, None, stride))
-        if max_rows is not None:
-            lines = lines[:max_rows]
+            lines = list(itertools.islice(f, 0, stop, stride))
         (row_ptr, cols, vals), y = parse_libsvm_lines(lines, None, dense=False)
     n = len(y)
     lengths = np.diff(row_ptr)

@@ -112,16 +112,24 @@ def _config_from_args(args: argparse.Namespace) -> Config:
             mesh_shape={"data": cfg.num_workers, "model": args.feature_shards},
             feature_shards=args.feature_shards,
         )
-    if cfg.model == "blocked_lr" and cfg.block_size == 0:
-        from distlr_tpu.data.hashing import resolve_auto_block_size  # noqa: PLC0415
-
-        r = resolve_auto_block_size(cfg.data_dir, cfg.ctr_fields,
-                                    cfg.num_feature_dim)
-        log.info("block_size auto: resolved to R=%d%s", r,
-                 "" if r > 1 else " (scalar-equivalent: tuples in this "
-                 "data don't recur enough for wider rows)")
-        cfg = cfg.replace(block_size=r)
     return cfg
+
+
+def _resolve_auto_block(cfg: Config) -> Config:
+    """Resolve ``--block-size auto`` for roles that consume it (sync and
+    PS workers).  NOT called by ps-server: the server's parameter dim
+    doesn't depend on block_size and the server host may not have a
+    copy of the data dir at all."""
+    if cfg.model != "blocked_lr" or cfg.block_size != 0:
+        return cfg
+    from distlr_tpu.data.hashing import resolve_auto_block_size  # noqa: PLC0415
+
+    r = resolve_auto_block_size(cfg.data_dir, cfg.ctr_fields,
+                                cfg.num_feature_dim)
+    log.info("block_size auto: resolved to R=%d%s", r,
+             "" if r > 1 else " (scalar-equivalent: tuples in this "
+             "data don't recur enough for wider rows)")
+    return cfg.replace(block_size=r)
 
 
 def _maybe_force_cpu_devices(args: argparse.Namespace) -> None:
@@ -226,7 +234,7 @@ def cmd_sync(args: argparse.Namespace) -> int:
     from distlr_tpu.train import Trainer  # noqa: PLC0415
 
     _maybe_init_distributed(args)
-    cfg = _config_from_args(args)
+    cfg = _resolve_auto_block(_config_from_args(args))
     trainer = Trainer(cfg).load_data()
     trainer.fit(resume=args.resume)
     path = trainer.save_model()
@@ -241,7 +249,7 @@ def cmd_ps(args: argparse.Namespace) -> int:
     _maybe_force_cpu_devices(args)
     from distlr_tpu.train.ps_trainer import run_ps_local, run_ps_workers  # noqa: PLC0415
 
-    cfg = _config_from_args(args)
+    cfg = _resolve_auto_block(_config_from_args(args))
     if args.asynchronous:
         cfg = cfg.replace(sync_mode=False)
     if args.hosts:
