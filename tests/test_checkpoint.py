@@ -81,3 +81,34 @@ class TestTrainerResume:
         with Checkpointer(ck_dir) as ck:
             assert ck.latest_step() == 7
             assert 5 in ck.all_steps()
+
+    def test_blocked_family_resume_matches_uninterrupted(self, tmp_path):
+        """Resume is family-agnostic (the checkpoint carries the weight
+        PYTREE — the blocked table is a (rows, R) array, not a vector);
+        pin it with the same interrupted-vs-straight identity the dense
+        family has."""
+        from distlr_tpu.data.hashing import write_raw_ctr_shards
+
+        d = str(tmp_path / "rawctr")
+        write_raw_ctr_shards(d, 1600, 6, 4, 4, seed=11)
+        common = dict(
+            data_dir=d, num_feature_dim=1024, model="blocked_lr",
+            block_size=4, learning_rate=0.5, l2_c=0.0, test_interval=0,
+            checkpoint_interval=3,
+        )
+        mesh = make_mesh({"data": 4})
+
+        ck_full = str(tmp_path / "bk_full")
+        cfg_full = Config(num_iteration=10, checkpoint_dir=ck_full, **common)
+        t_full = np.asarray(Trainer(cfg_full, mesh=mesh).load_data().fit())
+
+        ck2 = str(tmp_path / "bk_resume")
+        tr_a = Trainer(Config(num_iteration=5, checkpoint_dir=ck2, **common),
+                       mesh=mesh).load_data()
+        tr_a.fit()
+        tr_b = Trainer(Config(num_iteration=10, checkpoint_dir=ck2, **common),
+                       mesh=mesh).load_data()
+        t_resumed = np.asarray(tr_b.fit(resume=True))
+
+        assert t_resumed.shape == (256, 4)  # table, not flat vector
+        np.testing.assert_allclose(t_resumed, t_full, atol=1e-5)
