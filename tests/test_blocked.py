@@ -473,3 +473,24 @@ class TestSuggestBlockSize:
             from distlr_tpu.models import get_model
             get_model(Config(model="blocked_lr", num_feature_dim=4096,
                              block_size=0))
+
+    def test_block_size_auto_ps_mode(self, tmp_path):
+        """PS mode resolves --block-size auto too (same helper, applied
+        in cmd_ps); the keyed blocked path then trains end to end."""
+        from distlr_tpu import launch
+
+        d = str(tmp_path / "auto_ps")
+        rc = launch.main([
+            "gen-data", "--data-dir", d, "--num-samples", "20000",
+            "--ctr-fields", "8", "--ctr-vocab", "2", "--ctr-raw",
+            "--num-parts", "2", "--seed", "5",
+        ])
+        assert rc == 0
+        rc = launch.main([
+            "ps", "--data-dir", d, "--model", "blocked_lr",
+            "--num-feature-dim", "4096", "--block-size", "auto",
+            "--num-iteration", "2", "--batch-size", "1024",
+            "--learning-rate", "0.5", "--l2-c", "0", "--test-interval", "0",
+            "--num-workers", "2", "--num-servers", "1",
+        ])
+        assert rc == 0
