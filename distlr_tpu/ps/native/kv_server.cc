@@ -25,6 +25,7 @@
 //
 // Usage:
 //   distlr_kv_server --port=P --num_workers=W --dim=D [--lr=0.2]
+//                    [--max_dim=2^31]  (elasticity/corruption cap, §below)
 //                    [--sync=1] [--last_gradient=0] [--bind_any=0]
 //
 // --port=0 binds an ephemeral port; the chosen port is announced as
@@ -43,6 +44,7 @@
 #include <sys/socket.h>
 #include <unistd.h>
 
+#include <algorithm>
 #include <atomic>
 #include <condition_variable>
 #include <cstring>
@@ -76,9 +78,10 @@ struct PendingPush {
 class KVServer {
  public:
   KVServer(int port, int num_workers, uint64_t dim, float lr, bool sync,
-           bool last_gradient, bool bind_any)
+           bool last_gradient, bool bind_any, uint64_t max_dim)
       : port_(port), num_workers_(num_workers), lr_(lr), sync_(sync),
-        last_gradient_(last_gradient), bind_any_(bind_any) {
+        last_gradient_(last_gradient), bind_any_(bind_any),
+        max_dim_(max_dim) {
     weights_.resize(dim, 0.0f);
   }
 
@@ -158,21 +161,91 @@ class KVServer {
     return true;
   }
 
+  // Read n elements into vec, GROWING IN CHUNKS as payload actually
+  // arrives: allocation then mirrors real traffic, so a corrupt or
+  // hostile 24-byte header claiming num_keys=2^31 cannot force a
+  // multi-GB resize before a single payload byte shows up.
+  template <typename T>
+  bool ReadChunked(int fd, std::vector<T>& vec, uint64_t n) {
+    constexpr uint64_t kChunk = 1 << 20;  // 1M elements per growth step
+    // Fill-cursor, not clear(): steady-state same-size frames reuse the
+    // buffer with ZERO resize/memset cost (a clear()+resize would memset
+    // the whole buffer every frame just for ReadFull to overwrite it);
+    // only genuine growth value-initializes, and only the new region.
+    if (vec.size() > n) vec.resize(n);
+    uint64_t filled = 0;
+    while (filled < n) {
+      const uint64_t take = std::min<uint64_t>(kChunk, n - filled);
+      if (vec.size() < filled + take) vec.resize(filled + take);
+      if (!ReadFull(fd, vec.data() + filled, take * sizeof(T))) return false;
+      filled += take;
+    }
+    return true;
+  }
+
   void Serve(int fd) {
+    try {
+      ServeLoop(fd);
+    } catch (const std::bad_alloc&) {
+      // Last line of the never-kill-the-rank invariant: a key just
+      // UNDER max_dim_ passes every guard yet can demand a huge
+      // EnsureCapacity resize (e.g. key 2^31-1 on a small slice =
+      // ~16 GiB for weights_+merge_).  An uncaught bad_alloc would
+      // std::terminate the whole group member; dropping the connection
+      // keeps the rank serving its real clients.  vector::resize has
+      // the strong guarantee, so server state is unchanged.
+      std::fprintf(stderr,
+                   "[distlr_kv_server] dropping connection: allocation "
+                   "for requested capacity failed\n");
+    }
+    FinishConnection(fd);
+  }
+
+  void ServeLoop(int fd) {
     std::vector<Key> keys;
     std::vector<Val> vals;
     while (true) {
       MsgHeader h{};
       if (!ReadFull(fd, &h, sizeof(h)) || h.magic != kMagic) break;
-      keys.resize(h.num_keys);
-      if (h.num_keys && !ReadFull(fd, keys.data(), h.num_keys * sizeof(Key))) break;
+      // Wire values size allocations, so garbage must DROP the
+      // connection, never kill the server: a corrupt num_keys or key id
+      // is an essentially random u64, and resize(2^50) would bad_alloc
+      // the whole group member (the supervisor would then respawn it
+      // for no reason).  The magic check alone cannot catch a frame
+      // whose header is intact but whose counts are corrupt.  Guards:
+      // num_keys capped by max_dim_ AND read chunk-by-chunk (see
+      // ReadChunked), every key id capped by max_dim_, and capacity
+      // grown to the frame's MAX key — not its last, the wire does not
+      // promise sorted keys, and an unsorted frame passing a
+      // back()-based bound would be an out-of-bounds heap write.
+      if (h.num_keys > max_dim_) {
+        std::fprintf(stderr,
+                     "[distlr_kv_server] dropping connection: frame "
+                     "num_keys %llu exceeds max_dim %llu\n",
+                     (unsigned long long)h.num_keys,
+                     (unsigned long long)max_dim_);
+        break;
+      }
+      if (!ReadChunked(fd, keys, h.num_keys)) break;
+      Key max_key = 0;
+      bool keys_ok = true;
+      for (uint64_t i = 0; i < h.num_keys; ++i) {
+        if (keys[i] >= max_dim_) { keys_ok = false; break; }
+        if (keys[i] > max_key) max_key = keys[i];
+      }
+      if (!keys_ok) {
+        std::fprintf(stderr,
+                     "[distlr_kv_server] dropping connection: key id "
+                     "exceeds max_dim %llu\n",
+                     (unsigned long long)max_dim_);
+        break;
+      }
       const Op op = static_cast<Op>(h.op);
       if (op == Op::kPush || op == Op::kPushPull) {
-        vals.resize(h.num_keys);
-        if (h.num_keys && !ReadFull(fd, vals.data(), h.num_keys * sizeof(Val))) break;
-        HandlePush(fd, h, keys, vals, op == Op::kPushPull);
+        if (!ReadChunked(fd, vals, h.num_keys)) break;
+        HandlePush(fd, h, keys, vals, max_key, op == Op::kPushPull);
       } else if (op == Op::kPull) {
-        HandlePull(fd, h, keys);
+        HandlePull(fd, h, keys, max_key);
       } else if (op == Op::kBarrier) {
         HandleBarrier(fd, h);
       } else if (op == Op::kStats) {
@@ -195,6 +268,9 @@ class KVServer {
         break;
       }
     }
+  }
+
+  void FinishConnection(int fd) {
     DropConnection(fd);
     {
       std::lock_guard<std::mutex> lock(mu_);
@@ -232,11 +308,15 @@ class KVServer {
   // reply_weights = fused kPushPull: the reply carries the post-update
   // weights for the pushed keys (see kv_protocol.h). ---
   void HandlePush(int fd, const MsgHeader& h, const std::vector<Key>& keys,
-                  const std::vector<Val>& vals, bool reply_weights = false) {
+                  const std::vector<Val>& vals, Key max_key,
+                  bool reply_weights = false) {
     std::unique_lock<std::mutex> lock(mu_);
     ++n_push_;
     if (reply_weights) ++n_pull_;  // it serves the next pull too
-    if (!keys.empty()) EnsureCapacity(keys.back());
+    // max_key computed by Serve over the WHOLE frame — keys.back()
+    // would assume sorted keys, and an unsorted frame would then write
+    // out of bounds.
+    if (!keys.empty()) EnsureCapacity(max_key);
 
     if (h.flags & kInitPush) {
       // Idempotent init (kv_protocol.h): seeds only an uninitialized
@@ -354,12 +434,15 @@ class KVServer {
   }
 
   // --- PULL: reply current weights (src/main.cc:85-95) ---
-  void HandlePull(int fd, const MsgHeader& h, const std::vector<Key>& keys) {
+  void HandlePull(int fd, const MsgHeader& h, const std::vector<Key>& keys,
+                  Key max_key) {
     std::vector<Val> out(keys.size());
     {
       std::lock_guard<std::mutex> lock(mu_);
       ++n_pull_;
-      if (!keys.empty()) EnsureCapacity(keys.back());
+      // frame-wide max from Serve, not keys.back() (unsorted frame =>
+      // out-of-bounds read)
+      if (!keys.empty()) EnsureCapacity(max_key);
       for (size_t i = 0; i < keys.size(); ++i) out[i] = weights_[keys[i]];
     }
     Respond(fd, h, out.data(), out.size());
@@ -420,6 +503,7 @@ class KVServer {
   bool sync_;
   bool last_gradient_;
   bool bind_any_;
+  uint64_t max_dim_;
   int listen_fd_ = -1;
   std::atomic<bool> shutdown_{false};
   std::vector<int> active_fds_;
@@ -463,8 +547,16 @@ int main(int argc, char** argv) {
   const bool sync = Arg(argc, argv, "sync", 1) != 0;
   const bool last_gradient = Arg(argc, argv, "last_gradient", 0) != 0;
   const bool bind_any = Arg(argc, argv, "bind_any", 0) != 0;
+  // Elasticity cap: keys may grow the slice past --dim, but never past
+  // this (wire-corruption guard: rejects essentially all random u64s
+  // while permitting any realistic slice).  Always at least --dim, so a
+  // legitimately huge pre-sized slice can never have its own keys
+  // misread as corruption.
+  const uint64_t max_dim = std::max<uint64_t>(
+      static_cast<uint64_t>(Arg(argc, argv, "max_dim", 1L << 31)),
+      static_cast<uint64_t>(dim));
   distlr::KVServer server(port, num_workers, static_cast<uint64_t>(dim),
                           static_cast<float>(lr), sync, last_gradient,
-                          bind_any);
+                          bind_any, max_dim);
   return server.Run();
 }

@@ -45,6 +45,7 @@ class ServerGroup:
         ports: list[int] | None = None,
         bind_any: bool = False,
         binary: str | None = None,
+        max_dim: int | None = None,
     ):
         build_native()
         self._binary = binary or server_binary()
@@ -58,6 +59,9 @@ class ServerGroup:
             sync=int(sync),
             last_gradient=int(last_gradient),
             bind_any=int(bind_any),
+            # elasticity/corruption cap (server --max_dim); None = the
+            # server's default (2^31, always clamped to >= its slice dim)
+            max_dim=max_dim,
         )
         # serializes respawn() against stop() (supervisor thread vs
         # teardown) and marks teardown so a racing respawn becomes a no-op
@@ -87,6 +91,8 @@ class ServerGroup:
             f"--last_gradient={self._args['last_gradient']}",
             f"--bind_any={self._args['bind_any']}",
         ]
+        if self._args["max_dim"] is not None:
+            cmd.append(f"--max_dim={self._args['max_dim']}")
         proc = subprocess.Popen(cmd, stdout=subprocess.PIPE, text=True)
         # The server prints "PORT <n>" once listening; blocking on that
         # line doubles as the readiness wait.

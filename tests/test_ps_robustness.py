@@ -711,3 +711,109 @@ class TestSupervisorEdgeCases:
                 time.sleep(0.3)  # several poll cycles after retirement
                 assert sup.events == [], sup.events
                 assert all(p.poll() == 0 for p in g.procs)
+
+
+class TestWireCorruption:
+    """Wire values size allocations on the server; garbage must drop the
+    connection, never kill the group member (a bad_alloc from
+    resize(2^50) would take down the whole rank and trigger a pointless
+    supervisor respawn)."""
+
+    HEADER = "<IBBHIIQ"  # kv_protocol.h MsgHeader, 24 bytes packed
+    MAGIC = 0xD157C0DE
+
+    def _frame(self, op, num_keys):
+        import struct
+        return struct.pack(self.HEADER, self.MAGIC, op, 0, 0, 99, 1, num_keys)
+
+    def test_huge_num_keys_drops_connection_not_server(self):
+        import socket
+        import struct
+
+        with ServerGroup(1, 1, dim=8, sync=False) as g:
+            port = g.ports[0]
+            with socket.create_connection(("127.0.0.1", port), timeout=5) as s:
+                s.sendall(self._frame(op=1, num_keys=1 << 50))  # kPush
+                # server must close on us, not crash
+                assert s.recv(1) == b""
+            with socket.create_connection(("127.0.0.1", port), timeout=5) as s:
+                # key id past the elasticity cap: same outcome
+                s.sendall(self._frame(op=2, num_keys=1))  # kPull
+                s.sendall(struct.pack("<Q", 1 << 60))
+                assert s.recv(1) == b""
+            assert all(g.alive())
+            # and the server still serves real clients afterwards
+            with KVWorker(g.hosts, 8, timeout_ms=5000, sync_group=False) as kv:
+                assert kv.stats(0)["dim"] == 8
+                kv.shutdown_servers()
+
+    def test_unsorted_push_frame_grows_to_max_key(self):
+        """Regression (r4 review): capacity used to grow to keys.back(),
+        which assumes sorted keys — an unsorted frame like [100, 3] on a
+        dim-8 server would write weights_[100] out of bounds.  The wire
+        does not promise ordering, so the server must size by the
+        frame's MAX key and apply both updates."""
+        import socket
+        import struct
+
+        with ServerGroup(1, 1, dim=8, sync=False, learning_rate=1.0) as g:
+            with socket.create_connection(("127.0.0.1", g.ports[0]),
+                                          timeout=5) as s:
+                # async push, keys [100, 3] (unsorted), grads [2.0, 5.0]
+                s.sendall(self._frame(op=1, num_keys=2))
+                s.sendall(struct.pack("<QQ", 100, 3))
+                s.sendall(struct.pack("<ff", 2.0, 5.0))
+                # first-ever push takes the init branch: seeds weights
+                resp = s.recv(24)
+                assert len(resp) == 24
+            assert all(g.alive())
+            with KVWorker(g.hosts, 101, timeout_ms=5000,
+                          sync_group=False) as kv:
+                w = kv.pull()
+                assert w[100] == 2.0 and w[3] == 5.0  # init semantics
+                kv.shutdown_servers()
+
+    def test_alloc_failure_drops_connection_not_server(self):
+        """A key just UNDER the elasticity cap passes every guard but
+        demands a huge EnsureCapacity resize; the bad_alloc must drop
+        the connection, not std::terminate the rank.  Deterministic via
+        an address-space rlimit on a directly-spawned server."""
+        import shlex
+        import socket
+        import struct
+        import subprocess
+
+        from distlr_tpu.ps.build import server_binary
+
+        # ulimit via a shell wrapper, NOT preexec_fn: preexec_fn forces
+        # a raw os.fork() in this (JAX-)multithreaded test process —
+        # a documented deadlock risk — while a plain argv spawn uses
+        # posix_spawn.
+        cmd = (f"ulimit -v {1 << 20}; exec "  # 1 GiB of address space
+               f"{shlex.quote(server_binary())} --port=0 --num_workers=1 "
+               f"--dim=8 --sync=0")
+        proc = subprocess.Popen(
+            ["/bin/sh", "-c", cmd],
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
+        )
+        try:
+            line = proc.stdout.readline().strip()
+            assert line.startswith("PORT "), line
+            port = int(line.split()[1])
+            with socket.create_connection(("127.0.0.1", port), timeout=10) as s:
+                # pull of key 2^31-1: under the default cap, but the
+                # resize to ~16 GiB cannot fit in a 1 GiB address space
+                s.sendall(self._frame(op=2, num_keys=1))
+                s.sendall(struct.pack("<Q", (1 << 31) - 1))
+                assert s.recv(1) == b""  # dropped, not served
+            assert proc.poll() is None  # rank still alive
+            # still serves real clients afterwards
+            with KVWorker(f"127.0.0.1:{port}", 8, timeout_ms=5000,
+                          sync_group=False) as kv:
+                assert kv.stats(0)["dim"] == 8
+                kv.shutdown_servers()
+            proc.wait(timeout=10)
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait()
