@@ -193,21 +193,37 @@ int RoundTrip(Client* c, Op op, const Key* keys, const float* vals,
       snprintf(c->err, sizeof(c->err), "bad response from server %zu", s);
       return -1;
     }
-    if (rh.num_keys) {
-      std::vector<Val> buf(rh.num_keys);
-      if (!ReadFull(c->servers[s].fd, buf.data(), rh.num_keys * sizeof(Val))) {
+    // Validate the response size BEFORE any allocation: the client
+    // knows exactly how many vals a well-formed reply carries (the key
+    // slice for pull-class ops, zero otherwise), so a corrupt num_keys
+    // must poison the stream — sizing a buffer from it would let one
+    // bad frame demand an arbitrary allocation, and a bad_alloc
+    // escaping this extern "C" boundary would terminate the worker.
+    const uint64_t expected =
+        (op == Op::kPull || op == Op::kPushPull) ? (e - b) : 0;
+    if (rh.num_keys != expected) {
+      c->poisoned = true;
+      snprintf(c->err, sizeof(c->err),
+               "response size mismatch from server %zu", s);
+      return -1;
+    }
+    if (expected) {
+      bool ok;
+      if (out_vals != nullptr) {
+        ok = ReadFull(c->servers[s].fd, out_vals + b,
+                      expected * sizeof(Val));
+      } else {
+        // Caller doesn't want the weights (push_pull with a null out is
+        // legal through the C API): drain the well-sized payload so the
+        // stream stays framed.  Bounded by the caller's own key slice.
+        std::vector<Val> scratch(expected);
+        ok = ReadFull(c->servers[s].fd, scratch.data(),
+                      expected * sizeof(Val));
+      }
+      if (!ok) {
         c->poisoned = true;
         snprintf(c->err, sizeof(c->err), "short response from server %zu", s);
         return -1;
-      }
-      if ((op == Op::kPull || op == Op::kPushPull) && out_vals != nullptr) {
-        if (rh.num_keys != e - b) {
-          c->poisoned = true;
-          snprintf(c->err, sizeof(c->err),
-                   "pull size mismatch from server %zu", s);
-          return -1;
-        }
-        std::memcpy(out_vals + b, buf.data(), buf.size() * sizeof(Val));
       }
     }
   }

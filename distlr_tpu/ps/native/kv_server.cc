@@ -290,9 +290,27 @@ class KVServer {
   }
 
   void EnsureCapacity(Key max_key) {
-    if (max_key >= weights_.size()) {
+    if (max_key < weights_.size()) return;
+    const size_t old_w = weights_.size();
+    const size_t old_m = merge_.size();
+    try {
       weights_.resize(max_key + 1, 0.0f);
       merge_.resize(weights_.size(), 0.0f);
+    } catch (...) {
+      // All-or-nothing: weights_.resize succeeding and merge_.resize
+      // throwing would leave a permanently inflated weights_ whose size
+      // re-triggers the same bad_alloc on every later legitimate sync
+      // push.  Restore both sizes and give the big block back
+      // (shrink_to_fit); the tiny re-allocation there failing too is
+      // astronomically unlikely and only costs footprint, not state.
+      weights_.resize(old_w);
+      merge_.resize(old_m);
+      try {
+        weights_.shrink_to_fit();
+        merge_.shrink_to_fit();
+      } catch (...) {
+      }
+      throw;
     }
   }
 
@@ -357,9 +375,15 @@ class KVServer {
     }
 
     // Sync/BSP: merge and defer the response (src/main.cc:57-78).
+    // Order matters for exception safety: ALL allocating operations
+    // (merge_ resize, the pending entry's key/val copies) happen BEFORE
+    // the merge_ mutation loop, which itself cannot throw.  The reverse
+    // order would let a bad_alloc in push_back leave an orphan gradient
+    // in merge_ with no pending entry — DropConnection's rollback could
+    // never remove it, and the worker's retry would count twice.
     if (merge_.size() < weights_.size()) merge_.resize(weights_.size(), 0.0f);
-    for (size_t i = 0; i < keys.size(); ++i) merge_[keys[i]] += vals[i];
     pending_.push_back({fd, h, keys, vals, reply_weights});
+    for (size_t i = 0; i < keys.size(); ++i) merge_[keys[i]] += vals[i];
 
     if (static_cast<int>(pending_.size()) == num_workers_) {
       const float w = static_cast<float>(num_workers_);
