@@ -266,3 +266,30 @@ class TestExternalA9aFormatIngestion:
         tr = Trainer(cfg).load_data()
         tr.fit(eval_fn=lambda *_: None)
         assert tr.evaluate() >= 0.70
+
+
+class TestParserFuzz:
+    def test_random_garbage_never_crashes(self):
+        """Parsers handle untrusted files: any byte soup must raise a
+        clean ValueError (or parse), never crash/hang — both the native
+        tokenizer path and the pure-Python fallback."""
+        import numpy as np
+
+        from distlr_tpu.data.libsvm import parse_libsvm_lines
+
+        rng = np.random.default_rng(0)
+        for _ in range(200):
+            blob = bytes(rng.integers(0, 256, int(rng.integers(0, 400)),
+                                      dtype=np.uint8))
+            try:
+                parse_libsvm_lines(blob, None, dense=False)
+            except (ValueError, UnicodeDecodeError):
+                pass
+        for _ in range(200):
+            line = f"{rng.integers(-2, 3)} " + " ".join(
+                f"{rng.integers(-5, 5)}:{rng.integers(-9, 9)}:{rng.integers(0, 9)}"
+                for _ in range(int(rng.integers(0, 6))))
+            try:
+                parse_libsvm_lines(line, None, dense=False)
+            except ValueError:
+                pass
