@@ -1,0 +1,29 @@
+#!/bin/bash
+# One-shot on-chip artifact capture. Run whenever the TPU tunnel is
+# alive — it wedges for hours at a time (rounds 1-4 all lost windows to
+# it), so every live window should bank all driver-facing artifacts:
+#
+#   1. bench.py            -> benchmarks/LAST_TPU.json  (the LKG row the
+#                             CPU-fallback bench carries)
+#   2. bench_configs.py    -> BENCH_CONFIGS.json        (all 5 configs,
+#      --isolate              one subprocess per config: HBM released
+#                             between configs; aborts without partial writes)
+#
+# Each step prints its tail; the script stops at the first failure so a
+# half-wedged tunnel can't burn the whole window. Nothing else should
+# touch the TPU while this runs (concurrent probes push subprocesses
+# onto their CPU fallbacks).
+set -e
+cd "$(dirname "$0")/.."
+
+echo "== probe =="
+timeout 90 python -c "import jax, jax.numpy as j; print('tpu ok', float(j.ones((64,64)).sum()))"
+
+echo "== bench.py (headline + sub-rates, median-of-3 windows) =="
+timeout 1200 python bench.py
+
+echo "== bench_configs.py --isolate (all 5 configs) =="
+timeout 3600 python -u benchmarks/bench_configs.py --isolate
+
+echo "== done; review git status and commit the artifacts =="
+git status --short BENCH_CONFIGS.json benchmarks/LAST_TPU.json
