@@ -1,0 +1,77 @@
+"""Experiment: batch-size sweep for the row-blocked CTR step.
+
+Every blocked rate so far was measured at B=65536 (chosen ad hoc in
+round 2).  The step is gather-unit-bound (ROOFLINE.md), and gather
+throughput amortizes fixed per-step dispatch/launch cost — so larger B
+may still raise the R=16/R=32 rates toward the gather ceiling, and
+smaller B would show where dispatch overhead starts to dominate.
+
+Sweeps B in {16k, 32k, 64k, 128k, 256k} for R in {16, 32} at config-4
+shape (D=1M, 21 fields), device-resident batches, donated weights,
+median of 3 windows.
+
+Run on the real chip: python benchmarks/exp_blocked_batch.py
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+import sys
+import time
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.dirname(HERE))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from distlr_tpu.config import Config
+from distlr_tpu.data.hashing import make_uniform_blocked_batch
+from distlr_tpu.models import BlockedSparseLR
+
+D, FIELDS, STEPS = 1_000_000, 21, 20
+LR = 0.5
+
+
+def rate(r: int, b: int) -> float:
+    nb = D // r
+    cfg = Config(num_feature_dim=D, model="blocked_lr", block_size=r, l2_c=0.0)
+    model = BlockedSparseLR(nb, r)
+    rng = np.random.default_rng(0)
+    blocks, lane_vals = make_uniform_blocked_batch(rng, b, FIELDS, nb, r)
+    batch = (jnp.asarray(blocks), jnp.asarray(lane_vals),
+             jnp.asarray(rng.integers(0, 2, b), jnp.int32),
+             jnp.ones(b, jnp.float32))
+
+    @functools.partial(jax.jit, donate_argnums=0)
+    def step(t, batch):
+        return t - LR * model.grad(t, batch, cfg)
+
+    t = step(jnp.zeros((nb, r), jnp.float32), batch)
+    assert np.isfinite(float(jnp.sum(t)))
+    rates = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for _ in range(STEPS):
+            t = step(t, batch)
+        checksum = float(jnp.sum(t))
+        dt = time.perf_counter() - t0
+        assert np.isfinite(checksum)
+        rates.append(b * STEPS / dt)
+    return float(np.median(rates))
+
+
+def main():
+    print(f"backend={jax.default_backend()} D={D} fields={FIELDS} "
+          f"steps={STEPS} (median of 3 windows)")
+    for r in (16, 32):
+        row = []
+        for b in (1 << 14, 1 << 15, 1 << 16, 1 << 17, 1 << 18):
+            row.append(f"B={b:>6}: {rate(r, b)/1e6:6.2f} M/s")
+        print(f"R={r:2d}  " + "   ".join(row))
+
+
+if __name__ == "__main__":
+    main()
