@@ -1,0 +1,56 @@
+"""Smoke tests for the driver-facing benchmark entry points.
+
+The driver runs ``bench.py`` and (this round) ``bench_configs.py`` to
+produce the official artifacts; nothing else in the suite imports them,
+so a refactor that breaks only a bench path would otherwise surface for
+the first time inside the driver's one shot at the artifact.  These run
+the quick/CPU-fallback paths end to end — shapes are tiny, but every
+line of plumbing (probe fallback, JSON schema, scratch-file divert) is
+the real one.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run(cmd, timeout=600):
+    # DISTLR_PROBE_TIMEOUT_S=3: the accelerator probe against a wedged
+    # tunnel would otherwise cost each subprocess its full 60s default
+    # before the CPU fallback these tests are exercising anyway.
+    return subprocess.run(
+        cmd, cwd=REPO, capture_output=True, text=True, timeout=timeout,
+        env={**os.environ, "DISTLR_CPU_DEVICES": "1",
+             "DISTLR_PROBE_TIMEOUT_S": "3"},
+    )
+
+
+def test_bench_configs_quick_writes_scratch_not_canonical(tmp_path):
+    canonical = os.path.join(REPO, "BENCH_CONFIGS.json")
+    before = open(canonical).read()
+    r = _run([sys.executable, "benchmarks/bench_configs.py", "--quick",
+              "--configs", "1,5"])
+    assert r.returncode == 0, r.stderr[-2000:]
+    # canonical artifact untouched; quick rows landed in the scratch file
+    assert open(canonical).read() == before
+    quick = json.load(open(os.path.join(REPO, "BENCH_CONFIGS_quick.json")))
+    assert quick["quick"] is True
+    configs = [row["config"] for row in quick["rows"]]
+    assert configs == [1, 5]
+    row5 = quick["rows"][1]
+    # the round-4 quality anchors must be present in the schema
+    for field in ("oracle_accuracy", "converged_accuracy", "samples_per_sec"):
+        assert field in row5, row5
+
+
+def test_bench_configs_explicit_out(tmp_path):
+    out = str(tmp_path / "bc.json")
+    r = _run([sys.executable, "benchmarks/bench_configs.py", "--quick",
+              "--configs", "1", "--out", out])
+    assert r.returncode == 0, r.stderr[-2000:]
+    data = json.load(open(out))
+    assert data["rows"][0]["config"] == 1
+    assert data["rows"][0]["samples_per_sec"] > 0
