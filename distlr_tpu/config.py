@@ -204,15 +204,9 @@ class Config:
                 "feature_dtype='int8_dot' (native int8 MXU contraction) "
                 f"requires model='binary_lr'; got model={self.model!r}"
             )
-        if self.feature_dtype == "int8_dot" and self.feature_shards > 1:
-            # The feature-sharded / ring steps compute partial logits with
-            # the bf16 convert formulation; running them on an int8_dot
-            # model would silently fall back to the convert path.  Reject
-            # until the sharded steps grow a native-int8 formulation.
-            raise ValueError(
-                "feature_dtype='int8_dot' is single-shard only "
-                "(feature_shards must be 1)"
-            )
+        # (int8_dot + feature_shards > 1 is supported since r4: both the
+        # psum and ring feature-sharded steps feed the native int8
+        # contraction — parallel/feature_parallel.partial_logits.)
         if self.model in ("sparse_lr", "blocked_lr") and self.feature_dtype != "float32":
             # Quantized resident feature storage is a dense-matrix
             # capability; sparse COO / blocked lane vals stay float32 in

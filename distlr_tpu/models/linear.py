@@ -121,6 +121,17 @@ def _int8_contract(a, b, a_axis: int) -> jnp.ndarray:
     return acc
 
 
+def quantize_sym(x, max_abs):
+    """Symmetric int8 quantization on the grid defined by ``max_abs``:
+    ``(q int8, scale)`` with ``x ~ q * scale``.  The ONE definition of
+    the int8_dot grid — the single-device paths and the feature-sharded
+    steps (which compute ``max_abs`` with a pmax) must quantize
+    identically for their bit-for-bit weight-grid parity to hold."""
+    scale = jnp.maximum(max_abs, 1e-8) * (1.0 / 127.0)
+    q = jnp.clip(jnp.round(x / scale), -127, 127).astype(jnp.int8)
+    return q, scale
+
+
 def _masked_mean(values, mask):
     denom = jnp.maximum(jnp.sum(mask), 1)
     return jnp.sum(values * mask) / denom
@@ -167,8 +178,7 @@ class BinaryLR:
 
     def logits(self, w, X):
         if self.int8_dot:
-            s_w = jnp.maximum(jnp.max(jnp.abs(w)), 1e-8) * (1.0 / 127.0)
-            wq = jnp.clip(jnp.round(w / s_w), -127, 127).astype(jnp.int8)
+            wq, s_w = quantize_sym(w, jnp.max(jnp.abs(w)))
             z = _int8_contract(X, wq, X.ndim - 1)
             return z * (s_w * self.feature_scale)
         cdt = jnp.dtype(self.compute_dtype)
@@ -199,8 +209,7 @@ class BinaryLR:
             # full int8 resolution on whatever range this batch actually
             # spans (near convergence |r| shrinks, and a fixed scale
             # would quantize everything to 0).
-            s_r = jnp.maximum(jnp.max(jnp.abs(resid)), 1e-8) * (1.0 / 127.0)
-            rq = jnp.clip(jnp.round(resid / s_r), -127, 127).astype(jnp.int8)
+            rq, s_r = quantize_sym(resid, jnp.max(jnp.abs(resid)))
             g = _int8_contract(rq, X, 0) * (s_r * self.feature_scale) / n
             return g + _l2_grad(w, cfg, n)
         cdt = jnp.dtype(self.compute_dtype)

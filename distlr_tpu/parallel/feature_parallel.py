@@ -35,6 +35,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from distlr_tpu.config import Config
 from distlr_tpu.models import BinaryLR, SoftmaxRegression
+from distlr_tpu.models.linear import _int8_contract, quantize_sym
 from distlr_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS, shard_map
 
 
@@ -58,15 +59,48 @@ def _per_sample_logloss(z, y, is_softmax: bool):
     return jax.nn.softplus(z) - y.astype(jnp.float32) * z
 
 
-def _local_forward(model, w_shard, X_shard):
-    """Partial logits from this device's feature shard, then psum."""
+def partial_logits(model, w_shard, X_shard):
+    """This device's feature-shard contribution to the logits (already
+    feature-scaled); the caller reduces over ``model`` (psum or ring).
+
+    int8_dot models quantize the weight shard on a GLOBAL grid (|w| max
+    via pmax over shards), so the formulation matches the single-device
+    int8_dot path bit-for-bit on the weight side, and feed the native
+    int8 x int8 -> int32 contraction; others take the compute-dtype
+    matmul with the convert fused in."""
+    if getattr(model, "int8_dot", False):
+        wq, s_w = quantize_sym(
+            w_shard, lax.pmax(jnp.max(jnp.abs(w_shard)), MODEL_AXIS))
+        return _int8_contract(X_shard, wq, X_shard.ndim - 1) * (
+            s_w * model.feature_scale)
     cdt = jnp.dtype(model.compute_dtype)
     z_partial = jnp.dot(
         X_shard.astype(cdt), w_shard.astype(cdt), preferred_element_type=jnp.float32
     )
     if model.feature_scale != 1.0:  # int8-quantized features (BinaryLR doc)
         z_partial = z_partial * model.feature_scale
-    return lax.psum(z_partial, MODEL_AXIS)
+    return z_partial
+
+
+def binary_resid_grad(model, resid, X_shard, n):
+    """resid^T @ X_shard / n for the binary model, int8_dot-aware.
+
+    Residuals are replicated along ``model`` (computed from the reduced
+    logits), so a local max IS the model-axis global max; along ``data``
+    each shard quantizes its own batch slice — the same semantics as the
+    data-parallel int8_dot step.  feature_scale is NOT applied here (the
+    callers multiply it with their other scale factors)."""
+    if getattr(model, "int8_dot", False):
+        rq, s_r = quantize_sym(resid, jnp.max(jnp.abs(resid)))
+        return _int8_contract(rq, X_shard, 0) * s_r / n
+    cdt = jnp.dtype(model.compute_dtype)
+    return jnp.dot(resid.astype(cdt), X_shard.astype(cdt),
+                   preferred_element_type=jnp.float32) / n
+
+
+def _local_forward(model, w_shard, X_shard):
+    """Partial logits from this device's feature shard, then psum."""
+    return lax.psum(partial_logits(model, w_shard, X_shard), MODEL_AXIS)
 
 
 def make_feature_sharded_train_step(model, cfg: Config, mesh: Mesh, *, with_metrics: bool = True):
@@ -91,7 +125,7 @@ def make_feature_sharded_train_step(model, cfg: Config, mesh: Mesh, *, with_metr
             g = jnp.dot(X.astype(cdt).T, resid.astype(cdt), preferred_element_type=jnp.float32) / n
         else:
             resid = (jax.nn.sigmoid(z) - y.astype(jnp.float32)) * mask
-            g = jnp.dot(resid.astype(cdt), X.astype(cdt), preferred_element_type=jnp.float32) / n
+            g = binary_resid_grad(model, resid, X, n)
         ll = _per_sample_logloss(z, y, is_softmax)
         if model.feature_scale != 1.0:  # d/dw of (X*scale) @ w
             g = g * model.feature_scale

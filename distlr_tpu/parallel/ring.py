@@ -31,7 +31,11 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from distlr_tpu.config import Config
 from distlr_tpu.models import BinaryLR
-from distlr_tpu.parallel.feature_parallel import _check_mesh
+from distlr_tpu.parallel.feature_parallel import (
+    _check_mesh,
+    binary_resid_grad,
+    partial_logits,
+)
 from distlr_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS, shard_map
 
 
@@ -122,15 +126,11 @@ def make_ring_train_step(model, cfg: Config, mesh: Mesh, *, with_metrics: bool =
 
     def local_step(w, X, y, mask):
         n = jnp.maximum(jnp.sum(mask), 1).astype(jnp.float32)
-        cdt = jnp.dtype(model.compute_dtype)
-        z_partial = jnp.dot(
-            X.astype(cdt), w.astype(cdt), preferred_element_type=jnp.float32
-        )
-        if model.feature_scale != 1.0:  # int8-quantized X (BinaryLR doc)
-            z_partial = z_partial * model.feature_scale
-        z = ring_psum(z_partial, MODEL_AXIS)
+        # same int8_dot-aware partials as the psum step; only the
+        # reduction differs (explicit ppermute ring vs XLA psum)
+        z = ring_psum(partial_logits(model, w, X), MODEL_AXIS)
         resid = (jax.nn.sigmoid(z) - y.astype(jnp.float32)) * mask
-        g = jnp.dot(resid.astype(cdt), X.astype(cdt), preferred_element_type=jnp.float32) / n
+        g = binary_resid_grad(model, resid, X, n)
         if model.feature_scale != 1.0:  # d/dw of (X*scale) @ w
             g = g * model.feature_scale
         l2 = cfg.l2_c * w

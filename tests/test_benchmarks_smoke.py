@@ -28,22 +28,28 @@ def _run(cmd, timeout=600):
     )
 
 
-def test_bench_configs_quick_writes_scratch_not_canonical(tmp_path):
+def test_bench_configs_quick_writes_scratch_not_canonical():
     canonical = os.path.join(REPO, "BENCH_CONFIGS.json")
+    scratch = os.path.join(REPO, "BENCH_CONFIGS_quick.json")
     before = open(canonical).read()
-    r = _run([sys.executable, "benchmarks/bench_configs.py", "--quick",
-              "--configs", "1,5"])
-    assert r.returncode == 0, r.stderr[-2000:]
-    # canonical artifact untouched; quick rows landed in the scratch file
-    assert open(canonical).read() == before
-    quick = json.load(open(os.path.join(REPO, "BENCH_CONFIGS_quick.json")))
-    assert quick["quick"] is True
-    configs = [row["config"] for row in quick["rows"]]
-    assert configs == [1, 5]
-    row5 = quick["rows"][1]
-    # the round-4 quality anchors must be present in the schema
-    for field in ("oracle_accuracy", "converged_accuracy", "samples_per_sec"):
-        assert field in row5, row5
+    try:
+        r = _run([sys.executable, "benchmarks/bench_configs.py", "--quick",
+                  "--configs", "1,5"])
+        assert r.returncode == 0, r.stderr[-2000:]
+        # canonical artifact untouched; quick rows went to the scratch file
+        assert open(canonical).read() == before
+        quick = json.load(open(scratch))
+        assert quick["quick"] is True
+        configs = [row["config"] for row in quick["rows"]]
+        assert configs == [1, 5]
+        row5 = quick["rows"][1]
+        # the round-4 quality anchors must be present in the schema
+        for field in ("oracle_accuracy", "converged_accuracy",
+                      "samples_per_sec"):
+            assert field in row5, row5
+    finally:
+        if os.path.exists(scratch):
+            os.remove(scratch)
 
 
 def test_bench_configs_explicit_out(tmp_path):

@@ -126,8 +126,10 @@ class TestInt8Dot:
         with pytest.raises(ValueError, match="binary_lr"):
             Config(model="sparse_lr", feature_dtype="int8_dot",
                    num_feature_dim=64)
-        with pytest.raises(ValueError, match="single-shard"):
-            Config(feature_dtype="int8_dot", feature_shards=2)
+        # feature-sharded int8_dot is supported since r4 (the sharded
+        # steps feed the native int8 contraction)
+        assert Config(feature_dtype="int8_dot",
+                      feature_shards=2).feature_shards == 2
 
     def test_long_contraction_does_not_wrap_int32(self):
         """Worst-case same-sign int8 contractions longer than

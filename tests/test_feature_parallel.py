@@ -92,6 +92,67 @@ class TestFeatureShardedBinaryLR:
         assert float(evaluate(w, b)["accuracy"]) > 0.95
 
 
+class TestFeatureShardedInt8Dot:
+    def test_matches_single_device_int8dot_step(self, mesh42):
+        """Feature-sharded int8_dot == single-device int8_dot within
+        quantization noise: the weight shards quantize on a GLOBAL
+        scale (pmax), so the forward matches exactly; only the
+        per-data-shard residual scale differs from the single-device
+        global one."""
+        import dataclasses
+
+        d = 16
+        cfg = Config(learning_rate=0.2, l2_c=0.0, num_feature_dim=d,
+                     feature_dtype="int8_dot", feature_shards=2)
+        model = dataclasses.replace(
+            BinaryLR(d, int8_dot=True), feature_scale=1.0 / 127.0)
+        rng = np.random.default_rng(3)
+        X = rng.integers(-127, 128, (32, d)).astype(np.int8)
+        y = rng.integers(0, 2, 32).astype(np.int32)
+        mask = np.ones(32, np.float32)
+        w0 = (0.1 * rng.standard_normal(d)).astype(np.float32)
+
+        step = make_feature_sharded_train_step(model, cfg, mesh42)
+        w_sh = shard_weights(jnp.asarray(w0), mesh42)
+        b_sh = shard_batch_2d(
+            (jnp.asarray(X), jnp.asarray(y), jnp.asarray(mask)), mesh42)
+        w1, metrics = step(w_sh, b_sh)
+
+        g_ref = model.grad(
+            jnp.asarray(w0),
+            (jnp.asarray(X), jnp.asarray(y), jnp.asarray(mask)), cfg)
+        w1_ref = w0 - 0.2 * np.asarray(g_ref)
+        np.testing.assert_allclose(np.asarray(w1), w1_ref, atol=5e-4)
+        assert np.isfinite(float(metrics["loss"]))
+
+    def test_ring_variant_matches_too(self, mesh42):
+        import dataclasses
+
+        from distlr_tpu.parallel.ring import make_ring_train_step
+
+        d = 16
+        cfg = Config(learning_rate=0.2, l2_c=0.0, num_feature_dim=d,
+                     feature_dtype="int8_dot", feature_shards=2)
+        model = dataclasses.replace(
+            BinaryLR(d, int8_dot=True), feature_scale=1.0 / 127.0)
+        rng = np.random.default_rng(4)
+        X = rng.integers(-127, 128, (32, d)).astype(np.int8)
+        y = rng.integers(0, 2, 32).astype(np.int32)
+        mask = np.ones(32, np.float32)
+        w0 = (0.1 * rng.standard_normal(d)).astype(np.float32)
+
+        step = make_ring_train_step(model, cfg, mesh42)
+        w1, _ = step(
+            shard_weights(jnp.asarray(w0), mesh42),
+            shard_batch_2d(
+                (jnp.asarray(X), jnp.asarray(y), jnp.asarray(mask)), mesh42))
+        g_ref = model.grad(
+            jnp.asarray(w0),
+            (jnp.asarray(X), jnp.asarray(y), jnp.asarray(mask)), cfg)
+        np.testing.assert_allclose(
+            np.asarray(w1), w0 - 0.2 * np.asarray(g_ref), atol=5e-4)
+
+
 class TestFeatureShardedSoftmax:
     def test_matches_unsharded_step(self, mesh42):
         cfg = Config(model="softmax", num_classes=3, num_feature_dim=16, learning_rate=0.1, l2_c=0.2)
