@@ -99,7 +99,9 @@ class Config:
     # for w and the residual) instead of converting the (B, D) tile to
     # bfloat16 — the convert is the measured wall (~165k samples/s at
     # D=1M); the native dot measured ~170k, 1.55x bf16
-    # (benchmarks/exp_int8_dot.py).  binary_lr only.
+    # (benchmarks/exp_int8_dot.py; the shipped unrolled-chunk form
+    # measured 271.5k on-chip, 1.64x bf16).  Dense models (binary_lr and
+    # softmax), single-device or feature-sharded; sparse/blocked reject.
     feature_dtype: str = "float32"    # float32 | bfloat16 | int8 | int8_dot
 
     # ---- parity / compat with reference quirks (SURVEY.md §3.5) ----
@@ -199,10 +201,13 @@ class Config:
                 "feature_dtype must be float32|bfloat16|int8|int8_dot, "
                 f"got {self.feature_dtype!r}"
             )
-        if self.feature_dtype == "int8_dot" and self.model != "binary_lr":
+        if self.feature_dtype == "int8_dot" and self.model not in (
+            "binary_lr", "softmax",
+        ):
             raise ValueError(
                 "feature_dtype='int8_dot' (native int8 MXU contraction) "
-                f"requires model='binary_lr'; got model={self.model!r}"
+                f"requires a dense model (binary_lr or softmax); "
+                f"got model={self.model!r}"
             )
         # (int8_dot + feature_shards > 1 is supported since r4: both the
         # psum and ring feature-sharded steps feed the native int8

@@ -62,7 +62,7 @@ def _add_config_flags(p: argparse.ArgumentParser) -> None:
                    "(int8: symmetric per-dataset quantization; halves/quarters "
                    "the HBM stream the dense step is bound by; int8_dot: "
                    "int8 storage plus the native int8 MXU contraction — "
-                   "skips the bf16 convert wall, binary_lr only)")
+                   "skips the bf16 convert wall; dense models only)")
     p.add_argument("--checkpoint-dir", dest="checkpoint_dir")
     p.add_argument("--checkpoint-interval", dest="checkpoint_interval", type=int)
     p.add_argument("--profile-dir", dest="profile_dir")

@@ -252,6 +252,8 @@ class SoftmaxRegression:
     num_classes: int
     compute_dtype: str = "bfloat16"
     feature_scale: float = 1.0  # see BinaryLR.feature_scale
+    int8_dot: bool = False      # see BinaryLR.int8_dot — same formulation,
+    #                             W (D, K) quantized on one global grid
 
     def init(self, cfg: Config) -> jnp.ndarray:
         shape = (self.num_features, self.num_classes)
@@ -262,6 +264,10 @@ class SoftmaxRegression:
         return jax.random.uniform(key, shape, dtype=jnp.float32)
 
     def logits(self, W, X):
+        if self.int8_dot:
+            Wq, s_w = quantize_sym(W, jnp.max(jnp.abs(W)))
+            z = _int8_contract(X, Wq, X.ndim - 1)  # (B, K)
+            return z * (s_w * self.feature_scale)
         cdt = jnp.dtype(self.compute_dtype)
         z = jnp.dot(
             X.astype(cdt),
@@ -286,6 +292,10 @@ class SoftmaxRegression:
         onehot = jax.nn.one_hot(y, self.num_classes, dtype=jnp.float32)
         resid = (p - onehot) * mask[:, None]
         n = jnp.maximum(jnp.sum(mask), 1).astype(jnp.float32)
+        if self.int8_dot:
+            rq, s_r = quantize_sym(resid, jnp.max(jnp.abs(resid)))
+            g = _int8_contract(X, rq, 0) * (s_r * self.feature_scale) / n
+            return g + _l2_grad(W, cfg, n)
         cdt = jnp.dtype(self.compute_dtype)
         g = (
             jnp.dot(
@@ -447,7 +457,9 @@ def get_model(cfg: Config):
         return BinaryLR(cfg.num_feature_dim, compute_dtype=cfg.compute_dtype,
                         int8_dot=cfg.feature_dtype == "int8_dot")
     if cfg.model == "softmax":
-        return SoftmaxRegression(cfg.num_feature_dim, cfg.num_classes, compute_dtype=cfg.compute_dtype)
+        return SoftmaxRegression(cfg.num_feature_dim, cfg.num_classes,
+                                 compute_dtype=cfg.compute_dtype,
+                                 int8_dot=cfg.feature_dtype == "int8_dot")
     if cfg.model == "sparse_lr":
         return SparseBinaryLR(cfg.num_feature_dim)
     if cfg.model == "blocked_lr":

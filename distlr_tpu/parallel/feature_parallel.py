@@ -82,8 +82,11 @@ def partial_logits(model, w_shard, X_shard):
     return z_partial
 
 
-def binary_resid_grad(model, resid, X_shard, n):
-    """resid^T @ X_shard / n for the binary model, int8_dot-aware.
+def resid_grad(model, resid, X_shard, n):
+    """Residual-times-features gradient term, int8_dot-aware.
+
+    ``resid (B,)`` (binary) gives ``resid @ X / n -> (D_shard,)``;
+    ``resid (B, K)`` (softmax) gives ``X^T @ resid / n -> (D_shard, K)``.
 
     Residuals are replicated along ``model`` (computed from the reduced
     logits), so a local max IS the model-axis global max; along ``data``
@@ -92,8 +95,13 @@ def binary_resid_grad(model, resid, X_shard, n):
     callers multiply it with their other scale factors)."""
     if getattr(model, "int8_dot", False):
         rq, s_r = quantize_sym(resid, jnp.max(jnp.abs(resid)))
+        if resid.ndim == 2:
+            return _int8_contract(X_shard, rq, 0) * s_r / n
         return _int8_contract(rq, X_shard, 0) * s_r / n
     cdt = jnp.dtype(model.compute_dtype)
+    if resid.ndim == 2:
+        return jnp.dot(X_shard.astype(cdt).T, resid.astype(cdt),
+                       preferred_element_type=jnp.float32) / n
     return jnp.dot(resid.astype(cdt), X_shard.astype(cdt),
                    preferred_element_type=jnp.float32) / n
 
@@ -117,15 +125,13 @@ def make_feature_sharded_train_step(model, cfg: Config, mesh: Mesh, *, with_metr
     def local_step(w, X, y, mask):
         n = jnp.maximum(jnp.sum(mask), 1).astype(jnp.float32)
         z = _local_forward(model, w, X)
-        cdt = jnp.dtype(model.compute_dtype)
         if is_softmax:
             p = jax.nn.softmax(z)
             onehot = jax.nn.one_hot(y, model.num_classes, dtype=jnp.float32)
             resid = (p - onehot) * mask[:, None]
-            g = jnp.dot(X.astype(cdt).T, resid.astype(cdt), preferred_element_type=jnp.float32) / n
         else:
             resid = (jax.nn.sigmoid(z) - y.astype(jnp.float32)) * mask
-            g = binary_resid_grad(model, resid, X, n)
+        g = resid_grad(model, resid, X, n)
         ll = _per_sample_logloss(z, y, is_softmax)
         if model.feature_scale != 1.0:  # d/dw of (X*scale) @ w
             g = g * model.feature_scale

@@ -33,7 +33,7 @@ from distlr_tpu.config import Config
 from distlr_tpu.models import BinaryLR
 from distlr_tpu.parallel.feature_parallel import (
     _check_mesh,
-    binary_resid_grad,
+    resid_grad,
     partial_logits,
 )
 from distlr_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS, shard_map
@@ -130,7 +130,7 @@ def make_ring_train_step(model, cfg: Config, mesh: Mesh, *, with_metrics: bool =
         # reduction differs (explicit ppermute ring vs XLA psum)
         z = ring_psum(partial_logits(model, w, X), MODEL_AXIS)
         resid = (jax.nn.sigmoid(z) - y.astype(jnp.float32)) * mask
-        g = binary_resid_grad(model, resid, X, n)
+        g = resid_grad(model, resid, X, n)
         if model.feature_scale != 1.0:  # d/dw of (X*scale) @ w
             g = g * model.feature_scale
         l2 = cfg.l2_c * w

@@ -32,6 +32,7 @@ def test_bench_configs_quick_writes_scratch_not_canonical():
     canonical = os.path.join(REPO, "BENCH_CONFIGS.json")
     scratch = os.path.join(REPO, "BENCH_CONFIGS_quick.json")
     before = open(canonical).read()
+    scratch_preexisted = os.path.exists(scratch)
     try:
         r = _run([sys.executable, "benchmarks/bench_configs.py", "--quick",
                   "--configs", "1,5"])
@@ -48,7 +49,9 @@ def test_bench_configs_quick_writes_scratch_not_canonical():
                       "samples_per_sec"):
             assert field in row5, row5
     finally:
-        if os.path.exists(scratch):
+        # clean up only what this test created — a developer's own quick
+        # results from before the run are not ours to delete
+        if not scratch_preexisted and os.path.exists(scratch):
             os.remove(scratch)
 
 

@@ -120,10 +120,12 @@ class TestInt8Dot:
         acc_q = tr.evaluate()
         assert abs(acc_f - acc_q) < 0.02, (acc_f, acc_q)
 
-    def test_rejected_outside_dense_binary_lr(self):
-        with pytest.raises(ValueError, match="binary_lr"):
-            Config(model="softmax", feature_dtype="int8_dot", num_classes=3)
-        with pytest.raises(ValueError, match="binary_lr"):
+    def test_rejected_outside_dense_models(self):
+        # softmax is allowed since r4 (same native int8 contraction on
+        # the (D, K) table); sparse/blocked stay float32-only
+        assert Config(model="softmax", feature_dtype="int8_dot",
+                      num_classes=3).feature_dtype == "int8_dot"
+        with pytest.raises(ValueError, match="dense model"):
             Config(model="sparse_lr", feature_dtype="int8_dot",
                    num_feature_dim=64)
         # feature-sharded int8_dot is supported since r4 (the sharded
@@ -276,3 +278,72 @@ class TestTrainerQuantized:
         cfg = Config(data_dir=data_dir, num_feature_dim=32, feature_dtype="int8")
         with pytest.raises(ValueError, match="feature_dtype"):
             PSWorker(cfg, 0, "127.0.0.1:1")
+
+
+class TestSoftmaxInt8Dot:
+    def test_tracks_float32_gradient_step(self):
+        """Softmax int8_dot step stays within quantization noise of the
+        float32 formulation on identical int8-stored features."""
+        import dataclasses
+
+        from distlr_tpu.models import SoftmaxRegression
+
+        d, k, b = 32, 5, 64
+        rng = np.random.default_rng(0)
+        X = rng.integers(-127, 128, (b, d)).astype(np.int8)
+        y = rng.integers(0, k, b).astype(np.int32)
+        mask = np.ones(b, np.float32)
+        W0 = (0.1 * rng.standard_normal((d, k))).astype(np.float32)
+        cfg = Config(num_feature_dim=d, num_classes=k, model="softmax",
+                     learning_rate=0.2, l2_c=0.0)
+        batch = (jnp.asarray(X), jnp.asarray(y), jnp.asarray(mask))
+
+        base = dataclasses.replace(
+            SoftmaxRegression(d, k), feature_scale=1.0 / 127.0)
+        quant = dataclasses.replace(base, int8_dot=True)
+        g_f = np.asarray(base.grad(jnp.asarray(W0), batch, cfg))
+        g_q = np.asarray(quant.grad(jnp.asarray(W0), batch, cfg))
+        assert np.max(np.abs(g_f - g_q)) < 5e-3, np.max(np.abs(g_f - g_q))
+        # prediction agreement on the same weights
+        agree = float(np.mean(np.asarray(base.predict(jnp.asarray(W0), batch[0]))
+                              == np.asarray(quant.predict(jnp.asarray(W0), batch[0]))))
+        assert agree > 0.9, agree
+
+    def test_feature_sharded_softmax_int8dot_matches(self):
+        """2D-mesh softmax int8_dot == single-device int8_dot step within
+        quantization noise (weight grid global via pmax; residual scale
+        per data shard)."""
+        import dataclasses
+
+        from distlr_tpu.models import SoftmaxRegression
+        from distlr_tpu.parallel import make_mesh
+        from distlr_tpu.parallel.feature_parallel import (
+            make_feature_sharded_train_step,
+            shard_batch_2d,
+            shard_weights,
+        )
+
+        d, k, b = 16, 4, 32
+        mesh = make_mesh({"data": 4, "model": 2})
+        rng = np.random.default_rng(1)
+        X = rng.integers(-127, 128, (b, d)).astype(np.int8)
+        y = rng.integers(0, k, b).astype(np.int32)
+        mask = np.ones(b, np.float32)
+        W0 = (0.1 * rng.standard_normal((d, k))).astype(np.float32)
+        cfg = Config(num_feature_dim=d, num_classes=k, model="softmax",
+                     learning_rate=0.2, l2_c=0.0,
+                     feature_dtype="int8_dot", feature_shards=2)
+        model = dataclasses.replace(
+            SoftmaxRegression(d, k, int8_dot=True), feature_scale=1.0 / 127.0)
+
+        step = make_feature_sharded_train_step(model, cfg, mesh)
+        W1, metrics = step(
+            shard_weights(jnp.asarray(W0), mesh),
+            shard_batch_2d(
+                (jnp.asarray(X), jnp.asarray(y), jnp.asarray(mask)), mesh))
+        g_ref = model.grad(
+            jnp.asarray(W0),
+            (jnp.asarray(X), jnp.asarray(y), jnp.asarray(mask)), cfg)
+        W1_ref = W0 - 0.2 * np.asarray(g_ref)
+        np.testing.assert_allclose(np.asarray(W1), W1_ref, atol=5e-4)
+        assert np.isfinite(float(metrics["loss"]))
