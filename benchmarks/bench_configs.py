@@ -406,6 +406,22 @@ def bench_config_5(quick: bool) -> dict:
     step = _scan_step(model, cfg)
     W = jnp.zeros((d, k), jnp.float32)
     sps = _steady_state_sps(step, W, batch, steps, n)
+
+    # int8_dot variant (r4: the native int8 MXU contraction covers the
+    # softmax family too): int8-resident X, same step protocol
+    import dataclasses
+
+    scale = float(np.abs(X[n_te:]).max()) / 127.0
+    Xq = np.clip(np.rint(X[n_te:] / scale), -127, 127).astype(np.int8)
+    model_q = dataclasses.replace(
+        SoftmaxRegression(d, k, int8_dot=True), feature_scale=scale)
+    cfg_q = Config(num_feature_dim=d, num_classes=k, model="softmax",
+                   learning_rate=0.3, l2_c=0.0, feature_dtype="int8_dot")
+    batch_q = (jnp.asarray(Xq), batch[1], batch[2])
+    sps_q = _steady_state_sps(_scan_step(model_q, cfg_q),
+                              jnp.zeros((d, k), jnp.float32),
+                              batch_q, steps, n)
+
     for _ in range(60):
         W = step(W, batch)
     acc = float(model.accuracy(W, tbatch))
@@ -419,6 +435,7 @@ def bench_config_5(quick: bool) -> dict:
         "config": 5,
         "name": "multinomial softmax regression, D=784 K=10 (MNIST-shaped)",
         "samples_per_sec": round(sps, 1),
+        "int8_dot_samples_per_sec": round(sps_q, 1),
         "accuracy": round(acc, 4),
         "test_logloss": round(test_ll, 5),
         "converged_accuracy": round(conv_acc, 4),
