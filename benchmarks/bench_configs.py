@@ -411,12 +411,15 @@ def bench_config_5(quick: bool) -> dict:
     # softmax family too): int8-resident X, same step protocol
     import dataclasses
 
+    from distlr_tpu.models import get_model
+
     scale = float(np.abs(X[n_te:]).max()) / 127.0
     Xq = np.clip(np.rint(X[n_te:] / scale), -127, 127).astype(np.int8)
-    model_q = dataclasses.replace(
-        SoftmaxRegression(d, k, int8_dot=True), feature_scale=scale)
     cfg_q = Config(num_feature_dim=d, num_classes=k, model="softmax",
                    learning_rate=0.3, l2_c=0.0, feature_dtype="int8_dot")
+    # via get_model so int8_dot/compute_dtype derive from the Config
+    # exactly as the Trainer builds it (same pattern as config 3)
+    model_q = dataclasses.replace(get_model(cfg_q), feature_scale=scale)
     batch_q = (jnp.asarray(Xq), batch[1], batch[2])
     sps_q = _steady_state_sps(_scan_step(model_q, cfg_q),
                               jnp.zeros((d, k), jnp.float32),

@@ -18,14 +18,17 @@
 // a no-op.
 
 #include <arpa/inet.h>
+#include <fcntl.h>
 #include <netinet/in.h>
 #include <netinet/tcp.h>
+#include <poll.h>
 #include <sys/socket.h>
 #include <sys/time.h>
 #include <unistd.h>
 
 #include <algorithm>
 #include <cerrno>
+#include <chrono>
 #include <cstring>
 #include <cstdio>
 #include <cstdlib>
@@ -86,7 +89,7 @@ bool WriteFull(int fd, const void* buf, size_t n) {
   return true;
 }
 
-int ConnectTo(const std::string& host, int port) {
+int ConnectTo(const std::string& host, int port, int timeout_ms) {
   int fd = socket(AF_INET, SOCK_STREAM, 0);
   if (fd < 0) return -1;
   sockaddr_in addr{};
@@ -96,10 +99,45 @@ int ConnectTo(const std::string& host, int port) {
     close(fd);
     return -1;
   }
+  // Bounded-wait connect: a blocking connect to an unreachable host (a
+  // DCN partition, a firewalled server box) stalls for the kernel's
+  // SYN-retry window — minutes — freezing supervisor probes and worker
+  // restarts.  A dead-but-reachable host still fails fast (RST).
+  const int flags = fcntl(fd, F_GETFL, 0);
+  fcntl(fd, F_SETFL, flags | O_NONBLOCK);
   if (connect(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) < 0) {
-    close(fd);
-    return -1;
+    if (errno != EINPROGRESS) {
+      close(fd);
+      return -1;
+    }
+    pollfd p{};
+    p.fd = fd;
+    p.events = POLLOUT;
+    // EINTR must not read as "unreachable": retry with the remaining
+    // budget (a SIGPROF/SIGTERM during the wait would otherwise fail a
+    // perfectly live connect).
+    const auto deadline =
+        std::chrono::steady_clock::now() + std::chrono::milliseconds(timeout_ms);
+    int pr;
+    for (;;) {
+      const auto left = std::chrono::duration_cast<std::chrono::milliseconds>(
+          deadline - std::chrono::steady_clock::now()).count();
+      if (left <= 0) { pr = 0; break; }
+      pr = poll(&p, 1, static_cast<int>(left));
+      if (pr >= 0 || errno != EINTR) break;
+    }
+    if (pr <= 0) {
+      close(fd);
+      return -1;
+    }
+    int err = 0;
+    socklen_t len = sizeof(err);
+    if (getsockopt(fd, SOL_SOCKET, SO_ERROR, &err, &len) < 0 || err != 0) {
+      close(fd);
+      return -1;
+    }
   }
+  fcntl(fd, F_SETFL, flags);  // back to blocking for the RPC path
   int one = 1;
   setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
   return fd;
@@ -249,13 +287,24 @@ void* kv_connect(const char* hosts, uint64_t dim, uint32_t client_id) {
     parts.push_back(spec.substr(pos, comma == std::string::npos ? comma : comma - pos));
     pos = comma == std::string::npos ? comma : comma + 1;
   }
+  // Default connect timeout 10s (DISTLR_CONNECT_TIMEOUT_MS overrides):
+  // long enough for a loaded-but-alive server host, short enough that a
+  // partitioned one fails the op instead of freezing its caller.
+  // Unparseable or non-positive values fall back to the default — 0
+  // would fail every non-synchronous connect, negative would silently
+  // restore the unbounded wait this knob exists to remove.
+  int connect_timeout_ms = 10000;
+  if (const char* e = std::getenv("DISTLR_CONNECT_TIMEOUT_MS")) {
+    const int v = std::atoi(e);
+    if (v > 0) connect_timeout_ms = v;
+  }
   const size_t S = parts.size();
   for (size_t s = 0; s < S; ++s) {
     size_t colon = parts[s].rfind(':');
     if (colon == std::string::npos) { delete c; return nullptr; }
     const std::string host = parts[s].substr(0, colon);
     const int port = std::atoi(parts[s].c_str() + colon + 1);
-    int fd = distlr::ConnectTo(host, port);
+    int fd = distlr::ConnectTo(host, port, connect_timeout_ms);
     if (fd < 0) {
       for (auto& sc : c->servers) close(sc.fd);
       delete c;

@@ -868,3 +868,32 @@ class TestWireCorruption:
             if proc.poll() is None:
                 proc.kill()
                 proc.wait()
+
+
+class TestConnectTimeout:
+    def test_unresponsive_host_fails_fast(self, monkeypatch):
+        """kv_connect to a host that drops SYNs must fail within the
+        bounded connect timeout, not the kernel's minutes-long SYN-retry
+        window (a DCN partition would otherwise freeze supervisor probes
+        and worker restarts mid-op).  Reproduced locally by saturating a
+        backlog-0 listener's accept queue: the kernel then silently
+        drops further SYNs — exactly the partitioned-host picture."""
+        import socket
+
+        monkeypatch.setenv("DISTLR_CONNECT_TIMEOUT_MS", "400")
+        lst = socket.socket()
+        try:
+            lst.bind(("127.0.0.1", 0))
+            lst.listen(0)
+            host, port = lst.getsockname()
+            saturate = socket.create_connection((host, port))
+            try:
+                t0 = time.monotonic()
+                with pytest.raises(ConnectionError):
+                    KVWorker(f"{host}:{port}", 8, timeout_ms=1000,
+                             sync_group=False)
+                assert time.monotonic() - t0 < 5.0
+            finally:
+                saturate.close()
+        finally:
+            lst.close()
