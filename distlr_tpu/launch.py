@@ -245,6 +245,27 @@ def cmd_sync(args: argparse.Namespace) -> int:
     return 0
 
 
+def cmd_eval(args: argparse.Namespace) -> int:
+    """Score a saved text model against a data dir's test split — the
+    load path the reference never had (its SaveModel output,
+    ``src/lr.cc:73-82``, was write-only; this reads that exact format)."""
+    _maybe_force_cpu_devices(args)
+    from distlr_tpu.train import Trainer  # noqa: PLC0415
+    from distlr_tpu.train.export import load_model_text  # noqa: PLC0415
+
+    cfg = _resolve_auto_block(_config_from_args(args))
+    trainer = Trainer(cfg).load_data(
+        # quantized dtypes derive their scale from the train split; the
+        # default float32 path skips the (dominant) train ingest
+        test_only=cfg.feature_dtype == "float32",
+    )
+    w = load_model_text(args.model_file, shape=trainer.model.param_shape)
+    trainer.weights = trainer._shard_weights(w)
+    m = trainer.evaluate_metrics()
+    print(f"accuracy: {m['accuracy']:.4f}  test_logloss: {m['logloss']:.5f}")
+    return 0
+
+
 def cmd_ps(args: argparse.Namespace) -> int:
     _maybe_force_cpu_devices(args)
     from distlr_tpu.train.ps_trainer import run_ps_local, run_ps_workers  # noqa: PLC0415
@@ -351,6 +372,13 @@ def main(argv=None) -> int:
     s = sub.add_parser("sync", help="synchronous SPMD training (one process)")
     _add_config_flags(s)
     s.set_defaults(fn=cmd_sync)
+
+    e = sub.add_parser("eval", help="score a saved text model on the test split")
+    _add_config_flags(e)
+    e.add_argument("--model-file", dest="model_file", required=True,
+                   help="text model file (the reference SaveModel format; "
+                        "what sync/ps runs write to models/part-00N)")
+    e.set_defaults(fn=cmd_eval)
 
     p = sub.add_parser("ps", help="parameter-server training (native KV servers)")
     _add_config_flags(p)

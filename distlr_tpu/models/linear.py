@@ -168,6 +168,10 @@ class BinaryLR:
     # be int8 (the trainer's feature quantization guarantees it).
     int8_dot: bool = False
 
+    @property
+    def param_shape(self) -> tuple[int, ...]:
+        return (self.num_features,)
+
     def init(self, cfg: Config) -> jnp.ndarray:
         if cfg.reference_rng_init:
             # Q2 parity: srand(seed); rand()/RAND_MAX per weight.
@@ -255,6 +259,10 @@ class SoftmaxRegression:
     int8_dot: bool = False      # see BinaryLR.int8_dot — same formulation,
     #                             W (D, K) quantized on one global grid
 
+    @property
+    def param_shape(self) -> tuple[int, ...]:
+        return (self.num_features, self.num_classes)
+
     def init(self, cfg: Config) -> jnp.ndarray:
         shape = (self.num_features, self.num_classes)
         if cfg.reference_rng_init:
@@ -338,6 +346,10 @@ class SparseBinaryLR:
 
     num_features: int
 
+    @property
+    def param_shape(self) -> tuple[int, ...]:
+        return (self.num_features,)
+
     def init(self, cfg: Config) -> jnp.ndarray:
         if cfg.reference_rng_init:
             return jnp.asarray(reference_init_weights(self.num_features, 0))
@@ -406,6 +418,10 @@ class BlockedSparseLR:
 
     num_blocks: int
     block_size: int = 8
+
+    @property
+    def param_shape(self) -> tuple[int, ...]:
+        return (self.num_blocks, self.block_size)
 
     def init(self, cfg: Config) -> jnp.ndarray:
         # Zeros for the same reason SparseBinaryLR uses them: untrained

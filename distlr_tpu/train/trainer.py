@@ -418,16 +418,37 @@ class Trainer:
         self._build_steps()
 
     # -- data ---------------------------------------------------------------
-    def load_data(self, train: GlobalShardedData | None = None, test: GlobalShardedData | None = None):
+    def load_data(self, train: GlobalShardedData | None = None, test: GlobalShardedData | None = None, *, test_only: bool = False):
+        """Load the data dir's splits.  ``test_only=True`` skips the
+        train split entirely (eval-only workflows: the train ingest is
+        the dominant I/O cost and evaluate_metrics never touches it) —
+        float32 features only, since quantized dtypes derive their scale
+        from the train split."""
+        if test_only:
+            if train is not None:
+                raise ValueError("test_only=True contradicts passing train data")
+            if self.cfg.feature_dtype != "float32":
+                raise ValueError(
+                    "test_only loading requires feature_dtype='float32' "
+                    "(quantization scales come from the train split)"
+                )
         W = num_data_shards(self.mesh)
         multiclass = self.cfg.model == "softmax"
         sparse = self.cfg.model == "sparse_lr"
         if self.cfg.model == "blocked_lr":
+            self._test_data = test or GlobalShardedData.from_raw_ctr_dir(
+                self.cfg.data_dir, "test", W, self.cfg
+            )
+            if test_only:
+                return self
             self._train_data = train or GlobalShardedData.from_raw_ctr_dir(
                 self.cfg.data_dir, "train", W, self.cfg
             )
-            self._test_data = test or GlobalShardedData.from_raw_ctr_dir(
-                self.cfg.data_dir, "test", W, self.cfg
+            return self
+        if test_only:
+            self._test_data = test or GlobalShardedData.from_data_dir(
+                self.cfg.data_dir, "test", W, self.cfg.num_feature_dim,
+                multiclass=multiclass, sparse=sparse, nnz_max=self.cfg.nnz_max,
             )
             return self
         self._train_data = train or GlobalShardedData.from_data_dir(

@@ -880,6 +880,9 @@ class TestConnectTimeout:
         drops further SYNs — exactly the partitioned-host picture."""
         import socket
 
+        from distlr_tpu.ps.build import build_native
+
+        build_native()  # keep a cold-start compile out of the timing window
         monkeypatch.setenv("DISTLR_CONNECT_TIMEOUT_MS", "400")
         lst = socket.socket()
         try:

@@ -426,3 +426,67 @@ class TestGoldenModelFormat:
         path = tr.save_model()
         raw = open(path).read().split("\n")
         assert raw[0] == "32" and raw[1].endswith(" ")
+
+
+class TestEvalSubcommand:
+    def test_eval_reproduces_training_eval(self, tmp_path):
+        """launch eval scores a saved text model identically to the
+        trainer's own final evaluate() — the model-file round trip
+        (reference SaveModel format) loses nothing."""
+        import contextlib
+        import io
+
+        from distlr_tpu import launch
+
+        d = str(tmp_path / "data")
+        assert launch.main([
+            "gen-data", "--data-dir", d, "--num-samples", "1500",
+            "--num-feature-dim", "24", "--num-parts", "1", "--seed", "3",
+        ]) == 0
+        assert launch.main([
+            "sync", "--data-dir", d, "--num-feature-dim", "24",
+            "--num-iteration", "15", "--test-interval", "0",
+            "--learning-rate", "0.5", "--l2-c", "0",
+        ]) == 0
+        out = io.StringIO()
+        with contextlib.redirect_stdout(out):
+            assert launch.main([
+                "eval", "--data-dir", d, "--num-feature-dim", "24",
+                "--model-file", f"{d}/models/part-001",
+            ]) == 0
+        line = out.getvalue().strip()
+        assert line.startswith("accuracy: ") and "test_logloss: " in line
+        # compare against an in-process evaluate of the same weights
+        import numpy as np
+
+        from distlr_tpu import Config
+        from distlr_tpu.train import Trainer
+        from distlr_tpu.train.export import load_model_text
+
+        cfg = Config(data_dir=d, num_feature_dim=24, test_interval=0)
+        tr = Trainer(cfg).load_data()
+        tr.weights = tr._shard_weights(load_model_text(f"{d}/models/part-001"))
+        want = tr.evaluate_metrics()
+        acc = float(line.split()[1])
+        assert abs(acc - want["accuracy"]) < 1e-4
+
+    def test_eval_softmax_shape(self, tmp_path):
+        from distlr_tpu import launch
+
+        d = str(tmp_path / "mc")
+        assert launch.main([
+            "gen-data", "--data-dir", d, "--num-samples", "1500",
+            "--num-feature-dim", "24", "--num-classes", "4",
+            "--num-parts", "1", "--seed", "4",
+        ]) == 0
+        assert launch.main([
+            "sync", "--data-dir", d, "--model", "softmax",
+            "--num-classes", "4", "--num-feature-dim", "24",
+            "--num-iteration", "10", "--test-interval", "0",
+            "--learning-rate", "0.3", "--l2-c", "0",
+        ]) == 0
+        assert launch.main([
+            "eval", "--data-dir", d, "--model", "softmax",
+            "--num-classes", "4", "--num-feature-dim", "24",
+            "--model-file", f"{d}/models/part-001",
+        ]) == 0
