@@ -112,3 +112,31 @@ class TestTrainerResume:
 
         assert t_resumed.shape == (256, 4)  # table, not flat vector
         np.testing.assert_allclose(t_resumed, t_full, atol=1e-5)
+
+    def test_ps_blocked_resume_matches_straight_run(self, tmp_path):
+        """PS-mode resume for the blocked family (keyed rows over the
+        KV plane): interrupted-then-resumed equals straight-through,
+        same as the dense PS resume identity."""
+        from distlr_tpu.data.hashing import write_raw_ctr_shards
+        from distlr_tpu.train.ps_trainer import run_ps_local
+
+        d = str(tmp_path / "psraw")
+        write_raw_ctr_shards(d, 1200, 6, 4, 2, seed=13)
+        common = dict(
+            data_dir=d, num_feature_dim=512, model="blocked_lr",
+            block_size=4, learning_rate=0.5, l2_c=0.0, test_interval=0,
+            num_workers=2, num_servers=2, batch_size=-1, sync_mode=True,
+            checkpoint_interval=2,
+        )
+        ck1 = str(tmp_path / "ps_full")
+        straight = run_ps_local(
+            Config(num_iteration=6, checkpoint_dir=ck1, **common), save=False)
+
+        ck2 = str(tmp_path / "ps_resume")
+        run_ps_local(Config(num_iteration=3, checkpoint_dir=ck2, **common),
+                     save=False)
+        resumed = run_ps_local(
+            Config(num_iteration=6, checkpoint_dir=ck2, **common),
+            save=False, resume=True)
+        np.testing.assert_allclose(resumed[0], straight[0],
+                                   rtol=1e-5, atol=1e-6)
