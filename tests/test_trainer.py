@@ -490,3 +490,24 @@ class TestEvalSubcommand:
             "--num-classes", "4", "--num-feature-dim", "24",
             "--model-file", f"{d}/models/part-001",
         ]) == 0
+
+    def test_eval_blocked_family(self, tmp_path):
+        """eval round-trips the blocked table ((rows, R) via param_shape)
+        from raw-CTR shards."""
+        from distlr_tpu import launch
+
+        d = str(tmp_path / "bl")
+        assert launch.main([
+            "gen-data", "--data-dir", d, "--num-samples", "2000",
+            "--ctr-fields", "6", "--ctr-vocab", "4", "--ctr-raw",
+            "--num-parts", "1", "--seed", "6",
+        ]) == 0
+        common = ["--data-dir", d, "--model", "blocked_lr",
+                  "--num-feature-dim", "1024", "--block-size", "4"]
+        assert launch.main([
+            "sync", *common, "--num-iteration", "8", "--test-interval", "0",
+            "--learning-rate", "0.5", "--l2-c", "0",
+        ]) == 0
+        assert launch.main([
+            "eval", *common, "--model-file", f"{d}/models/part-001",
+        ]) == 0
