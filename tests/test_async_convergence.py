@@ -38,23 +38,45 @@ def _logloss(data_dir: str, w) -> float:
     return float(np.mean(np.logaddexp(0.0, z) - y * z))
 
 
-def _run(data_dir: str, sync: bool) -> float:
+def _run(data_dir: str, sync: bool, pipeline: bool = True) -> float:
     cfg = Config(
         data_dir=data_dir, num_feature_dim=D, num_iteration=EPOCHS,
         learning_rate=0.5, l2_c=0.0, test_interval=0, batch_size=128,
         sync_mode=sync, num_workers=WORKERS, num_servers=2,
-        ps_timeout_ms=30_000,
+        ps_timeout_ms=30_000, ps_pipeline=pipeline,
     )
     weights = run_ps_local(cfg)
     return _logloss(data_dir, weights[0])
 
 
-def test_async_logloss_lands_in_sync_band(data_dir):
+@pytest.fixture(scope="module")
+def sync_ll(data_dir):
+    # One sync anchor serves both async parametrizations: the sync BSP
+    # trajectory is bit-identical whether the fused push_pull pipeline
+    # or the serialized two-round-trip protocol carries it (pinned by
+    # the oracle parity tests in test_ps.py), so either setting yields
+    # the same anchor.
+    return _run(data_dir, sync=True)
+
+
+@pytest.mark.parametrize("pipeline", [True, False],
+                         ids=["pipelined", "serialized"])
+def test_async_logloss_lands_in_sync_band(data_dir, sync_ll, pipeline):
+    """Band holds for BOTH async protocols (VERDICT r4 #7).
+
+    ``pipelined`` (default): fused push_pull double-buffered against
+    compute — weights stale by exactly the one in-flight push.
+    ``serialized``: reference-faithful two blocking round trips per
+    batch (``src/lr.cc:116-132``) — staleness only from cross-worker
+    interleaving.  The staleness distributions differ, so each needs
+    its own statistical assertion.
+    """
     # anchor at the loss of the ACTUAL initial weights every worker
     # computes (uniform [0,1) — far from the optimum by construction)
     init_ll = _logloss(data_dir, np.asarray(_MODEL.init(_CFG0)).reshape(-1))
-    sync_ll = _run(data_dir, sync=True)
-    async_lls = [_run(data_dir, sync=False) for _ in range(3)]
+    async_lls = [
+        _run(data_dir, sync=False, pipeline=pipeline) for _ in range(3)
+    ]
 
     # both modes make real progress from the shared init
     # (measured: init ~1.56, sync ~0.49, async ~0.53 on this fixture)
