@@ -4,15 +4,32 @@
 # it), so every live window should bank all driver-facing artifacts:
 #
 #   1. bench.py            -> benchmarks/LAST_TPU.json  (the LKG row the
-#                             CPU-fallback bench carries)
-#   2. bench_configs.py    -> BENCH_CONFIGS.json        (all 5 configs,
+#                             CPU-fallback bench carries, now with the
+#                             quality-valid headline)
+#   2. bench_configs.py    -> BENCH_CONFIGS.json        (all 6 configs,
 #      --isolate              one subprocess per config: HBM released
-#                             between configs; aborts without partial writes)
+#                             between configs; aborts without partial
+#                             writes) + benchmarks/FRONTIER_TPU.json
+#                             (refreshed automatically from config 4,
+#                             incl. the operating-point load sweep),
+#                             then bench.py --requality-lkg immediately
+#                             re-derives LAST_TPU.json's quality gate
+#                             from that fresh frontier so the window's
+#                             artifacts agree with EACH OTHER
+#   3. exp_blocked_batch   -> benchmarks/BLOCKED_BATCH_TPU.json (B sweep
+#                             + G-variant rates — the R=16 north-star
+#                             lever); best-effort so a late wedge can't
+#                             strand the consistency steps behind it
+#   4. update_roofline.py  -> ROOFLINE.md auto-capture section
+#   5. best-effort logs    -> benchmarks/capture_logs/*.log (pallas +
+#                             streaming re-measures; these refresh the
+#                             platform-capped numbers when real hardware
+#                             replaces the tunnel)
 #
-# Each step prints its tail; the script stops at the first failure so a
-# half-wedged tunnel can't burn the whole window. Nothing else should
-# touch the TPU while this runs (concurrent probes push subprocesses
-# onto their CPU fallbacks).
+# Steps 1-2 and 4 stop at the first failure so a half-wedged tunnel
+# can't burn the whole window; 3 and 5 are best-effort (failures
+# logged, not fatal). Nothing else should touch the TPU while this runs
+# (concurrent probes push subprocesses onto their CPU fallbacks).
 set -e
 cd "$(dirname "$0")/.."
 
@@ -22,8 +39,29 @@ timeout 90 python -c "import jax, jax.numpy as j; print('tpu ok', float(j.ones((
 echo "== bench.py (headline + sub-rates, median-of-3 windows) =="
 timeout 1200 python bench.py
 
-echo "== bench_configs.py --isolate (all 5 configs) =="
-timeout 3600 python -u benchmarks/bench_configs.py --isolate
+echo "== bench_configs.py --isolate (all 6 configs + frontier refresh) =="
+timeout 5400 python -u benchmarks/bench_configs.py --isolate
+
+echo "== re-derive LKG quality gate from the fresh frontier =="
+python bench.py --requality-lkg
+
+echo "== exp_blocked_batch.py (B sweep + G variants; best-effort) =="
+timeout 1800 python -u benchmarks/exp_blocked_batch.py \
+  || echo "exp_blocked_batch failed (non-fatal; artifact not refreshed)"
+
+echo "== update ROOFLINE.md auto-capture section =="
+python benchmarks/update_roofline.py
+
+echo "== best-effort: pallas + streaming re-measures -> capture_logs/ =="
+mkdir -p benchmarks/capture_logs
+timeout 1200 python -u benchmarks/exp_gen_roofline2.py \
+  > benchmarks/capture_logs/pallas.log 2>&1 \
+  && echo "pallas ok" || echo "pallas re-measure failed (non-fatal)"
+timeout 1800 python -u benchmarks/exp_stream.py \
+  > benchmarks/capture_logs/stream.log 2>&1 \
+  && echo "stream ok" || echo "stream re-measure failed (non-fatal)"
 
 echo "== done; review git status and commit the artifacts =="
-git status --short BENCH_CONFIGS.json benchmarks/LAST_TPU.json
+git status --short BENCH_CONFIGS.json benchmarks/LAST_TPU.json \
+  benchmarks/FRONTIER_TPU.json benchmarks/BLOCKED_BATCH_TPU.json \
+  benchmarks/ROOFLINE.md

@@ -6,16 +6,25 @@ throughput amortizes fixed per-step dispatch/launch cost — so larger B
 may still raise the R=16/R=32 rates toward the gather ceiling, and
 smaller B would show where dispatch overhead starts to dominate.
 
-Sweeps B in {16k, 32k, 64k, 128k, 256k} for R in {16, 32} at config-4
-shape (D=1M, 21 fields), device-resident batches, donated weights,
-median of 3 windows.
+Sweeps B in {16k, 32k, 64k, 128k, 256k} for R in {8, 16, 32} at
+config-4 shape (D=1M, 21 fields), device-resident batches, donated
+weights, median of 3 windows.  Also measures the G-group R=32 variants
+(2-3 conjunction groups of ~7-11 fields each, padded to 32 lanes) that
+the operating-point quality sweep (bench_configs._operating_point_sweep)
+evaluates — if one of those is the quality-valid configuration, its
+rate must exist too.
+
+Writes ``benchmarks/BLOCKED_BATCH_TPU.json`` when run on an accelerator
+(never from a CPU fallback — the artifact is on-chip evidence).
 
 Run on the real chip: python benchmarks/exp_blocked_batch.py
 """
 
 from __future__ import annotations
 
+import datetime
 import functools
+import json
 import os
 import sys
 import time
@@ -35,12 +44,18 @@ D, FIELDS, STEPS = 1_000_000, 21, 20
 LR = 0.5
 
 
-def rate(r: int, b: int) -> float:
+def rate(r: int, b: int, g_count: int | None = None) -> float:
     nb = D // r
     cfg = Config(num_feature_dim=D, model="blocked_lr", block_size=r, l2_c=0.0)
     model = BlockedSparseLR(nb, r)
     rng = np.random.default_rng(0)
-    blocks, lane_vals = make_uniform_blocked_batch(rng, b, FIELDS, nb, r)
+    if g_count is None:
+        blocks, lane_vals = make_uniform_blocked_batch(rng, b, FIELDS, nb, r)
+    else:
+        # G-group variant layout: G row ids per sample, all lanes live
+        # (rate depends on gather count and shapes, not lane contents)
+        blocks = rng.integers(0, nb, size=(b, g_count)).astype(np.int32)
+        lane_vals = np.ones((b, g_count, r), np.float32)
     batch = (jnp.asarray(blocks), jnp.asarray(lane_vals),
              jnp.asarray(rng.integers(0, 2, b), jnp.int32),
              jnp.ones(b, jnp.float32))
@@ -64,13 +79,53 @@ def rate(r: int, b: int) -> float:
 
 
 def main():
-    print(f"backend={jax.default_backend()} D={D} fields={FIELDS} "
+    backend = jax.default_backend()
+    print(f"backend={backend} D={D} fields={FIELDS} "
           f"steps={STEPS} (median of 3 windows)")
-    for r in (16, 32):
-        row = []
-        for b in (1 << 14, 1 << 15, 1 << 16, 1 << 17, 1 << 18):
-            row.append(f"B={b:>6}: {rate(r, b)/1e6:6.2f} M/s")
-        print(f"R={r:2d}  " + "   ".join(row))
+    b_values = (1 << 14, 1 << 15, 1 << 16, 1 << 17, 1 << 18)
+    sweep: dict[str, dict[str, float]] = {}
+    for r in (8, 16, 32):
+        row = {}
+        parts = []
+        for b in b_values:
+            v = rate(r, b)
+            row[str(b)] = round(v, 1)
+            parts.append(f"B={b:>6}: {v / 1e6:6.2f} M/s")
+        sweep[f"r{r}"] = row
+        print(f"R={r:2d}  " + "   ".join(parts))
+    # G-group R=32 variants at the two largest batch sizes
+    variants: dict[str, dict[str, float]] = {}
+    for g in (2, 3):
+        row = {}
+        parts = []
+        for b in (1 << 16, 1 << 17):
+            v = rate(32, b, g_count=g)
+            row[str(b)] = round(v, 1)
+            parts.append(f"B={b:>6}: {v / 1e6:6.2f} M/s")
+        variants[f"r32_g{g}"] = row
+        print(f"R=32 G={g}  " + "   ".join(parts))
+    best = {
+        k: max(v.values()) for k, v in {**sweep, **variants}.items()
+    }
+    print("best per config:",
+          {k: f"{v / 1e6:.2f}M" for k, v in best.items()})
+    if backend != "cpu":
+        art = {
+            "what": ("blocked batch-size sweep + G-variant rates, "
+                     "on-chip (exp_blocked_batch.py)"),
+            "backend": backend,
+            "timestamp": datetime.datetime.now(datetime.timezone.utc)
+            .isoformat(timespec="seconds"),
+            "shapes": {"D": D, "fields": FIELDS, "steps": STEPS,
+                       "B_values": list(b_values)},
+            "samples_per_sec": sweep,
+            "g_variants": variants,
+            "best_samples_per_sec": best,
+        }
+        out = os.path.join(HERE, "BLOCKED_BATCH_TPU.json")
+        with open(out, "w") as f:
+            json.dump(art, f, indent=1)
+        print(f"wrote {out}")
 
 
 if __name__ == "__main__":

@@ -1,0 +1,122 @@
+"""Inject the latest on-chip capture into ROOFLINE.md (VERDICT r4 #6).
+
+Reads the machine-written artifacts a `capture_all_tpu.sh` run refreshes
+(``LAST_TPU.json``, ``FRONTIER_TPU.json``, ``BLOCKED_BATCH_TPU.json``)
+and rewrites the auto-generated section of ``ROOFLINE.md`` between the
+``<!-- AUTO-CAPTURE .. -->`` markers — so the document's headline
+numbers update from script output, not by hand.  Prose sections above
+the markers stay human-owned.
+
+Run (normally via capture_all_tpu.sh): python benchmarks/update_roofline.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+ROOFLINE = os.path.join(HERE, "ROOFLINE.md")
+BEGIN = "<!-- AUTO-CAPTURE BEGIN (update_roofline.py; do not edit by hand) -->"
+END = "<!-- AUTO-CAPTURE END -->"
+
+
+def _load(name: str) -> dict | None:
+    try:
+        with open(os.path.join(HERE, name)) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def _fmt_m(v) -> str:
+    return f"{v / 1e6:.2f}M" if isinstance(v, (int, float)) else "—"
+
+
+def _fmt_n(v) -> str:
+    return f"{v:,.0f}" if isinstance(v, (int, float)) else "—"
+
+
+def render() -> str:
+    lines = [BEGIN, "", "## Latest on-chip capture (auto-generated)", ""]
+    lkg = _load("LAST_TPU.json")
+    if lkg:
+        lines += [
+            f"`LAST_TPU.json` — {lkg.get('timestamp', '?')} at rev "
+            f"`{lkg.get('git_rev', '?')}`, backend {lkg.get('backend')}:",
+            "",
+            f"- dense bf16 headline: **{_fmt_n(lkg.get('value'))} samples/s** "
+            f"(D={lkg.get('D')}, B={lkg.get('B')})",
+            f"- dense int8_dot: "
+            f"{_fmt_n(lkg.get('dense_int8dot_samples_per_sec'))} samples/s",
+            f"- sparse scalar: {_fmt_m(lkg.get('sparse_samples_per_sec'))}",
+            f"- blocked R=8/16/32: "
+            f"{_fmt_m(lkg.get('blocked_r8_samples_per_sec'))} / "
+            f"{_fmt_m(lkg.get('blocked_r16_samples_per_sec'))} / "
+            f"{_fmt_m(lkg.get('blocked_r32_samples_per_sec'))}",
+            f"- best (quality-blind): "
+            f"{_fmt_m(lkg.get('best_samples_per_sec'))}; "
+            f"best quality-valid: "
+            f"{_fmt_m(lkg.get('best_quality_valid_samples_per_sec'))} "
+            f"(valid Rs per frontier: "
+            f"{lkg.get('quality_frontier_valid_rs', '?')})",
+            "",
+        ]
+    fr = _load("FRONTIER_TPU.json")
+    if fr:
+        frontier = fr.get("frontier", {})
+        lines += [f"`FRONTIER_TPU.json` — {fr.get('timestamp', '?')}, "
+                  f"backend {fr.get('backend')}:", ""]
+        for regime, row in frontier.items():
+            if regime == "operating_point" or not isinstance(row, dict):
+                continue
+            best = row.get("largest_r_within_1pt")
+            lines.append(f"- {regime}: largest R within 1pt of scalar = "
+                         f"**{best}**")
+        op = frontier.get("operating_point")
+        if isinstance(op, dict):
+            lines.append(
+                f"- operating point (dc={op.get('at_dc')}): "
+                f"default-grouping Rs within 1pt = "
+                f"**{op.get('valid_default_rs')}**, variants = "
+                f"{op.get('valid_variants')}")
+        lines.append("")
+    bb = _load("BLOCKED_BATCH_TPU.json")
+    if bb:
+        best = bb.get("best_samples_per_sec", {})
+        lines += [
+            f"`BLOCKED_BATCH_TPU.json` — {bb.get('timestamp', '?')}, "
+            f"backend {bb.get('backend')}: best rate over the B sweep: "
+            + ", ".join(f"{k}={_fmt_m(v)}" for k, v in best.items()),
+            "",
+        ]
+    if len(lines) == 4:
+        lines.append("(no on-chip artifacts found)")
+        lines.append("")
+    lines.append(END)
+    return "\n".join(lines)
+
+
+def main() -> int:
+    with open(ROOFLINE) as f:
+        doc = f.read()
+    block = render()
+    if BEGIN in doc and END in doc[doc.index(BEGIN):]:
+        pre = doc[: doc.index(BEGIN)]
+        post = doc[doc.index(END) + len(END):]
+        doc = pre + block + post
+    elif BEGIN in doc:
+        # END marker lost to a hand edit: regenerate from BEGIN down
+        # (everything below the marker is machine-owned anyway)
+        doc = doc[: doc.index(BEGIN)] + block + "\n"
+    else:
+        doc = doc.rstrip("\n") + "\n\n" + block + "\n"
+    with open(ROOFLINE, "w") as f:
+        f.write(doc)
+    print(f"updated {ROOFLINE}", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
