@@ -77,7 +77,8 @@ def render() -> str:
         op = frontier.get("operating_point")
         if isinstance(op, dict):
             lines.append(
-                f"- operating point (dc={op.get('at_dc')}): "
+                f"- operating point (dc={op.get('at_dc')}, quality "
+                f"measured on {op.get('backend', fr.get('backend'))}): "
                 f"default-grouping Rs within 1pt = "
                 f"**{op.get('valid_default_rs')}**, variants = "
                 f"{op.get('valid_variants')}")
@@ -104,7 +105,7 @@ def main() -> int:
     block = render()
     if BEGIN in doc and END in doc[doc.index(BEGIN):]:
         pre = doc[: doc.index(BEGIN)]
-        post = doc[doc.index(END) + len(END):]
+        post = doc[doc.index(END, doc.index(BEGIN)) + len(END):]
         doc = pre + block + post
     elif BEGIN in doc:
         # END marker lost to a hand edit: regenerate from BEGIN down

@@ -161,7 +161,8 @@ def suggest_block_size(raw_ids, num_buckets: int,
                        candidates: tuple[int, ...] = (32, 16, 8),
                        *,
                        min_recurrence: float = 32.0,
-                       max_row_load: float = 0.5) -> int:
+                       max_row_load: float = 0.5,
+                       max_row_load_single: float = 0.1) -> int:
     """Data-driven block-size advisor: the largest candidate R whose
     conjunction groups would actually TRAIN on this data, else 1
     (scalar hashing).
@@ -181,11 +182,19 @@ def suggest_block_size(raw_ids, num_buckets: int,
                   must be >= ``min_recurrence`` (rows are trained per
                   tuple; each needs enough label observations)
       collision   total distinct tuples / (D/R table rows), discounted
-      exposure    by the group count G, must be <= ``max_row_load``.
-                  A colliding row averages unrelated conjunctions, but
-                  corrupts only ~1/G of a sample's logit — the
-                  measured anchor: at identical row load 1.0, G=2
-                  (R=16) held within 0.4pt while G=1 (R=32) lost 9pt.
+      exposure    by the group count G, must be <= ``max_row_load``
+                  when G >= 2, and <= ``max_row_load_single`` when the
+                  candidate puts ALL fields in one group.  A colliding
+                  row averages unrelated conjunctions, but with G >= 2
+                  the other groups' rows partially compensate, so
+                  corruption scales well below 1/G; at G=1 the row IS
+                  the whole logit and there is no redundancy to absorb
+                  it.  Measured anchors (equal-param frontier + r5
+                  operating-point sweep, correlated-tuples regime):
+                  G=2 at row load 1.0 held within 0.4pt, while G=1
+                  lost 9.5pt at load 1.0, still lost 3.8pt at load
+                  0.25, and only reached parity (+0.2pt) at load
+                  0.016 — hence the much stricter single-group bound.
 
     Recurrence is necessary, not sufficient: purely additive signal
     with no field interactions can still favor scalar hashing by a
@@ -211,7 +220,9 @@ def suggest_block_size(raw_ids, num_buckets: int,
             distinct.append(len(tuples))
         recurrence = n / max(distinct)
         load = sum(distinct) / max(num_buckets // r, 1)
-        if recurrence >= min_recurrence and load / len(groups) <= max_row_load:
+        load_ok = (load <= max_row_load_single if len(groups) == 1
+                   else load / len(groups) <= max_row_load)
+        if recurrence >= min_recurrence and load_ok:
             return r
     return 1
 

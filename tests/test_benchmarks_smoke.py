@@ -63,3 +63,127 @@ def test_bench_configs_explicit_out(tmp_path):
     data = json.load(open(out))
     assert data["rows"][0]["config"] == 1
     assert data["rows"][0]["samples_per_sec"] > 0
+
+
+def test_bench_config6_quick_keyed_ps_row():
+    """Config 6 (blocked CTR over the keyed native PS plane) produces a
+    rate and an end-of-run accuracy through real sockets."""
+    import tempfile
+    with tempfile.TemporaryDirectory() as tmp:
+        out = os.path.join(tmp, "c6.json")
+        r = _run([sys.executable, "benchmarks/bench_configs.py", "--quick",
+                  "--configs", "6", "--out", out])
+        assert r.returncode == 0, r.stderr[-2000:]
+        row = json.load(open(out))["rows"][0]
+    assert row["config"] == 6
+    assert row["samples_per_sec"] > 0
+    assert 0.0 <= row["accuracy"] <= 1.0
+
+
+def test_quality_gate_prefers_operating_point(tmp_path, monkeypatch):
+    """bench.py's blocked-R quality gate reads the operating-point
+    verdict when the frontier artifact carries one, and falls back to
+    scanning the equal-param regimes otherwise."""
+    import bench
+
+    art = tmp_path / "frontier.json"
+    monkeypatch.setattr(bench, "_FRONTIER_PATH", str(art))
+    # operating-point verdict wins outright
+    art.write_text(json.dumps({"frontier": {
+        "correlated_tuples": {
+            "r32": {"delta_vs_scalar_pts": -9.45}},
+        "operating_point": {"valid_default_rs": [8, 16, 32]},
+    }}))
+    assert bench._quality_valid_blocked_rs() == {8: True, 16: True, 32: True}
+    # legacy artifact (no operating_point): per-regime scan, OR across
+    # regimes, R=32 failing everywhere stays invalid
+    art.write_text(json.dumps({"frontier": {
+        "correlated_tuples": {
+            "scalar": {"accuracy": 0.82},
+            "r8": {"delta_vs_scalar_pts": 0.34},
+            "r16": {"delta_vs_scalar_pts": -0.37},
+            "r32": {"delta_vs_scalar_pts": -9.45},
+            "largest_r_within_1pt": 16,
+        },
+        "high_card_iid": {
+            "r8": {"delta_vs_scalar_pts": -23.99},
+            "r16": {"delta_vs_scalar_pts": -23.5},
+            "r32": {"delta_vs_scalar_pts": -23.42},
+        },
+    }}))
+    assert bench._quality_valid_blocked_rs() == {8: True, 16: True, 32: False}
+    # missing artifact: nothing validated (never everything)
+    art.unlink()
+    assert bench._quality_valid_blocked_rs() == {}
+
+
+def test_requality_lkg_rederives_from_fresh_frontier(tmp_path, monkeypatch):
+    """--requality-lkg recomputes the LKG row's quality fields from the
+    CURRENT frontier without touching the chip, so a capture window's
+    artifacts agree with each other."""
+    import bench
+
+    lkg_path = tmp_path / "LAST_TPU.json"
+    frontier_path = tmp_path / "frontier.json"
+    monkeypatch.setattr(bench, "_LKG_PATH", str(lkg_path))
+    monkeypatch.setattr(bench, "_FRONTIER_PATH", str(frontier_path))
+    lkg_path.write_text(json.dumps({
+        "value": 165069.1,
+        "best_samples_per_sec": 15068285.2,
+        "sparse_samples_per_sec": 3146969.3,
+        "blocked_r8_samples_per_sec": 8096435.0,
+        "blocked_r16_samples_per_sec": 10851064.2,
+        "blocked_r32_samples_per_sec": 15068285.2,
+        "best_samples_per_sec_quality_valid": False,
+        "best_quality_valid_samples_per_sec": 10851064.2,
+        "quality_frontier_valid_rs": [8, 16],
+    }))
+    # old frontier: R=32 invalid -> best quality-valid is the R=16 rate
+    frontier_path.write_text(json.dumps({"frontier": {
+        "correlated_tuples": {"r8": {"delta_vs_scalar_pts": 0.3},
+                              "r16": {"delta_vs_scalar_pts": -0.4},
+                              "r32": {"delta_vs_scalar_pts": -9.5}}}}))
+    assert bench._requality_lkg() == 0
+    row = json.loads(lkg_path.read_text())
+    assert row["best_quality_valid_samples_per_sec"] == 10851064.2
+    assert row["best_samples_per_sec_quality_valid"] is False
+    # fresh frontier with the operating-point verdict: R=32 validates
+    # and the headline becomes quality-valid
+    frontier_path.write_text(json.dumps({"frontier": {
+        "operating_point": {"valid_default_rs": [8, 16, 32]}}}))
+    assert bench._requality_lkg() == 0
+    row = json.loads(lkg_path.read_text())
+    assert row["best_quality_valid_samples_per_sec"] == 15068285.2
+    assert row["best_samples_per_sec_quality_valid"] is True
+    assert row["quality_frontier_valid_rs"] == [8, 16, 32]
+
+
+def test_update_roofline_rewrites_auto_section(tmp_path, monkeypatch):
+    """update_roofline.py regenerates only the marked block, is
+    idempotent, and survives a hand edit that lost the END marker."""
+    sys.path.insert(0, os.path.join(REPO, "benchmarks"))
+    try:
+        import update_roofline as ur
+    finally:
+        sys.path.pop(0)
+    monkeypatch.setattr(ur, "HERE", str(tmp_path))
+    roofline = tmp_path / "ROOFLINE.md"
+    monkeypatch.setattr(ur, "ROOFLINE", str(roofline))
+    (tmp_path / "LAST_TPU.json").write_text(json.dumps({
+        "timestamp": "t", "git_rev": "abc", "backend": "tpu",
+        "value": 165069.1, "D": 1000000, "B": 2048,
+        "blocked_r32_samples_per_sec": 15068285.2,
+        "best_samples_per_sec": 15068285.2}))
+    roofline.write_text("# Prose stays\n\nhuman text\n")
+    assert ur.main() == 0
+    first = roofline.read_text()
+    assert first.startswith("# Prose stays")
+    assert "165,069" in first and ur.BEGIN in first and ur.END in first
+    # idempotent: second run replaces, not appends
+    assert ur.main() == 0
+    assert roofline.read_text().count(ur.BEGIN) == 1
+    # END marker lost: regenerate from BEGIN down instead of crashing
+    roofline.write_text(first.replace(ur.END, ""))
+    assert ur.main() == 0
+    body = roofline.read_text()
+    assert body.count(ur.BEGIN) == 1 and ur.END in body

@@ -429,6 +429,18 @@ class TestSuggestBlockSize:
         raw = self._regime(49_152, vocab_size=50, num_distinct_tuples=512)
         assert suggest_block_size(raw, 1_000_000) == 32
 
+    def test_single_group_needs_near_zero_load(self):
+        """The r5 operating-point anchor: 512 correlated tuples at
+        dc=65536 put single-group R=32 at row load 0.25, where it
+        measured -3.8pt (no redundancy to absorb collisions at G=1) —
+        the advisor must step down to R=16 (G=2, measured +0.5pt
+        there); only ~zero load (dc=1M, 0.016, measured +0.2pt)
+        green-lights the single group."""
+        from distlr_tpu.data.hashing import suggest_block_size
+
+        raw = self._regime(49_152, vocab_size=50, num_distinct_tuples=512)
+        assert suggest_block_size(raw, 65536) == 16
+
     def test_sparse_recurrence_rejected(self):
         """~2 samples/tuple (the quick-mode frontier that degraded
         everywhere): recurrence below threshold at every R."""
@@ -444,9 +456,12 @@ class TestSuggestBlockSize:
         assert suggest_block_size(raw, 1_000_000, min_recurrence=1.0) == 32
 
     def test_block_size_auto_cli_end_to_end(self, tmp_path):
-        """--block-size auto: low-vocab raw shards (2^8 group tuples
-        recur ~78x at 20k rows) resolve to R=8 and train through the
-        normal sync path; Config forbids unresolved 0 elsewhere."""
+        """--block-size auto: low-vocab raw shards (two 8-field groups,
+        2^8 tuples each recurring ~78x at 20k rows) resolve to R=8 and
+        train through the normal sync path; the single-group R=16/32
+        candidates are rejected (2^16 tuples never recur, and G=1 needs
+        row load <= 0.1 per the measured operating-point anchors).
+        Config forbids unresolved 0 elsewhere."""
         import pytest
 
         from distlr_tpu import Config, launch
@@ -455,7 +470,7 @@ class TestSuggestBlockSize:
         d = str(tmp_path / "auto")
         rc = launch.main([
             "gen-data", "--data-dir", d, "--num-samples", "20000",
-            "--ctr-fields", "8", "--ctr-vocab", "2", "--ctr-raw",
+            "--ctr-fields", "16", "--ctr-vocab", "2", "--ctr-raw",
             "--num-parts", "1", "--seed", "5",
         ])
         assert rc == 0
@@ -482,7 +497,7 @@ class TestSuggestBlockSize:
         d = str(tmp_path / "auto_ps")
         rc = launch.main([
             "gen-data", "--data-dir", d, "--num-samples", "20000",
-            "--ctr-fields", "8", "--ctr-vocab", "2", "--ctr-raw",
+            "--ctr-fields", "16", "--ctr-vocab", "2", "--ctr-raw",
             "--num-parts", "2", "--seed", "5",
         ])
         assert rc == 0
