@@ -446,17 +446,13 @@ def _fit_and_eval(model, cfg, train_batch, test_batch, steps: int,
 
 
 def _split_groups(num_fields: int, g: int, r: int) -> np.ndarray:
-    """``g`` near-equal consecutive field groups, each padded to ``r``
-    lanes — the intermediate groupings between ``default_field_groups``'
-    ceil(F/R) chunks and the single all-fields conjunction."""
-    groups = np.full((g, r), -1, dtype=np.int64)
-    bounds = np.linspace(0, num_fields, g + 1).astype(int)
-    for i in range(g):
-        m = bounds[i + 1] - bounds[i]
-        if m > r:
-            raise ValueError(f"group {i} has {m} fields > {r} lanes")
-        groups[i, :m] = np.arange(bounds[i], bounds[i + 1])
-    return groups
+    """``g`` near-equal field groups padded to ``r`` lanes — now the
+    shipped ``hashing.split_field_groups`` (``cfg.block_groups`` end to
+    end); kept as a thin adapter for the sweep's (fields, G, R) call
+    order."""
+    from distlr_tpu.data.hashing import split_field_groups
+
+    return split_field_groups(num_fields, r, g)
 
 
 def _operating_point_sweep(quick: bool) -> dict:
@@ -720,7 +716,12 @@ def bench_config_6(quick: bool) -> dict:
         d, n, fields, r, workers, servers, epochs, bs = (
             1_048_576, 100_000, 21, 32, 4, 2, 3, 4096)
     with tempfile.TemporaryDirectory() as tmp:
-        write_raw_ctr_shards(tmp, n, fields, 50, num_parts=workers, seed=3)
+        # tuple-recurrent data (512 distinct field tuples): the regime
+        # the blocked path learns on — i.i.d. fields would pin accuracy
+        # at 0.5 by construction and make the row's quality column
+        # meaningless (FRONTIER_TPU.json operating_point)
+        write_raw_ctr_shards(tmp, n, fields, 50, num_parts=workers, seed=3,
+                             num_distinct_tuples=64 if quick else 512)
         build_native()
         cfg = Config(
             data_dir=tmp, num_feature_dim=d, num_iteration=epochs,

@@ -78,6 +78,15 @@ class Config:
     # blocked_lr: lanes per table row (params = num_feature_dim, rows =
     # num_feature_dim / block_size) — see data/hashing.hash_group_blocks.
     block_size: int = 8
+    # blocked_lr: number of conjunction groups the raw fields hash into.
+    # 0 = ceil(ctr_fields / block_size) consecutive chunks (the default
+    # layout).  G > that splits the fields near-equally into G groups of
+    # <= block_size lanes each (data/hashing.split_field_groups): one
+    # extra row gather per extra group buys tuple spaces small enough to
+    # recur — measured (FRONTIER_TPU.json operating_point) R=32 G=3
+    # holds within 0.3pt of scalar hashing on low-cardinality iid
+    # fields where the single-group layout loses ~28pt.
+    block_groups: int = 0
     # blocked_lr from disk: number of raw categorical fields per row in
     # raw-CTR shards (data/hashing.write_raw_ctr_shards).  0 = read it
     # from the data dir's ctr_meta.json manifest at load time.
@@ -191,6 +200,15 @@ class Config:
             raise ValueError(
                 "block_size must be positive (0 = auto, blocked_lr only: "
                 "resolved from raw-CTR data by suggest_block_size)"
+            )
+        if self.block_groups < 0 or (
+            self.block_groups > 0 and self.model != "blocked_lr"
+        ):
+            raise ValueError(
+                "block_groups is a blocked_lr option (0 = default "
+                "ceil(fields/block_size) grouping; G = near-equal G-way "
+                f"field split); got block_groups={self.block_groups} "
+                f"with model={self.model!r}"
             )
         if self.num_feature_dim <= 0:
             raise ValueError("num_feature_dim must be positive")

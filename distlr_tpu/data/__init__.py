@@ -11,7 +11,9 @@ from distlr_tpu.data.hashing import (  # noqa: F401
     read_ctr_meta,
     read_raw_ctr_file,
     resolve_auto_block_size,
+    split_field_groups,
     suggest_block_size,
+    suggest_blocking,
     write_ctr_shards,
     write_raw_ctr_shards,
 )

@@ -33,8 +33,10 @@ __all__ = [
     "hash_buckets",
     "hash_group_blocks",
     "default_field_groups",
+    "split_field_groups",
     "encode_blocked",
     "suggest_block_size",
+    "suggest_blocking",
     "resolve_auto_block_size",
     "HashedFeatureEncoder",
     "csr_to_padded_coo",
@@ -157,6 +159,45 @@ def default_field_groups(num_fields: int, block_size: int) -> np.ndarray:
     return groups
 
 
+def split_field_groups(num_fields: int, block_size: int,
+                       num_groups: int = 0) -> np.ndarray:
+    """Field grouping with an explicit group count.
+
+    ``num_groups=0`` (the default everywhere) keeps the historical
+    :func:`default_field_groups` layout — consecutive R-sized chunks —
+    so existing data hashes identically.  ``num_groups == ceil(F/R)``
+    returns that SAME default layout (one canonical grouping per
+    (F, R, G) triple — the advisor's normalization of G to 0 and an
+    explicit ``--block-groups ceil(F/R)`` must hash identically, or a
+    model trained one way and evaluated the other silently scores
+    garbage).  Larger ``num_groups=G`` splits the fields into G
+    near-equal consecutive groups, each padded to R lanes: the
+    intermediate groupings between ceil(F/R) chunks and one all-fields
+    conjunction.  Measured motivation (r5 operating-point
+    sweep, ``benchmarks/FRONTIER_TPU.json``): on low-cardinality i.i.d.
+    fields the single-group R=32 layout loses ~28pt (21-field tuples
+    never recur) while the SAME R at G=3 holds within 0.3pt of scalar
+    hashing — extra groups trade one extra row gather per sample for
+    tuple spaces small enough to recur.
+    """
+    g_min = -(-num_fields // block_size)
+    if num_groups in (0, None) or num_groups == g_min:
+        return default_field_groups(num_fields, block_size)
+    g = int(num_groups)
+    if g < g_min or g > num_fields:
+        raise ValueError(
+            f"num_groups={g} outside [{g_min}, {num_fields}] for "
+            f"{num_fields} fields at block_size={block_size} (each group "
+            f"holds at most {block_size} fields, at least 1)"
+        )
+    groups = np.full((g, block_size), -1, dtype=np.int64)
+    bounds = np.linspace(0, num_fields, g + 1).astype(int)
+    for i in range(g):
+        m = bounds[i + 1] - bounds[i]
+        groups[i, :m] = np.arange(bounds[i], bounds[i + 1])
+    return groups
+
+
 def suggest_block_size(raw_ids, num_buckets: int,
                        candidates: tuple[int, ...] = (32, 16, 8),
                        *,
@@ -213,26 +254,127 @@ def suggest_block_size(raw_ids, num_buckets: int,
         )
     for r in sorted(candidates, reverse=True):
         groups = default_field_groups(num_fields, r)
-        distinct = []
-        for g in groups:
-            members = g[g >= 0]
-            tuples = np.unique(raw_ids[:, members], axis=0)
-            distinct.append(len(tuples))
-        recurrence = n / max(distinct)
-        load = sum(distinct) / max(num_buckets // r, 1)
-        load_ok = (load <= max_row_load_single if len(groups) == 1
-                   else load / len(groups) <= max_row_load)
-        if recurrence >= min_recurrence and load_ok:
+        if _grouping_passes(n, _distinct_group_tuples(raw_ids, groups),
+                            num_buckets, r, min_recurrence, max_row_load,
+                            max_row_load_single):
             return r
     return 1
 
 
+def _distinct_group_tuples(raw_ids, groups) -> list[int]:
+    """Distinct value-tuple count per group (the advisor's raw stat)."""
+    return [len(np.unique(raw_ids[:, g[g >= 0]], axis=0)) for g in groups]
+
+
+def _grouping_passes(n: int, distinct: list[int], num_buckets: int, r: int,
+                     min_recurrence: float, max_row_load: float,
+                     max_row_load_single: float) -> bool:
+    """The advisor's two statistical gates, evaluated on an explicit
+    grouping's distinct-tuple counts (shared by
+    :func:`suggest_block_size` and :func:`suggest_blocking` so the
+    measured thresholds live once)."""
+    recurrence = n / max(distinct)
+    load = sum(distinct) / max(num_buckets // r, 1)
+    load_ok = (load <= max_row_load_single if len(distinct) == 1
+               else load / len(distinct) <= max_row_load)
+    return recurrence >= min_recurrence and load_ok
+
+
+def suggest_blocking(raw_ids, num_buckets: int,
+                     r_candidates: tuple[int, ...] = (32, 16, 8),
+                     *,
+                     num_groups: int = 0,
+                     max_groups: int = 4,
+                     min_recurrence: float = 32.0,
+                     max_row_load: float = 0.5,
+                     max_row_load_single: float = 0.1) -> tuple[int, int]:
+    """Joint (block_size, block_groups) advisor: the cheapest layout
+    whose conjunction groups would actually train, else ``(1, 0)``
+    (scalar hashing).
+
+    Generalizes :func:`suggest_block_size` over explicit group counts
+    (:func:`split_field_groups`): candidates are ordered by gather cost
+    — fewest groups first (each group is one row gather per sample,
+    the dominant cost on the measured gather-bound step), then smallest
+    fitting R (fewer lanes fetched).  Each candidate is gated by the
+    same recurrence/row-load thresholds as :func:`suggest_block_size`,
+    evaluated on the grouping ACTUALLY trained — this is what lets the
+    advisor find e.g. (R=8, 3 default groups) on low-cardinality
+    i.i.d. fields where every single-group layout fails, or step down
+    to more groups when a wide single group would collide.
+
+    ``num_groups > 0`` pins the user's group count and only searches R
+    (the ``--block-size auto --block-groups G`` path).  ``max_groups``
+    bounds the EXTRA groups the unpinned search will spend; the default
+    ceil(F/R) chunking of every candidate R is always searched
+    regardless, so wide-field data never loses a layout the plain
+    :func:`suggest_block_size` would have tried.  The returned group
+    count is normalized to 0 when it equals the default ceil(F/R)
+    chunking, keeping resolved configs canonical.
+    """
+    raw_ids = np.asarray(raw_ids, dtype=np.int64)
+    n, num_fields = raw_ids.shape
+    if n == 0:
+        raise ValueError("suggest_blocking needs a non-empty sample of raw rows")
+    rs = sorted(r_candidates)
+    if num_groups:
+        g_values = [int(num_groups)]
+    else:
+        # 1..max_groups bounds the EXTRA gathers auto may spend, but the
+        # default ceil(F/R) chunking of every candidate R must always be
+        # searched — otherwise wide-field data (F > max_groups * min R)
+        # would silently lose layouts the plain R advisor always tried
+        g_values = sorted(
+            set(range(1, min(max_groups, num_fields) + 1))
+            | {-(-num_fields // r) for r in rs}
+        )
+    # distinct-tuple counts depend only on group MEMBERSHIP, which many
+    # (r, g) candidates share — memoize so the np.unique sorts (the
+    # advisor's entire cost on a 100k-row sample) run once per layout
+    # key must include the shape: a (2, 8) and a (1, 16) grouping over
+    # fields 0..15 serialize to identical bytes
+    memo: dict[tuple, list[int]] = {}
+
+    def distinct_of(groups) -> list[int]:
+        key = (groups.shape, groups.tobytes())
+        if key not in memo:
+            memo[key] = _distinct_group_tuples(raw_ids, groups)
+        return memo[key]
+
+    any_feasible = False
+    for g in g_values:
+        for r in rs:
+            if r * g < num_fields or g > num_fields:
+                continue  # G groups of <= R lanes cannot hold every field
+            any_feasible = True
+            groups = split_field_groups(num_fields, r, g)
+            if _grouping_passes(n, distinct_of(groups), num_buckets, r,
+                                min_recurrence, max_row_load,
+                                max_row_load_single):
+                return r, (0 if g == -(-num_fields // r) else g)
+    if num_groups and not any_feasible:
+        # A pinned G that no candidate R can realize is a config error,
+        # not a data statistic — raise like split_field_groups would,
+        # instead of silently training scalar with a misleading log.
+        raise ValueError(
+            f"block_groups={int(num_groups)} is infeasible for "
+            f"{num_fields} fields with block-size candidates {tuple(rs)} "
+            f"(need ceil(fields/G) <= R and G <= fields)"
+        )
+    return 1, 0
+
+
 def resolve_auto_block_size(data_dir: str, ctr_fields: int, num_buckets: int,
-                            *, sample_rows: int = 100_000) -> int:
+                            *, sample_rows: int = 100_000,
+                            num_groups: int = 0) -> tuple[int, int]:
     """Resolve ``block_size=0`` ("auto") for a raw-CTR data dir: run
-    :func:`suggest_block_size` on a sample of the first train shard.
-    Requires raw shards on disk — auto cannot work on pre-encoded or
-    injected data (the raw categorical ids are gone by then)."""
+    :func:`suggest_blocking` on a sample of the first train shard and
+    return ``(block_size, block_groups)`` (``block_groups`` 0 = default
+    ceil(F/R) chunking; ``(1, 0)`` = scalar fallback).  ``num_groups``
+    pins an explicit ``--block-groups`` so the advisor validates the
+    grouping actually trained.  Requires raw shards on disk — auto
+    cannot work on pre-encoded or injected data (the raw categorical
+    ids are gone by then)."""
     from distlr_tpu.data.sharding import part_name  # noqa: PLC0415
 
     path = os.path.join(data_dir, "train", part_name(0))
@@ -267,21 +409,26 @@ def resolve_auto_block_size(data_dir: str, ctr_fields: int, num_buckets: int,
     # only Rs that divide the table (get_model requires it; 1M-style
     # power-of-two bucket counts keep every candidate)
     candidates = tuple(r for r in (32, 16, 8) if num_buckets % r == 0)
-    return suggest_block_size(raw_ids, num_buckets, candidates)
+    return suggest_blocking(raw_ids, num_buckets, candidates,
+                            num_groups=num_groups)
 
 
 def encode_blocked(raw_ids, num_blocks: int, block_size: int, *, seed: int = 0,
-                   raw_vals=None, field_groups=None):
+                   raw_vals=None, field_groups=None, num_groups: int = 0):
     """Raw ``(N, F)`` categorical ids -> ``BlockedSparseLR`` batch leaves
-    ``(blocks, lane_vals)`` using the default consecutive grouping.
+    ``(blocks, lane_vals)``.
 
     The one load-time call sites use; keeps the train/test splits of a
-    run hashing identically as long as they share ``seed`` and shape.
+    run hashing identically as long as they share ``seed``, shape, and
+    grouping.  ``num_groups=0`` keeps the default consecutive chunking;
+    ``num_groups=G`` selects the near-equal G-way split
+    (:func:`split_field_groups` — ``cfg.block_groups`` end to end).
     Returns ``(blocks (N, G) int32, lane_vals (N, G, R) float32)``.
     """
     raw_ids = np.asarray(raw_ids, dtype=np.int64)
     if field_groups is None:
-        field_groups = default_field_groups(raw_ids.shape[1], block_size)
+        field_groups = split_field_groups(raw_ids.shape[1], block_size,
+                                          num_groups)
     blocks, lane_vals = hash_group_blocks(
         raw_ids, field_groups, num_blocks, seed=seed, raw_vals=raw_vals
     )
@@ -547,6 +694,7 @@ def write_raw_ctr_shards(
     *,
     seed: int = 0,
     test_fraction: float = 0.2,
+    num_distinct_tuples: int | None = None,
 ) -> dict:
     """Write RAW categorical CTR shards: reference-layout parts whose rows
     are ``±1 field:id ...`` with 1-based field numbers and the raw
@@ -574,9 +722,11 @@ def write_raw_ctr_shards(
             f"vocab_size {vocab_size} exceeds float32's exact-integer range "
             "(2^24); raw ids would corrupt in the libsvm value slot"
         )
+    # num_distinct_tuples models correlated fields (see make_ctr_dataset)
+    # — the tuple-recurrent regime the blocked path needs to learn
     raw_ids, _, _, y, w_true = make_ctr_dataset(
         num_samples, num_fields, vocab_size, max(num_fields * 64, 1024),
-        seed=seed,
+        seed=seed, num_distinct_tuples=num_distinct_tuples,
     )
     n_test = int(num_samples * test_fraction)
     os.makedirs(os.path.join(data_dir, "train"), exist_ok=True)

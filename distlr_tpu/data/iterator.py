@@ -172,14 +172,16 @@ class BlockedDataIter(DataIter):
 
     @classmethod
     def from_file(cls, path, num_fields: int, num_blocks: int, block_size: int,
-                  batch_size: int = -1, *, seed: int = 0, **kw):
+                  batch_size: int = -1, *, seed: int = 0, num_groups: int = 0,
+                  **kw):
         """Parse a raw-CTR shard (``write_raw_ctr_shards`` format) and
-        hash its field groups into block rows at load time."""
+        hash its field groups into block rows at load time
+        (``num_groups``: see ``hashing.split_field_groups``)."""
         from distlr_tpu.data.hashing import encode_blocked, read_raw_ctr_file  # noqa: PLC0415
 
         raw_ids, y = read_raw_ctr_file(path, num_fields)
         blocks, lane_vals = encode_blocked(
-            raw_ids, num_blocks, block_size, seed=seed
+            raw_ids, num_blocks, block_size, seed=seed, num_groups=num_groups
         )
         return cls(blocks, lane_vals, y, batch_size, **kw)
 

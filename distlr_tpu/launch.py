@@ -48,8 +48,20 @@ def _add_config_flags(p: argparse.ArgumentParser) -> None:
                    type=lambda s: 0 if s == "auto" else int(s),
                    help="blocked_lr: lanes per table row (table rows = "
                    "num-feature-dim / block-size); 'auto' samples the "
-                   "raw shards and picks the largest statistically safe "
-                   "R (data.hashing.suggest_block_size)")
+                   "raw shards and picks the cheapest statistically safe "
+                   "(R, groups) layout — fewest row gathers, then fewest "
+                   "lanes (data.hashing.suggest_blocking; honors a "
+                   "pinned --block-groups).  Resolution is data-"
+                   "dependent: pin explicit values when a model must be "
+                   "re-evaluated reproducibly")
+    p.add_argument("--block-groups", dest="block_groups", type=int,
+                   help="blocked_lr: hash the fields into this many "
+                   "conjunction groups instead of ceil(fields/block-size) "
+                   "chunks; extra groups cost one row gather each but "
+                   "keep group tuple spaces small enough to recur "
+                   "(measured: R=32 with 3 groups holds scalar accuracy "
+                   "on low-cardinality iid fields where the single group "
+                   "loses ~28pt — benchmarks/FRONTIER_TPU.json)")
     p.add_argument("--ctr-fields", dest="ctr_fields", type=int,
                    help="blocked_lr: raw categorical fields per row "
                    "(default: read from the data dir's ctr_meta.json)")
@@ -102,8 +114,8 @@ def _config_from_args(args: argparse.Namespace) -> Config:
             "learning_rate", "l2_c", "test_interval", "model", "num_classes",
             "nnz_max", "compat_mode", "checkpoint_dir", "checkpoint_interval",
             "profile_dir", "num_workers", "num_servers", "ps_compute_backend",
-            "feature_dtype", "block_size", "ctr_fields", "hash_seed",
-            "ps_pipeline",
+            "feature_dtype", "block_size", "block_groups", "ctr_fields",
+            "hash_seed", "ps_pipeline",
         }
     }
     cfg = Config.from_env(**overrides)
@@ -124,12 +136,20 @@ def _resolve_auto_block(cfg: Config) -> Config:
         return cfg
     from distlr_tpu.data.hashing import resolve_auto_block_size  # noqa: PLC0415
 
-    r = resolve_auto_block_size(cfg.data_dir, cfg.ctr_fields,
-                                cfg.num_feature_dim)
-    log.info("block_size auto: resolved to R=%d%s", r,
-             "" if r > 1 else " (scalar-equivalent: tuples in this "
-             "data don't recur enough for wider rows)")
-    return cfg.replace(block_size=r)
+    r, g = resolve_auto_block_size(cfg.data_dir, cfg.ctr_fields,
+                                   cfg.num_feature_dim,
+                                   num_groups=cfg.block_groups)
+    if r == 1:
+        log.info("block_size auto: resolved to scalar-equivalent R=1 "
+                 "(no candidate layout%s passed the recurrence/row-load "
+                 "gates on this data)",
+                 f" at block_groups={cfg.block_groups}" if cfg.block_groups
+                 else "")
+    else:
+        log.info("block_size auto: resolved to R=%d, %s", r,
+                 f"{g} conjunction groups" if g
+                 else "default field grouping")
+    return cfg.replace(block_size=r, block_groups=g)
 
 
 def _maybe_force_cpu_devices(args: argparse.Namespace) -> None:
@@ -178,6 +198,14 @@ def cmd_gen_data(args: argparse.Namespace) -> int:
     if args.ctr_raw and not args.ctr_fields:
         print("error: --ctr-raw requires --ctr-fields", file=sys.stderr)
         return 2
+    if args.ctr_tuples < 0:
+        print("error: --ctr-tuples must be non-negative (0 disables the "
+              "tuple table)", file=sys.stderr)
+        return 2
+    if args.ctr_tuples and not args.ctr_raw:
+        print("error: --ctr-tuples requires --ctr-raw (the pre-hashed "
+              "one-hot writer has no tuple-table mode)", file=sys.stderr)
+        return 2
     if args.ctr_fields:
         if args.num_classes != 2 or args.sparsity != 0.5:
             print("error: --num-classes/--sparsity do not apply to CTR shards "
@@ -196,6 +224,7 @@ def cmd_gen_data(args: argparse.Namespace) -> int:
                 args.ctr_vocab,
                 args.num_parts,
                 seed=args.seed,
+                num_distinct_tuples=args.ctr_tuples or None,
             )
             log.info("wrote %d raw-CTR train shards + test to %s",
                      len(manifest["train_parts"]), args.data_dir)
@@ -367,6 +396,11 @@ def main(argv=None) -> int:
                    help="with --ctr-fields: write RAW categorical shards "
                    "(hash-scheme-agnostic; the blocked_lr on-disk format) "
                    "instead of pre-hashed one-hot rows")
+    g.add_argument("--ctr-tuples", type=int, default=0,
+                   help="with --ctr-raw: draw rows from this many distinct "
+                   "field-value tuples (correlated fields — the "
+                   "tuple-recurrent regime the blocked path learns on) "
+                   "instead of i.i.d. fields")
     g.set_defaults(fn=cmd_gen_data)
 
     s = sub.add_parser("sync", help="synchronous SPMD training (one process)")

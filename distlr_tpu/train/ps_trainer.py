@@ -410,7 +410,8 @@ class PSWorker:
         return BlockedDataIter.from_file(
             path, resolve_ctr_fields(cfg.data_dir, cfg.ctr_fields),
             cfg.num_feature_dim // cfg.block_size, cfg.block_size,
-            batch_size, seed=cfg.hash_seed, wrap_compat=wrap,
+            batch_size, seed=cfg.hash_seed, num_groups=cfg.block_groups,
+            wrap_compat=wrap,
         )
 
     def _load_train_iter(self) -> DataIter:

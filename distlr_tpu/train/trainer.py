@@ -167,7 +167,8 @@ class GlobalShardedData:
         for p in cls._discover_parts(data_dir, split):
             raw_ids, y = read_raw_ctr_file(p, num_fields)
             blocks, lane_vals = encode_blocked(
-                raw_ids, num_blocks, cfg.block_size, seed=cfg.hash_seed
+                raw_ids, num_blocks, cfg.block_size, seed=cfg.hash_seed,
+                num_groups=cfg.block_groups,
             )
             parts.append((blocks, lane_vals, y))
         return cls._from_parts(parts, num_shards)

@@ -187,3 +187,28 @@ def test_update_roofline_rewrites_auto_section(tmp_path, monkeypatch):
     assert ur.main() == 0
     body = roofline.read_text()
     assert body.count(ur.BEGIN) == 1 and ur.END in body
+
+
+def test_bench_config4_quick_frontier_schema():
+    """Config 4's frontier — the source bench.py's quality gate and the
+    FRONTIER_TPU.json refresh both read — keeps its schema: equal-param
+    regimes with largest_r_within_1pt plus the operating_point section
+    whose valid_default_rs verdict drives the headline."""
+    import tempfile
+    with tempfile.TemporaryDirectory() as tmp:
+        out = os.path.join(tmp, "c4.json")
+        r = _run([sys.executable, "benchmarks/bench_configs.py", "--quick",
+                  "--configs", "4", "--out", out], timeout=900)
+        assert r.returncode == 0, r.stderr[-2000:]
+        row = json.load(open(out))["rows"][0]
+    fr = row["blocked_frontier"]
+    for regime in ("high_card_iid", "low_card_iid", "correlated_tuples"):
+        assert "largest_r_within_1pt" in fr[regime]
+        assert "delta_vs_scalar_pts" in fr[regime]["r16"]
+    op = fr["operating_point"]
+    assert set(op["valid_default_rs"]) <= {8, 16, 32}
+    cell = next(iter(op["regimes"]["correlated_tuples"].values()))
+    for label in ("scalar", "r8", "r16", "r32", "r32_g2", "r32_g3"):
+        assert label in cell
+    for diag in ("row_load", "min_recurrence", "groups"):
+        assert diag in cell["r32_g3"]

@@ -474,7 +474,7 @@ class TestSuggestBlockSize:
             "--num-parts", "1", "--seed", "5",
         ])
         assert rc == 0
-        assert resolve_auto_block_size(d, 0, 4096) == 8
+        assert resolve_auto_block_size(d, 0, 4096) == (8, 0)
         rc = launch.main([
             "sync", "--data-dir", d, "--model", "blocked_lr",
             "--num-feature-dim", "4096", "--block-size", "auto",
@@ -507,5 +507,237 @@ class TestSuggestBlockSize:
             "--num-iteration", "2", "--batch-size", "1024",
             "--learning-rate", "0.5", "--l2-c", "0", "--test-interval", "0",
             "--num-workers", "2", "--num-servers", "1",
+        ])
+        assert rc == 0
+
+
+class TestBlockGroups:
+    """cfg.block_groups / --block-groups: explicit conjunction-group
+    counts (r5).  The measured motivation lives in FRONTIER_TPU.json's
+    operating_point section; these tests pin the layout, the statistical
+    direction, and the end-to-end plumbing."""
+
+    def test_split_field_groups_layouts(self):
+        import numpy as np
+
+        from distlr_tpu.data.hashing import (
+            default_field_groups,
+            split_field_groups,
+        )
+
+        # num_groups=0 is bit-identical to the historical default, so
+        # existing data hashes identically
+        np.testing.assert_array_equal(
+            split_field_groups(21, 16, 0), default_field_groups(21, 16))
+        # ... and so is num_groups == ceil(F/R): one canonical layout
+        # per (F, R, G) triple, so the advisor's G->0 normalization and
+        # an explicit --block-groups ceil(F/R) hash identically
+        np.testing.assert_array_equal(
+            split_field_groups(21, 8, 3), default_field_groups(21, 8))
+        np.testing.assert_array_equal(
+            split_field_groups(21, 16, 2), default_field_groups(21, 16))
+        g3 = split_field_groups(21, 32, 3)
+        assert g3.shape == (3, 32)
+        members = [g[g >= 0] for g in g3]
+        assert [len(m) for m in members] == [7, 7, 7]
+        np.testing.assert_array_equal(np.concatenate(members), np.arange(21))
+        import pytest
+
+        with pytest.raises(ValueError, match="outside"):
+            split_field_groups(21, 32, -1)
+        with pytest.raises(ValueError, match="outside"):
+            split_field_groups(21, 8, 2)  # 2 groups can't hold 21 fields at R=8
+        with pytest.raises(ValueError, match="outside"):
+            split_field_groups(21, 32, 22)  # more groups than fields
+
+    def test_g3_rescues_low_card_iid_direction(self):
+        """Statistical direction at small scale (mirrors the quick
+        operating-point sweep): on low-cardinality i.i.d. fields the
+        3-group R=32 layout must clearly beat the single-group one
+        (tuple spaces 2^7 recur; 2^21 never do)."""
+        import jax.numpy as jnp
+        import numpy as np
+
+        from distlr_tpu import Config
+        from distlr_tpu.data.hashing import (
+            hash_group_blocks,
+            make_ctr_dataset,
+            split_field_groups,
+        )
+        from distlr_tpu.models import BlockedSparseLR
+
+        dc, n_tr, n_te, steps = 4096, 6000, 1500, 120
+        raw, _c, _v, y, _w = make_ctr_dataset(
+            n_tr + n_te, 21, vocab_size=2, num_buckets=dc, seed=7,
+            center_logits=True)
+        accs = {}
+        for g in (1, 3):
+            nb = dc // 32
+            groups = split_field_groups(21, 32, g)
+            blocks, lv = hash_group_blocks(raw, groups, nb, seed=7)
+            cfg = Config(num_feature_dim=dc, model="blocked_lr",
+                         block_size=32, learning_rate=1.0, l2_c=0.0)
+            m = BlockedSparseLR(nb, 32)
+            import jax
+
+            @jax.jit
+            def step(t, b):
+                return t - 1.0 * m.grad(t, b, cfg)
+
+            tr = (jnp.asarray(blocks[n_te:].astype(np.int32)),
+                  jnp.asarray(lv[n_te:]), jnp.asarray(y[n_te:]),
+                  jnp.ones(n_tr, jnp.float32))
+            te = (jnp.asarray(blocks[:n_te].astype(np.int32)),
+                  jnp.asarray(lv[:n_te]), jnp.asarray(y[:n_te]),
+                  jnp.ones(n_te, jnp.float32))
+            t = jnp.zeros((nb, 32), jnp.float32)
+            for _ in range(steps):
+                t = step(t, tr)
+            accs[g] = float(m.accuracy(t, te))
+        # measured at these shapes: g1 ~0.47 (memorizing never-recurring
+        # 21-field tuples), g3 ~0.65; wide margin so seed drift can't flake
+        assert accs[3] > accs[1] + 0.05, accs
+
+    def test_cli_block_groups_end_to_end(self, tmp_path):
+        """gen-data --ctr-tuples writes tuple-recurrent raw shards; sync
+        and PS runs train blocked_lr with --block-groups 3 end to end."""
+        from distlr_tpu import launch
+
+        d = str(tmp_path / "bg")
+        rc = launch.main([
+            "gen-data", "--data-dir", d, "--num-samples", "6000",
+            "--ctr-fields", "21", "--ctr-vocab", "50", "--ctr-raw",
+            "--ctr-tuples", "64", "--num-parts", "2", "--seed", "5",
+        ])
+        assert rc == 0
+        rc = launch.main([
+            "sync", "--data-dir", d, "--model", "blocked_lr",
+            "--num-feature-dim", "4096", "--block-size", "32",
+            "--block-groups", "3", "--num-iteration", "3",
+            "--batch-size", "512", "--learning-rate", "0.5", "--l2-c", "0",
+            "--test-interval", "0",
+        ])
+        assert rc == 0
+        rc = launch.main([
+            "ps", "--data-dir", d, "--model", "blocked_lr",
+            "--num-feature-dim", "4096", "--block-size", "32",
+            "--block-groups", "3", "--num-iteration", "2",
+            "--batch-size", "512", "--learning-rate", "0.5", "--l2-c", "0",
+            "--test-interval", "2", "--num-workers", "2", "--num-servers", "1",
+        ])
+        assert rc == 0
+
+    def test_config_rejects_block_groups_off_family(self):
+        import pytest
+
+        from distlr_tpu import Config
+
+        with pytest.raises(ValueError, match="block_groups"):
+            Config(model="binary_lr", num_feature_dim=64, block_groups=2)
+        with pytest.raises(ValueError, match="block_groups"):
+            Config(model="blocked_lr", num_feature_dim=64, block_size=8,
+                   block_groups=-1)
+
+    def test_gen_data_tuples_requires_raw(self, capsys):
+        from distlr_tpu import launch
+
+        rc = launch.main([
+            "gen-data", "--data-dir", "/tmp/nope", "--num-samples", "100",
+            "--ctr-fields", "8", "--ctr-tuples", "16",
+        ])
+        assert rc == 2
+
+
+class TestSuggestBlocking:
+    """Joint (R, G) advisor: same measured gates as suggest_block_size,
+    candidates ordered by gather cost (fewest groups, then fewest
+    lanes), evaluated on the grouping actually trained."""
+
+    def _regime(self, n, seed=7, **kw):
+        from distlr_tpu.data.hashing import make_ctr_dataset
+
+        raw, *_ = make_ctr_dataset(n, 21, num_buckets=64, seed=seed, **kw)
+        return raw
+
+    def test_matches_default_advisor_where_defaults_win(self):
+        from distlr_tpu.data.hashing import suggest_blocking
+
+        # correlated tuples with a 1M-row table: single-group R=32 at
+        # ~zero load, same as suggest_block_size
+        raw = self._regime(49_152, vocab_size=50, num_distinct_tuples=512)
+        assert suggest_blocking(raw, 1_000_000) == (32, 0)
+        # at dc=65536 the single group fails its load gate; the G=2
+        # layouts pass and R=16 fetches fewer lanes than R=32
+        assert suggest_blocking(raw, 65536) == (16, 0)
+
+    def test_finds_multi_group_layout_default_advisor_finds(self):
+        from distlr_tpu.data.hashing import (
+            suggest_block_size,
+            suggest_blocking,
+        )
+
+        # low-cardinality iid fields: only 3-group layouts recur (2^7
+        # tuples); cheapest is R=8 = the default ceil(21/8)=3 chunking
+        raw = self._regime(49_152, vocab_size=2)
+        assert suggest_blocking(raw, 1_000_000) == (8, 0)
+        assert suggest_block_size(raw, 1_000_000) == 8  # agreement
+
+    def test_pinned_groups_searches_r_only(self):
+        from distlr_tpu.data.hashing import suggest_blocking
+
+        raw = self._regime(49_152, vocab_size=2)
+        # G pinned to 3: R=8's default grouping IS 3 groups -> normalized
+        r, g = suggest_blocking(raw, 1_000_000, num_groups=3)
+        assert (r, g) == (8, 0)
+        # G pinned to 1: no single 21-field conjunction recurs -> scalar
+        assert suggest_blocking(raw, 1_000_000, num_groups=1) == (1, 0)
+
+    def test_scalar_fallback_on_hostile_data(self):
+        from distlr_tpu.data.hashing import suggest_blocking
+
+        raw = self._regime(50_000, vocab_size=10_000_000)
+        assert suggest_blocking(raw, 1_000_000) == (1, 0)
+
+    def test_wide_field_default_layouts_always_searched(self):
+        """max_groups bounds only the EXTRA gathers: with 40 fields the
+        R=8 default chunking is 5 groups (> max_groups=4), and it is
+        the only layout whose tuple spaces (2^8) recur on vocab-2 data
+        — auto must find it, not silently fall back to scalar (r5
+        review finding)."""
+        from distlr_tpu.data.hashing import make_ctr_dataset, suggest_blocking
+
+        raw, *_ = make_ctr_dataset(20_000, 40, vocab_size=2,
+                                   num_buckets=64, seed=7)
+        assert suggest_blocking(raw, 1_000_000) == (8, 0)
+
+    def test_infeasible_pinned_groups_raise(self):
+        """A pinned G no candidate R can realize is a config error, not
+        a data statistic — it must raise, not silently train scalar."""
+        from distlr_tpu.data.hashing import suggest_blocking
+
+        raw = self._regime(5_000, vocab_size=50, num_distinct_tuples=64)
+        with pytest.raises(ValueError, match="infeasible"):
+            suggest_blocking(raw, 1_000_000, num_groups=25)  # > 21 fields
+
+    def test_auto_with_pinned_groups_cli(self, tmp_path):
+        """--block-size auto --block-groups G resolves through the
+        grouping actually trained (r5 review finding: auto used to
+        validate the default grouping and could then crash on an
+        incompatible pinned G)."""
+        from distlr_tpu import launch
+
+        d = str(tmp_path / "autog")
+        rc = launch.main([
+            "gen-data", "--data-dir", d, "--num-samples", "20000",
+            "--ctr-fields", "21", "--ctr-vocab", "2", "--ctr-raw",
+            "--num-parts", "1", "--seed", "5",
+        ])
+        assert rc == 0
+        rc = launch.main([
+            "sync", "--data-dir", d, "--model", "blocked_lr",
+            "--num-feature-dim", "4096", "--block-size", "auto",
+            "--block-groups", "3", "--num-iteration", "2",
+            "--batch-size", "512", "--learning-rate", "0.5", "--l2-c", "0",
+            "--test-interval", "0",
         ])
         assert rc == 0
