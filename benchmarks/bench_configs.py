@@ -849,6 +849,22 @@ def _maybe_refresh_frontier_artifact(payload: dict, out_path: str,
         "blocked_samples_per_sec": row4.get("blocked_samples_per_sec"),
         "frontier": row4["blocked_frontier"],
     }
+    # fold in the standalone seed-replication evidence so regeneration
+    # can't silently orphan the docs that cite it (exp_op_seed_check.py)
+    try:
+        with open(os.path.join(HERE, "OP_SEED_CHECK.json")) as f:
+            sc = json.load(f)
+        op = art["frontier"].get("operating_point")
+        if isinstance(op, dict):
+            op["seed_replication"] = {
+                "deltas_pts_r32_vs_scalar": [r["delta_pts"]
+                                             for r in sc["rows"]],
+                "seeds": [r["seed"] for r in sc["rows"]],
+                "claim_holds_all_seeds": sc["claim_holds_all_seeds"],
+                "source": "benchmarks/OP_SEED_CHECK.json (exp_op_seed_check.py)",
+            }
+    except (OSError, ValueError, KeyError):
+        pass
     path = os.path.join(HERE, "FRONTIER_TPU.json")
     with open(path, "w") as f:
         json.dump(art, f, indent=1)
