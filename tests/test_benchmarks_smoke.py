@@ -147,6 +147,7 @@ def test_requality_lkg_rederives_from_fresh_frontier(tmp_path, monkeypatch):
     row = json.loads(lkg_path.read_text())
     assert row["best_quality_valid_samples_per_sec"] == 10851064.2
     assert row["best_samples_per_sec_quality_valid"] is False
+    assert row["north_star_cleared_with_quality"] is False  # 10.85M < 12.5M
     # fresh frontier with the operating-point verdict: R=32 validates
     # and the headline becomes quality-valid
     frontier_path.write_text(json.dumps({"frontier": {
@@ -156,6 +157,7 @@ def test_requality_lkg_rederives_from_fresh_frontier(tmp_path, monkeypatch):
     assert row["best_quality_valid_samples_per_sec"] == 15068285.2
     assert row["best_samples_per_sec_quality_valid"] is True
     assert row["quality_frontier_valid_rs"] == [8, 16, 32]
+    assert row["north_star_cleared_with_quality"] is True
 
 
 def test_update_roofline_rewrites_auto_section(tmp_path, monkeypatch):
