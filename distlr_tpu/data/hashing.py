@@ -755,6 +755,11 @@ def write_raw_ctr_shards(
         "num_fields": num_fields,
         "vocab_size": vocab_size,
         "seed": seed,
+        # provenance only: loaders never read this (the block-size
+        # advisor measures recurrence empirically), but a human auditing
+        # a data dir should see whether rows were drawn from a fixed
+        # tuple table (correlated fields) or i.i.d.
+        "num_distinct_tuples": num_distinct_tuples,
     }
     with open(os.path.join(data_dir, _CTR_META), "w") as f:
         json.dump(meta, f)

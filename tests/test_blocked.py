@@ -227,6 +227,8 @@ class TestRawCtrShards:
         m = write_raw_ctr_shards(d, 500, 6, 40, 2, seed=9)
         assert m["meta"]["num_fields"] == 6
         assert read_ctr_meta(d)["seed"] == 9
+        # provenance: i.i.d. draws record no tuple table
+        assert read_ctr_meta(d)["num_distinct_tuples"] is None
         assert resolve_ctr_fields(d, 0) == 6
         assert resolve_ctr_fields(d, 6) == 6  # explicit cfg, agreeing
         # an explicit cfg.ctr_fields that CONTRADICTS the manifest is a
