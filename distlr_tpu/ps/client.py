@@ -49,6 +49,16 @@ def _load():
             ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
             ctypes.c_void_p, ctypes.c_uint64,
         ]
+        for name in ("kv_push_vpk", "kv_pull_vpk", "kv_push_pull_vpk"):
+            fn = getattr(lib, name)
+            fn.restype = ctypes.c_int
+            fn.argtypes = (
+                [ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+                 ctypes.c_uint64, ctypes.c_uint64]
+                if name != "kv_push_pull_vpk" else
+                [ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+                 ctypes.c_void_p, ctypes.c_uint64, ctypes.c_uint64]
+            )
         lib.kv_push_init.restype = ctypes.c_int
         lib.kv_push_init.argtypes = [
             ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
@@ -85,6 +95,7 @@ class KVWorker:
         lib = _load()
         self._lib = lib
         self.dim = dim
+        self.num_servers = hosts.count(",") + 1
         self._h = lib.kv_connect(hosts.encode(), dim, client_id)
         if not self._h:
             raise ConnectionError(f"could not connect to KV servers at {hosts}")
@@ -113,31 +124,72 @@ class KVWorker:
             raise IOError(f"KV {what} failed: {err}")
         return ts
 
-    def _validate_keys(self, keys: np.ndarray) -> np.ndarray:
+    def _validate_keys(self, keys: np.ndarray, vpk: int = 1) -> np.ndarray:
         """The native range-slicer requires strictly ascending in-range
         keys (it binary-searches range boundaries); reject violations
-        here rather than returning silently-wrong slices."""
+        here rather than returning silently-wrong slices.  With
+        ``vpk > 1`` keys are row ids over a ``dim // vpk`` row space."""
         keys = np.ascontiguousarray(keys, dtype=np.uint64)
+        space = self.dim // vpk
         if keys.size:
             kmax = int(keys.max())  # unsigned max, not last element
-            if kmax >= self.dim:
-                raise ValueError(f"key {kmax} out of range (dim={self.dim})")
+            if kmax >= space:
+                raise ValueError(
+                    f"key {kmax} out of range (dim={self.dim}"
+                    + (f", vals_per_key={vpk} -> {space} rows)" if vpk > 1
+                       else ")"))
             if keys.size > 1 and not (keys[1:] > keys[:-1]).all():
                 raise ValueError("keys must be strictly ascending")
         return keys
 
-    def push(self, vals: np.ndarray, keys: np.ndarray | None = None) -> int:
+    def supports_vals_per_key(self, vpk: int) -> bool:
+        """Whether ``vals_per_key=vpk`` ops can be range-sliced over this
+        server group: every range boundary (``dim*s/S``) must be a
+        multiple of vpk so no row straddles two servers.  Callers for
+        whom this is False should send expanded per-lane keys instead."""
+        if vpk <= 1:
+            return True
+        if self.dim % vpk != 0:
+            return False
+        return all((self.dim * s // self.num_servers) % vpk == 0
+                   for s in range(1, self.num_servers))
+
+    def _default_or_validated(self, keys, vpk: int) -> np.ndarray:
+        """Resolve the keys argument: the dense default 0..D-1 set is a
+        FLAT key set — combining it with ``vals_per_key > 1`` would
+        silently reinterpret flat ids as row ids (most falling outside
+        every server's row range and never being sent), so that
+        combination is rejected rather than returning garbage."""
+        if keys is None:
+            if vpk != 1:
+                raise ValueError(
+                    "vals_per_key > 1 requires explicit row keys (the "
+                    "dense default key set is flat ids, not rows)")
+            return self._all_keys
+        return self._validate_keys(keys, vpk)
+
+    def push(self, vals: np.ndarray, keys: np.ndarray | None = None,
+             *, vals_per_key: int = 1) -> int:
         """Blocking push; in sync mode returns only after ALL workers
-        pushed (the server's deferred reply = BSP barrier)."""
-        vals = np.ascontiguousarray(vals, dtype=np.float32)
-        keys = self._all_keys if keys is None else self._validate_keys(keys)
-        if vals.shape[0] != keys.shape[0]:
-            raise ValueError(f"{vals.shape[0]} vals vs {keys.shape[0]} keys")
-        ts = self._lib.kv_push(
+        pushed (the server's deferred reply = BSP barrier).
+
+        ``vals_per_key=R``: keys are R-lane ROW ids (each owns flat
+        slots ``[k*R, (k+1)*R)``) and ``vals`` holds ``len(keys)*R``
+        floats row-major — one u64 of key per R values on the wire
+        instead of R expanded keys (the blocked CTR path's encoding;
+        requires :meth:`supports_vals_per_key`)."""
+        vals = np.ascontiguousarray(vals, dtype=np.float32).reshape(-1)
+        vpk = int(vals_per_key)
+        keys = self._default_or_validated(keys, vpk)
+        if vals.shape[0] != keys.shape[0] * vpk:
+            raise ValueError(
+                f"{vals.shape[0]} vals vs {keys.shape[0]} keys "
+                f"x vals_per_key {vpk}")
+        ts = self._lib.kv_push_vpk(
             self._h,
             keys.ctypes.data_as(ctypes.c_void_p),
             vals.ctypes.data_as(ctypes.c_void_p),
-            keys.shape[0],
+            keys.shape[0], vpk,
         )
         return self._check(ts, "push")
 
@@ -162,36 +214,45 @@ class KVWorker:
         return self._check(ts, "push_init")
 
     def push_pull(self, vals: np.ndarray,
-                  keys: np.ndarray | None = None) -> np.ndarray:
+                  keys: np.ndarray | None = None,
+                  *, vals_per_key: int = 1) -> np.ndarray:
         """Fused push+pull: push a gradient and receive the post-update
         weights for the same keys in ONE round trip per server (the
         reference protocol spends two per batch, ``src/lr.cc:116-132``).
         Sync mode: blocks through the BSP round like a push, and the
         returned weights are the post-round state — bit-identical to the
-        pull that would have followed."""
-        vals = np.ascontiguousarray(vals, dtype=np.float32)
-        keys = self._all_keys if keys is None else self._validate_keys(keys)
-        if vals.shape[0] != keys.shape[0]:
-            raise ValueError(f"{vals.shape[0]} vals vs {keys.shape[0]} keys")
-        out = np.empty(keys.shape[0], dtype=np.float32)
-        ts = self._lib.kv_push_pull(
+        pull that would have followed.  ``vals_per_key``: see
+        :meth:`push`."""
+        vpk = int(vals_per_key)
+        vals = np.ascontiguousarray(vals, dtype=np.float32).reshape(-1)
+        keys = self._default_or_validated(keys, vpk)
+        if vals.shape[0] != keys.shape[0] * vpk:
+            raise ValueError(
+                f"{vals.shape[0]} vals vs {keys.shape[0]} keys "
+                f"x vals_per_key {vpk}")
+        out = np.empty(keys.shape[0] * vpk, dtype=np.float32)
+        ts = self._lib.kv_push_pull_vpk(
             self._h,
             keys.ctypes.data_as(ctypes.c_void_p),
             vals.ctypes.data_as(ctypes.c_void_p),
             out.ctypes.data_as(ctypes.c_void_p),
-            keys.shape[0],
+            keys.shape[0], vpk,
         )
         self._check(ts, "push_pull")
         return out
 
-    def pull(self, keys: np.ndarray | None = None) -> np.ndarray:
-        keys = self._all_keys if keys is None else self._validate_keys(keys)
-        out = np.empty(keys.shape[0], dtype=np.float32)
-        ts = self._lib.kv_pull(
+    def pull(self, keys: np.ndarray | None = None,
+             *, vals_per_key: int = 1) -> np.ndarray:
+        """Blocking pull.  ``vals_per_key=R``: keys are row ids and the
+        result holds ``len(keys)*R`` floats row-major (see :meth:`push`)."""
+        vpk = int(vals_per_key)
+        keys = self._default_or_validated(keys, vpk)
+        out = np.empty(keys.shape[0] * vpk, dtype=np.float32)
+        ts = self._lib.kv_pull_vpk(
             self._h,
             keys.ctypes.data_as(ctypes.c_void_p),
             out.ctypes.data_as(ctypes.c_void_p),
-            keys.shape[0],
+            keys.shape[0], vpk,
         )
         self._check(ts, "pull")
         return out

@@ -143,14 +143,19 @@ int ConnectTo(const std::string& host, int port, int timeout_ms) {
   return fd;
 }
 
-// Slice [keys, keys+n) (sorted ascending, global ids) into per-server
-// contiguous sub-ranges.  Returns per-server (begin_idx, end_idx).
+// Slice [keys, keys+n) (sorted ascending, global ids in units of
+// vpk-wide rows) into per-server contiguous sub-ranges.  Returns
+// per-server (begin_idx, end_idx).  With vpk > 1 the servers' flat
+// ranges are divided into row space — the caller has already validated
+// divisibility (see RoundTrip).
 std::vector<std::pair<uint64_t, uint64_t>> SliceByRange(
-    const Client& c, const Key* keys, uint64_t n) {
+    const Client& c, const Key* keys, uint64_t n, uint64_t vpk) {
   std::vector<std::pair<uint64_t, uint64_t>> out(c.servers.size());
   for (size_t s = 0; s < c.servers.size(); ++s) {
-    const Key* lo = std::lower_bound(keys, keys + n, c.servers[s].range_begin);
-    const Key* hi = std::lower_bound(keys, keys + n, c.servers[s].range_end);
+    const Key* lo =
+        std::lower_bound(keys, keys + n, c.servers[s].range_begin / vpk);
+    const Key* hi =
+        std::lower_bound(keys, keys + n, c.servers[s].range_end / vpk);
     out[s] = {static_cast<uint64_t>(lo - keys), static_cast<uint64_t>(hi - keys)};
   }
   return out;
@@ -158,7 +163,7 @@ std::vector<std::pair<uint64_t, uint64_t>> SliceByRange(
 
 int RoundTrip(Client* c, Op op, const Key* keys, const float* vals,
               float* out_vals, uint64_t n, uint8_t flags = kNone,
-              uint16_t barrier_id = 0) {
+              uint16_t barrier_id = 0, uint64_t vpk = 1) {
   c->timed_out = false;
   if (c->poisoned) {
     snprintf(c->err, sizeof(c->err),
@@ -166,8 +171,31 @@ int RoundTrip(Client* c, Op op, const Key* keys, const float* vals,
              "reconnect (kv_connect) before issuing more ops");
     return -1;
   }
+  if (vpk < 1 || vpk > kMaxValsPerKey) {
+    snprintf(c->err, sizeof(c->err),
+             "vals_per_key %llu outside [1, %llu]",
+             (unsigned long long)vpk, (unsigned long long)kMaxValsPerKey);
+    return -1;
+  }
+  if (vpk > 1) {
+    // A row's whole [k*vpk, (k+1)*vpk) range must live on ONE server:
+    // every range boundary (dim*s/S by construction) must be a
+    // multiple of vpk, or rows would straddle servers and the per-row
+    // wire encoding could not be range-sliced.  Callers for whom this
+    // fails should fall back to expanded per-lane keys.
+    for (auto& sc : c->servers) {
+      if (sc.range_begin % vpk != 0 || sc.range_end % vpk != 0) {
+        snprintf(c->err, sizeof(c->err),
+                 "server range [%llu, %llu) not aligned to vals_per_key "
+                 "%llu; use expanded keys instead",
+                 (unsigned long long)sc.range_begin,
+                 (unsigned long long)sc.range_end, (unsigned long long)vpk);
+        return -1;
+      }
+    }
+  }
   const uint32_t ts = c->next_ts++;
-  auto slices = SliceByRange(*c, keys, n);
+  auto slices = SliceByRange(*c, keys, n, vpk);
 
   // A PUSH visits EVERY server even when its key slice is empty: in sync
   // mode the server releases the BSP barrier only after num_workers
@@ -182,21 +210,28 @@ int RoundTrip(Client* c, Op op, const Key* keys, const float* vals,
   const bool visit_all = is_push && c->push_visit_all;
 
   // Phase 1: send the sliced request to every involved server.
+  // The op-specific 16-bit header field (kv_protocol.h MsgHeader::aux)
+  // carries the barrier generation for kBarrier and vals_per_key for
+  // the keyed ops.
+  const uint16_t aux =
+      op == Op::kBarrier ? barrier_id : static_cast<uint16_t>(vpk);
   std::vector<std::vector<Key>> local_keys(c->servers.size());
   for (size_t s = 0; s < c->servers.size(); ++s) {
     const auto [b, e] = slices[s];
     if (b == e && !visit_all && !(op == Op::kBarrier && s == 0)) continue;
-    MsgHeader h{kMagic, static_cast<uint8_t>(op), flags, barrier_id,
+    MsgHeader h{kMagic, static_cast<uint8_t>(op), flags, aux,
                 c->client_id, ts, e - b};
     auto& lk = local_keys[s];
     lk.resize(e - b);
-    for (uint64_t i = b; i < e; ++i)
-      lk[i - b] = keys[i] - c->servers[s].range_begin;  // DecodeKey rebase
+    // DecodeKey rebase — in row units when vpk > 1 (range_begin is
+    // vpk-aligned, validated above)
+    const Key rebase = c->servers[s].range_begin / vpk;
+    for (uint64_t i = b; i < e; ++i) lk[i - b] = keys[i] - rebase;
     const int fd = c->servers[s].fd;
     if (!WriteFull(fd, &h, sizeof(h)) ||
         (h.num_keys && !WriteFull(fd, lk.data(), lk.size() * sizeof(Key))) ||
         (is_push && h.num_keys &&
-         !WriteFull(fd, vals + b, (e - b) * sizeof(Val)))) {
+         !WriteFull(fd, vals + b * vpk, (e - b) * vpk * sizeof(Val)))) {
       c->poisoned = true;  // peers already received slices of this ts
       snprintf(c->err, sizeof(c->err), "send to server %zu failed", s);
       return -1;
@@ -238,7 +273,7 @@ int RoundTrip(Client* c, Op op, const Key* keys, const float* vals,
     // bad frame demand an arbitrary allocation, and a bad_alloc
     // escaping this extern "C" boundary would terminate the worker.
     const uint64_t expected =
-        (op == Op::kPull || op == Op::kPushPull) ? (e - b) : 0;
+        (op == Op::kPull || op == Op::kPushPull) ? (e - b) * vpk : 0;
     if (rh.num_keys != expected) {
       c->poisoned = true;
       snprintf(c->err, sizeof(c->err),
@@ -248,7 +283,7 @@ int RoundTrip(Client* c, Op op, const Key* keys, const float* vals,
     if (expected) {
       bool ok;
       if (out_vals != nullptr) {
-        ok = ReadFull(c->servers[s].fd, out_vals + b,
+        ok = ReadFull(c->servers[s].fd, out_vals + b * vpk,
                       expected * sizeof(Val));
       } else {
         // Caller doesn't want the weights (push_pull with a null out is
@@ -353,6 +388,35 @@ int kv_push_pull(void* handle, const uint64_t* keys, const float* vals,
                  float* out_vals, uint64_t n) {
   auto* c = static_cast<distlr::Client*>(handle);
   return distlr::RoundTrip(c, distlr::Op::kPushPull, keys, vals, out_vals, n);
+}
+
+// --- vals_per_key variants (ps-lite KVPairs.lens, uniform): each key
+// addresses `vpk` consecutive flat slots starting at key*vpk; keys are
+// in row units, vals/out_vals hold n*vpk floats in row-major order.
+// The row-blocked CTR path ships one u64 per R-lane table row this way
+// instead of R expanded keys (~2.7x fewer keyed wire bytes at R=32).
+// Requires every server range boundary to be a multiple of vpk (always
+// true when (dim/S) % vpk == 0); otherwise the op fails with a named
+// error and the caller should fall back to expanded keys. ---
+int kv_push_vpk(void* handle, const uint64_t* keys, const float* vals,
+                uint64_t n, uint64_t vpk) {
+  auto* c = static_cast<distlr::Client*>(handle);
+  return distlr::RoundTrip(c, distlr::Op::kPush, keys, vals, nullptr, n,
+                           distlr::kNone, 0, vpk);
+}
+
+int kv_pull_vpk(void* handle, const uint64_t* keys, float* out_vals,
+                uint64_t n, uint64_t vpk) {
+  auto* c = static_cast<distlr::Client*>(handle);
+  return distlr::RoundTrip(c, distlr::Op::kPull, keys, nullptr, out_vals, n,
+                           distlr::kNone, 0, vpk);
+}
+
+int kv_push_pull_vpk(void* handle, const uint64_t* keys, const float* vals,
+                     float* out_vals, uint64_t n, uint64_t vpk) {
+  auto* c = static_cast<distlr::Client*>(handle);
+  return distlr::RoundTrip(c, distlr::Op::kPushPull, keys, vals, out_vals, n,
+                           distlr::kNone, 0, vpk);
 }
 
 // Receive timeout for every pending/future op, in milliseconds; 0

@@ -9,9 +9,21 @@
 // this — it is lax.psum over ICI).
 //
 // Frame layout (little-endian, no padding):
-//   MsgHeader { magic, op, flags, client_id, timestamp, num_keys }
+//   MsgHeader { magic, op, flags, aux, client_id, timestamp, num_keys }
 //   then num_keys * u64 keys
-//   then (op == PUSH || (op == PULL && is_response)) num_keys * f32 vals
+//   then (op == PUSH || (op == PULL && is_response))
+//        num_keys * vals_per_key * f32 vals
+//
+// vals_per_key (the header's aux field for kPush/kPull/kPushPull;
+// 0 == 1 == legacy scalar keys): each key addresses vals_per_key
+// CONSECUTIVE slots of the flat parameter space, starting at
+// key * vals_per_key — ps-lite's KVPairs.lens capability (uniform
+// lens), which the row-blocked CTR path uses to ship one u64 row id
+// per R-lane table row instead of R expanded keys (the expanded
+// encoding spends 8 bytes of key per 4 bytes of value; at R=32 the
+// multi-val encoding cuts keyed wire bytes ~2.7x).  The server
+// expands at the parsing layer, so merge/barrier/rollback semantics
+// are byte-identical to a client that expanded the keys itself.
 //
 // Semantics mirror the reference server handle (src/main.cc:41-96):
 //   * first PUSH initializes server weights (src/main.cc:50-56)
@@ -86,17 +98,25 @@ struct MsgHeader {
   uint32_t magic;
   uint8_t op;
   uint8_t flags;
-  // For Op::kBarrier: the barrier GENERATION id.  Barriers are counted
-  // per id, and an id that has already released replies instantly to
-  // late votes — so a restarted worker re-voting the startup barrier
-  // (id 0) can never pair with peers' exit-barrier votes (id 1), and
-  // never hangs regardless of when its predecessor crashed.
-  uint16_t reserved;
+  // Op-specific 16-bit field:
+  //   kBarrier — the barrier GENERATION id.  Barriers are counted per
+  //   id, and an id that has already released replies instantly to
+  //   late votes — so a restarted worker re-voting the startup barrier
+  //   (id 0) can never pair with peers' exit-barrier votes (id 1), and
+  //   never hangs regardless of when its predecessor crashed.
+  //   kPush/kPull/kPushPull — vals_per_key (0 == 1 == scalar keys); see
+  //   the frame-layout comment above.
+  uint16_t aux;
   uint32_t client_id;
   uint32_t timestamp;   // per-client op sequence number (ps-lite ts)
   uint64_t num_keys;
 };
 #pragma pack(pop)
+
+// Wire-corruption guard for vals_per_key: large enough for any
+// realistic row width (the blocked path uses R in {8, 16, 32}), small
+// enough to reject essentially all random u16s.
+constexpr uint64_t kMaxValsPerKey = 4096;
 
 static_assert(sizeof(MsgHeader) == 24, "MsgHeader must be 24 bytes");
 

@@ -203,49 +203,83 @@ class KVServer {
 
   void ServeLoop(int fd) {
     std::vector<Key> keys;
+    std::vector<Key> expanded;
     std::vector<Val> vals;
     while (true) {
       MsgHeader h{};
       if (!ReadFull(fd, &h, sizeof(h)) || h.magic != kMagic) break;
+      const Op op = static_cast<Op>(h.op);
+      // vals_per_key (kv_protocol.h): each key addresses vpk consecutive
+      // flat slots starting at key*vpk.  Expansion happens HERE, at the
+      // parsing layer, so every handler below (merge, barrier release,
+      // disconnect rollback) sees exactly the per-lane keys a legacy
+      // client would have sent — the semantics cannot diverge.
+      const bool keyed_op =
+          op == Op::kPush || op == Op::kPull || op == Op::kPushPull;
+      const uint64_t vpk = keyed_op && h.aux > 1 ? h.aux : 1;
       // Wire values size allocations, so garbage must DROP the
-      // connection, never kill the server: a corrupt num_keys or key id
-      // is an essentially random u64, and resize(2^50) would bad_alloc
-      // the whole group member (the supervisor would then respawn it
-      // for no reason).  The magic check alone cannot catch a frame
-      // whose header is intact but whose counts are corrupt.  Guards:
-      // num_keys capped by max_dim_ AND read chunk-by-chunk (see
-      // ReadChunked), every key id capped by max_dim_, and capacity
-      // grown to the frame's MAX key — not its last, the wire does not
-      // promise sorted keys, and an unsorted frame passing a
-      // back()-based bound would be an out-of-bounds heap write.
-      if (h.num_keys > max_dim_) {
+      // connection, never kill the server: a corrupt num_keys, key id,
+      // or vals_per_key is an essentially random integer, and
+      // resize(2^50) would bad_alloc the whole group member (the
+      // supervisor would then respawn it for no reason).  The magic
+      // check alone cannot catch a frame whose header is intact but
+      // whose counts are corrupt.  Guards: vals_per_key capped
+      // (kMaxValsPerKey), num_keys * vals_per_key capped by max_dim_
+      // AND read chunk-by-chunk (see ReadChunked), every EXPANDED key
+      // id capped by max_dim_, and capacity grown to the frame's MAX
+      // key — not its last, the wire does not promise sorted keys, and
+      // an unsorted frame passing a back()-based bound would be an
+      // out-of-bounds heap write.
+      if (vpk > kMaxValsPerKey || h.num_keys > max_dim_ / vpk) {
         std::fprintf(stderr,
                      "[distlr_kv_server] dropping connection: frame "
-                     "num_keys %llu exceeds max_dim %llu\n",
+                     "num_keys %llu x vals_per_key %llu exceeds "
+                     "max_dim %llu\n",
                      (unsigned long long)h.num_keys,
+                     (unsigned long long)vpk,
                      (unsigned long long)max_dim_);
         break;
       }
       if (!ReadChunked(fd, keys, h.num_keys)) break;
+      // a key's WHOLE expanded range [k*vpk, (k+1)*vpk) must fit below
+      // max_dim_: k < max_dim_ / vpk  =>  k*vpk + vpk - 1 < max_dim_
+      const Key key_cap = max_dim_ / vpk;
       Key max_key = 0;
       bool keys_ok = true;
       for (uint64_t i = 0; i < h.num_keys; ++i) {
-        if (keys[i] >= max_dim_) { keys_ok = false; break; }
+        if (keys[i] >= key_cap) { keys_ok = false; break; }
         if (keys[i] > max_key) max_key = keys[i];
       }
       if (!keys_ok) {
         std::fprintf(stderr,
                      "[distlr_kv_server] dropping connection: key id "
-                     "exceeds max_dim %llu\n",
-                     (unsigned long long)max_dim_);
+                     "exceeds max_dim %llu (vals_per_key %llu)\n",
+                     (unsigned long long)max_dim_,
+                     (unsigned long long)vpk);
         break;
       }
-      const Op op = static_cast<Op>(h.op);
+      const std::vector<Key>* use_keys = &keys;
+      uint64_t n_flat = h.num_keys;
+      if (vpk > 1) {
+        n_flat = h.num_keys * vpk;
+        expanded.resize(n_flat);
+        for (uint64_t i = 0; i < h.num_keys; ++i) {
+          const Key base = keys[i] * vpk;
+          for (uint64_t j = 0; j < vpk; ++j) expanded[i * vpk + j] = base + j;
+        }
+        max_key = max_key * vpk + vpk - 1;
+        use_keys = &expanded;
+      }
+      // Handlers reply with h.num_keys-independent sizes (vals counts),
+      // but the echoed header must describe the EXPANDED frame so
+      // deferred-release bookkeeping stays uniform.
+      MsgHeader hf = h;
+      hf.num_keys = n_flat;
       if (op == Op::kPush || op == Op::kPushPull) {
-        if (!ReadChunked(fd, vals, h.num_keys)) break;
-        HandlePush(fd, h, keys, vals, max_key, op == Op::kPushPull);
+        if (!ReadChunked(fd, vals, n_flat)) break;
+        HandlePush(fd, hf, *use_keys, vals, max_key, op == Op::kPushPull);
       } else if (op == Op::kPull) {
-        HandlePull(fd, h, keys, max_key);
+        HandlePull(fd, hf, *use_keys, max_key);
       } else if (op == Op::kBarrier) {
         HandleBarrier(fd, h);
       } else if (op == Op::kStats) {
@@ -496,13 +530,13 @@ class KVServer {
   }
 
   // --- BARRIER: Postoffice::Barrier equivalent (src/main.cc:150),
-  // counted per GENERATION id (h.reserved; see kv_protocol.h).  A vote
+  // counted per GENERATION id (h.aux; see kv_protocol.h).  A vote
   // for an id that already released replies instantly, so restarted
   // workers re-voting an old generation neither hang nor contaminate a
   // later barrier's count. ---
   void HandleBarrier(int fd, const MsgHeader& h) {
     std::lock_guard<std::mutex> lock(mu_);
-    const uint16_t id = h.reserved;
+    const uint16_t id = h.aux;
     if (released_barriers_.count(id)) {
       Respond(fd, h, nullptr, 0);
       return;

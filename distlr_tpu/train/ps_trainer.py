@@ -549,11 +549,25 @@ class PSWorker:
                 # (sparse) / R-wide block-row key ranges (blocked) travel —
                 # ps-lite's sliced-key capability, SURVEY.md §2.2 E1.d/g,
                 # which the reference app itself never exercises.
+                # Blocked rows prefer the vals_per_key wire encoding
+                # (one u64 row id per R-lane row, ps-lite lens-style —
+                # ~2.7x fewer keyed bytes at R=32 than R expanded keys);
+                # groups whose range boundaries don't align to R fall
+                # back to the expanded encoding, bit-identical
+                # semantics either way (the server expands at parse
+                # time onto the same code paths).
+                vpk = (cfg.block_size
+                       if blocked and self.kv.supports_vals_per_key(
+                           cfg.block_size)
+                       else 1)
+
                 def prep(b):
                     ids = b[0]
                     ub, pos = np.unique(ids, return_inverse=True)
-                    keys = (_expand_block_keys(ub, cfg.block_size) if blocked
-                            else ub.astype(np.uint64))
+                    if blocked and vpk == 1:
+                        keys = _expand_block_keys(ub, cfg.block_size)
+                    else:
+                        keys = ub.astype(np.uint64)
                     return keys, (pos.reshape(ids.shape), *b[1:])
 
                 def kgrad(w_u, rest):
@@ -581,8 +595,9 @@ class PSWorker:
                 # trip (pull and push key sets differ per batch).
                 for b in train:
                     keys, rest = prep(b)
-                    w_u = self.kv.pull(keys=keys)
-                    self.kv.wait(self.kv.push(kgrad(w_u, rest), keys=keys))
+                    w_u = self.kv.pull(keys=keys, vals_per_key=vpk)
+                    self.kv.wait(self.kv.push(kgrad(w_u, rest), keys=keys,
+                                              vals_per_key=vpk))
             elif not cfg.ps_pipeline:
                 # Reference-faithful serialized protocol: two blocking
                 # round trips per batch (src/lr.cc:116-132).
@@ -689,7 +704,11 @@ class PSWorker:
         R = self.cfg.block_size
         ub = np.unique(blocks)
         t = np.zeros((self.cfg.num_feature_dim // R, R), np.float32)
-        t[ub] = self.kv.pull(keys=_expand_block_keys(ub, R)).reshape(len(ub), R)
+        if self.kv.supports_vals_per_key(R):
+            pulled = self.kv.pull(keys=ub.astype(np.uint64), vals_per_key=R)
+        else:
+            pulled = self.kv.pull(keys=_expand_block_keys(ub, R))
+        t[ub] = pulled.reshape(len(ub), R)
         z = (t[blocks] * lane_vals).sum(axis=(-1, -2))
         return self._eval_from_logits(z, y, mask)
 

@@ -594,12 +594,43 @@ class TestProtocolModelBased:
                 kv.wait(kv.push_init(ref.copy()))
                 pushes = pulls = 0
                 for _ in range(n_ops):
-                    op = rng.choice(["push", "pull", "push_pull", "stats"])
+                    op = rng.choice(["push", "pull", "push_pull", "stats",
+                                     "push_vpk", "pull_vpk"])
                     k = np.sort(rng.choice(
                         dim, size=int(rng.integers(1, dim + 1)),
                         replace=False)).astype(np.uint64)
                     v = rng.standard_normal(k.size).astype(np.float32)
-                    if op == "push":
+                    if op in ("push_vpk", "pull_vpk"):
+                        # multi-val row keys (vals_per_key): exercised
+                        # only where the group's ranges align (S=1/2 at
+                        # dim=32); elsewhere the op maps to the expanded
+                        # encoding — the same fallback decision the
+                        # blocked trainer makes
+                        vpk = int(rng.choice([4, 8]))
+                        space = dim // vpk
+                        rows = np.sort(rng.choice(
+                            space, size=int(rng.integers(1, space + 1)),
+                            replace=False)).astype(np.uint64)
+                        flat = (rows[:, None] * vpk
+                                + np.arange(vpk, dtype=np.uint64)).reshape(-1)
+                        use_vpk = kv.supports_vals_per_key(vpk)
+                        if op == "push_vpk":
+                            g_v = rng.standard_normal(
+                                flat.size).astype(np.float32)
+                            if use_vpk:
+                                kv.wait(kv.push(g_v, keys=rows,
+                                                vals_per_key=vpk))
+                            else:
+                                kv.wait(kv.push(g_v, keys=flat))
+                            ref[flat] -= lr * g_v
+                            pushes += 1
+                        else:
+                            got = (kv.pull(keys=rows, vals_per_key=vpk)
+                                   if use_vpk else kv.pull(keys=flat))
+                            np.testing.assert_allclose(
+                                got, ref[flat], rtol=1e-5, atol=1e-5)
+                            pulls += 1
+                    elif op == "push":
                         kv.wait(kv.push(v, keys=k))
                         ref[k] -= lr * v
                         pushes += 1
@@ -628,3 +659,137 @@ class TestProtocolModelBased:
                 np.testing.assert_allclose(kv.pull(), ref,
                                            rtol=1e-5, atol=1e-5)
                 kv.shutdown_servers()
+
+
+class TestValsPerKey:
+    """vals_per_key wire encoding (ps-lite KVPairs.lens, uniform): one
+    u64 row id addresses R consecutive flat slots.  Semantics must be
+    bit-identical to expanded per-lane keys — the server expands at the
+    parsing layer onto the same handlers."""
+
+    def test_pull_matches_expanded(self):
+        # dim=64 over 2 servers -> ranges [0,32) [32,64), R=8-aligned
+        with ServerGroup(2, 1, dim=64) as sg, KVWorker(sg.hosts, 64) as kv:
+            init = np.arange(64, dtype=np.float32)
+            kv.push(init)
+            rows = np.array([0, 3, 4, 7], dtype=np.uint64)  # crosses boundary
+            expanded = (rows[:, None] * 8 + np.arange(8, dtype=np.uint64)
+                        ).reshape(-1)
+            np.testing.assert_array_equal(
+                kv.pull(keys=rows, vals_per_key=8), kv.pull(keys=expanded))
+
+    def test_push_matches_expanded(self):
+        def run(use_vpk):
+            with ServerGroup(1, 1, dim=64, sync=False,
+                             learning_rate=1.0) as sg, \
+                    KVWorker(sg.hosts, 64) as kv:
+                kv.push(np.zeros(64, np.float32))  # init
+                rows = np.array([1, 5], dtype=np.uint64)
+                g = np.arange(16, dtype=np.float32)
+                if use_vpk:
+                    kv.push(g, keys=rows, vals_per_key=8)
+                else:
+                    expanded = (rows[:, None] * 8
+                                + np.arange(8, dtype=np.uint64)).reshape(-1)
+                    kv.push(g, keys=expanded)
+                return kv.pull()
+
+        np.testing.assert_array_equal(run(True), run(False))
+
+    def test_push_pull_fused_vpk(self):
+        with ServerGroup(1, 1, dim=32, sync=False, learning_rate=1.0) as sg, \
+                KVWorker(sg.hosts, 32) as kv:
+            kv.push(np.zeros(32, np.float32))  # init
+            rows = np.array([2], dtype=np.uint64)
+            g = np.ones(8, np.float32)
+            out = kv.push_pull(g, keys=rows, vals_per_key=8)
+            np.testing.assert_allclose(out, -np.ones(8))  # w -= 1*g
+            full = kv.pull()
+            np.testing.assert_allclose(full[16:24], -np.ones(8))
+            assert np.all(full[:16] == 0) and np.all(full[24:] == 0)
+
+    def test_sync_merge_mixes_vpk_and_expanded(self):
+        """Two workers of one BSP round, one pushing row keys, one
+        pushing expanded keys for the SAME slots: the merge must treat
+        them identically (server-side expansion feeds one merge path)."""
+        with ServerGroup(1, 2, dim=32, sync=True, learning_rate=1.0) as sg:
+            kv0 = KVWorker(sg.hosts, 32, client_id=0)
+            kv1 = KVWorker(sg.hosts, 32, client_id=1)
+            kv0.push(np.zeros(32, np.float32))  # init
+            rows = np.array([1], dtype=np.uint64)
+            expanded = np.arange(8, 16, dtype=np.uint64)
+            done = []
+
+            def w0():
+                kv0.push(np.full(8, 2.0, np.float32), keys=rows,
+                         vals_per_key=8)
+                done.append(0)
+
+            th = threading.Thread(target=w0)
+            th.start()
+            kv1.push(np.full(8, 4.0, np.float32), keys=expanded)
+            th.join(timeout=10)
+            assert done
+            # mean update on slots 8..16: w -= 1 * (2+4)/2
+            np.testing.assert_allclose(kv0.pull()[8:16], np.full(8, -3.0))
+            kv0.close()
+            kv1.close()
+
+    def test_supports_vals_per_key_alignment(self):
+        # dim=96 over 2 servers -> boundary 48: aligned for R=8, not R=32
+        with ServerGroup(2, 1, dim=96) as sg, KVWorker(sg.hosts, 96) as kv:
+            assert kv.supports_vals_per_key(8)
+            assert not kv.supports_vals_per_key(32)
+            assert kv.supports_vals_per_key(1)
+            # the client refuses an unaligned vpk op with a named error
+            kv.push(np.zeros(96, np.float32))
+            with pytest.raises(IOError, match="aligned|expanded"):
+                kv.pull(keys=np.array([0], dtype=np.uint64), vals_per_key=32)
+
+    def test_dense_default_keys_reject_vpk(self):
+        """keys=None is the FLAT dense key set; combining it with
+        vals_per_key > 1 must raise instead of silently reinterpreting
+        flat ids as row ids (r5 review finding)."""
+        with ServerGroup(1, 1, dim=64) as sg, KVWorker(sg.hosts, 64) as kv:
+            kv.push(np.zeros(64, np.float32))
+            with pytest.raises(ValueError, match="row keys"):
+                kv.pull(vals_per_key=8)
+            with pytest.raises(ValueError, match="row keys"):
+                kv.push(np.zeros(64, np.float32), vals_per_key=8)
+
+    def test_row_key_range_validation(self):
+        with ServerGroup(1, 1, dim=64) as sg, KVWorker(sg.hosts, 64) as kv:
+            kv.push(np.zeros(64, np.float32))
+            with pytest.raises(ValueError, match="out of range"):
+                kv.pull(keys=np.array([8], dtype=np.uint64), vals_per_key=8)
+
+    def test_corrupt_vals_per_key_drops_connection_server_survives(self):
+        """A frame claiming a huge vals_per_key must drop that
+        connection (allocation guard), leaving the server serving other
+        clients — same never-kill-the-rank contract as the other
+        corruption guards."""
+        import socket
+        import struct
+
+        with ServerGroup(1, 1, dim=32) as sg:
+            kv = KVWorker(sg.hosts, 32)
+            kv.push(np.zeros(32, np.float32))
+            host, port = sg.hosts.split(":")
+            s = socket.create_connection((host, int(port)), timeout=5)
+            # header: magic, op=kPull, flags=0, aux=65535 (> kMaxValsPerKey),
+            # client_id, ts, num_keys=1
+            s.sendall(struct.pack("<IBBHII Q".replace(" ", ""),
+                                  0xD157C0DE, 2, 0, 65535, 99, 0, 1))
+            s.sendall(struct.pack("<Q", 0))
+            # server must close this connection without replying — as a
+            # clean FIN (recv -> b"") or an RST (reset error) depending
+            # on whether our key bytes were still unread at close time
+            s.settimeout(5)
+            try:
+                assert s.recv(1) == b""
+            except ConnectionResetError:
+                pass
+            s.close()
+            # and keep serving the legitimate client
+            np.testing.assert_array_equal(kv.pull(), np.zeros(32))
+            kv.close()
