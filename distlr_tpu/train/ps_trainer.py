@@ -560,6 +560,13 @@ class PSWorker:
                        if blocked and self.kv.supports_vals_per_key(
                            cfg.block_size)
                        else 1)
+                if blocked and epoch == start_epoch:
+                    # visible (and test-assertable) record of which wire
+                    # encoding the keyed rounds actually used
+                    log.info(
+                        "rank %d keyed wire encoding: %s", self.rank,
+                        f"vals_per_key={vpk}" if vpk > 1
+                        else "expanded per-lane keys")
 
                 def prep(b):
                     ids = b[0]
