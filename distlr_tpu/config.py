@@ -73,6 +73,7 @@ class Config:
 
     # ---- model ----
     model: str = "binary_lr"          # binary_lr | softmax | sparse_lr
+    #                                 | sparse_softmax | blocked_lr
     num_classes: int = 2              # softmax only
     nnz_max: int | None = None        # sparse_lr: cap per-row nonzeros (pad width)
     # blocked_lr: lanes per table row (params = num_feature_dim, rows =
@@ -192,7 +193,8 @@ class Config:
             self.reference_rng_init = ref
         if self.wrap_final_batch is None:
             self.wrap_final_batch = ref
-        if self.model not in ("binary_lr", "softmax", "sparse_lr", "blocked_lr"):
+        if self.model not in ("binary_lr", "softmax", "sparse_lr",
+                              "sparse_softmax", "blocked_lr"):
             raise ValueError(f"unknown model {self.model!r}")
         if self.block_size < 0 or (
             self.block_size == 0 and self.model != "blocked_lr"
@@ -230,7 +232,8 @@ class Config:
         # (int8_dot + feature_shards > 1 is supported since r4: both the
         # psum and ring feature-sharded steps feed the native int8
         # contraction — parallel/feature_parallel.partial_logits.)
-        if self.model in ("sparse_lr", "blocked_lr") and self.feature_dtype != "float32":
+        if self.model in ("sparse_lr", "sparse_softmax", "blocked_lr"
+                          ) and self.feature_dtype != "float32":
             # Quantized resident feature storage is a dense-matrix
             # capability; sparse COO / blocked lane vals stay float32 in
             # every mode. Fail here so sync and PS reject identically.

@@ -131,14 +131,16 @@ class SparseDataIter(DataIter):
 
     @classmethod
     def from_file(cls, path, num_features: int | None = None, batch_size: int = -1,
-                  *, nnz_max: int | None = None, **kw):
+                  *, nnz_max: int | None = None, multiclass: bool = False,
+                  **kw):
         """Parse a libsvm shard WITHOUT densifying (CTR-scale feature
-        spaces where ``(N, D)`` dense would not fit host RAM)."""
+        spaces where ``(N, D)`` dense would not fit host RAM).
+        ``multiclass`` keeps integer labels verbatim (sparse_softmax)."""
         from distlr_tpu.data.hashing import csr_to_padded_coo  # noqa: PLC0415
         from distlr_tpu.data.libsvm import parse_libsvm_file  # noqa: PLC0415
 
         (row_ptr, csr_cols, csr_vals), y = parse_libsvm_file(
-            path, num_features, dense=False
+            path, num_features, dense=False, multiclass=multiclass
         )
         cols, vals = csr_to_padded_coo(row_ptr, csr_cols, csr_vals, nnz_max=nnz_max)
         return cls(cols, vals, y, batch_size, **kw)

@@ -40,7 +40,8 @@ def _add_config_flags(p: argparse.ArgumentParser) -> None:
     p.add_argument("--learning-rate", dest="learning_rate", type=float)
     p.add_argument("--l2-c", dest="l2_c", type=float)
     p.add_argument("--test-interval", dest="test_interval", type=int)
-    p.add_argument("--model", choices=["binary_lr", "softmax", "sparse_lr", "blocked_lr"])
+    p.add_argument("--model", choices=["binary_lr", "softmax", "sparse_lr",
+                                       "sparse_softmax", "blocked_lr"])
     p.add_argument("--num-classes", dest="num_classes", type=int)
     p.add_argument("--nnz-max", dest="nnz_max", type=int,
                    help="sparse_lr: cap per-row nonzeros (pad width)")

@@ -3,5 +3,6 @@ from distlr_tpu.models.linear import (  # noqa: F401
     BlockedSparseLR,
     SoftmaxRegression,
     SparseBinaryLR,
+    SparseSoftmaxRegression,
     get_model,
 )

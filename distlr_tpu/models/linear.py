@@ -400,6 +400,85 @@ class SparseBinaryLR:
 
 
 @dataclasses.dataclass(frozen=True)
+class SparseSoftmaxRegression:
+    """Multinomial softmax over padded-COO sparse batches: params W of
+    shape ``(D, K)``.
+
+    The multiclass member of the CTR encoding family (the reference is
+    binary-only — ``src/lr.cc``; BASELINE.json config 5's softmax family
+    extended to the sparse path, completing the model-family x encoding
+    matrix).  A batch is ``(cols, vals, y, mask)`` like
+    :class:`SparseBinaryLR`, with integer class labels.  The forward
+    gathers one K-wide class-weight ROW per active feature — the same
+    row-gather access pattern the blocked path exploits, so TPU gather
+    cost is per-feature, not per-(feature, class) — and the gradient is
+    one ``segment_sum`` of per-feature outer contributions
+    ``vals[:, :, None] * resid[:, None, :]`` over the flattened column
+    ids.  In keyed PS mode the (D, K) rows travel as ``vals_per_key=K``
+    frames (one u64 feature id per K floats).
+    """
+
+    num_features: int
+    num_classes: int
+
+    @property
+    def param_shape(self) -> tuple[int, ...]:
+        return (self.num_features, self.num_classes)
+
+    def init(self, cfg: Config) -> jnp.ndarray:
+        shape = (self.num_features, self.num_classes)
+        if cfg.reference_rng_init:
+            flat = reference_init_weights(
+                self.num_features * self.num_classes, 0)
+            return jnp.asarray(flat.reshape(shape))
+        # zeros for the same reason as SparseBinaryLR.init: at CTR scale
+        # a positive-mean init biases every logit and SGD touches each
+        # row too rarely to unwind it
+        return jnp.zeros(shape, jnp.float32)
+
+    def logits(self, W, cols, vals):
+        # (B, F, K) gathered rows, weighted per-feature, summed over F
+        return jnp.sum(W[cols] * vals[..., None], axis=-2)
+
+    def loss(self, W, batch, cfg: Config):
+        cols, vals, y, mask = batch
+        z = self.logits(W, cols, vals)
+        ll = -jax.nn.log_softmax(z)[jnp.arange(z.shape[0]), y]
+        reg = 0.5 * cfg.l2_c * jnp.sum(W * W)
+        if cfg.l2_scale_by_batch:
+            reg = reg / jnp.maximum(jnp.sum(mask), 1)
+        return _masked_mean(ll, mask) + reg
+
+    def grad(self, W, batch, cfg: Config):
+        cols, vals, y, mask = batch
+        z = self.logits(W, cols, vals)
+        p = jax.nn.softmax(z)
+        onehot = jax.nn.one_hot(y, self.num_classes, dtype=jnp.float32)
+        resid = (p - onehot) * mask[:, None]                   # (B, K)
+        n = jnp.maximum(jnp.sum(mask), 1).astype(jnp.float32)
+        contrib = (vals[..., None] * resid[:, None, :]).reshape(
+            -1, self.num_classes)                              # (B*F, K)
+        g = jax.ops.segment_sum(
+            contrib, cols.reshape(-1), num_segments=self.num_features) / n
+        return g + _l2_grad(W, cfg, n)
+
+    def predict(self, W, cols, vals):
+        return jnp.argmax(self.logits(W, cols, vals), axis=-1).astype(jnp.int32)
+
+    def accuracy(self, W, batch):
+        cols, vals, y, mask = batch
+        correct = (self.predict(W, cols, vals) == y).astype(jnp.float32)
+        return _masked_mean(correct, mask)
+
+    def logloss(self, W, batch):
+        """Mean test cross-entropy, no L2 (see BinaryLR.logloss)."""
+        cols, vals, y, mask = batch
+        z = self.logits(W, cols, vals)
+        ll = -jax.nn.log_softmax(z)[jnp.arange(z.shape[0]), y]
+        return _masked_mean(ll, mask)
+
+
+@dataclasses.dataclass(frozen=True)
 class BlockedSparseLR:
     """Binary LR over row-aligned block batches (the row-blocked CTR
     path — see :func:`distlr_tpu.data.hashing.hash_group_blocks`).
@@ -478,6 +557,8 @@ def get_model(cfg: Config):
                                  int8_dot=cfg.feature_dtype == "int8_dot")
     if cfg.model == "sparse_lr":
         return SparseBinaryLR(cfg.num_feature_dim)
+    if cfg.model == "sparse_softmax":
+        return SparseSoftmaxRegression(cfg.num_feature_dim, cfg.num_classes)
     if cfg.model == "blocked_lr":
         if cfg.block_size == 0:
             raise ValueError(

@@ -221,6 +221,36 @@ def _sparse_batch_grad(w_u, pos, vals, y, mask, l2_c, l2_scale_by_batch):
     return g
 
 
+def _sparse_softmax_batch_grad(W_u, pos, vals, y, mask, l2_c,
+                               l2_scale_by_batch):
+    """Gradient of the sparse softmax loss wrt the batch's UNIQUE touched
+    (D, K) table rows (numpy, host-side).
+
+    Mirrors ``SparseSoftmaxRegression.grad`` (models/linear.py)
+    restricted to the touched row set: ``W_u`` is the ``(n_u, K)``
+    pulled slice, ``pos`` maps each (sample, slot) to its row.  Lazy L2
+    at ROW granularity with the same active-key discount as the binary
+    sparse path (COO padding aliases row 0 in every batch)."""
+    z = (W_u[pos] * vals[..., None]).sum(axis=1)      # (B, K)
+    z -= z.max(axis=1, keepdims=True)
+    p = np.exp(z, dtype=np.float32)
+    p /= p.sum(axis=1, keepdims=True)
+    p[np.arange(len(y)), y] -= 1.0
+    n = np.float32(max(mask.sum(), 1))
+    resid = p * np.asarray(mask, np.float32)[:, None]  # (B, K)
+    contrib = (vals[..., None] * resid[:, None, :]).reshape(
+        -1, W_u.shape[1]) / n                          # (B*F, K)
+    g = np.zeros_like(W_u, dtype=np.float32)
+    np.add.at(g, pos.ravel(), contrib)
+    if l2_c:
+        active = np.bincount(
+            pos.ravel(), weights=(vals != 0).ravel().astype(np.float32),
+            minlength=len(W_u)) > 0
+        term = np.float32(l2_c) * W_u * active[:, None]
+        g += term / n if l2_scale_by_batch else term
+    return g
+
+
 def _expand_block_keys(blocks: np.ndarray, block_size: int) -> np.ndarray:
     """Unique block-row ids -> their flat KV keys (row b owns the
     contiguous range ``[b*R, (b+1)*R)`` of the ``ps_param_dim`` key
@@ -419,10 +449,12 @@ class PSWorker:
         # we parse once and reset (same samples, no quirk).
         path = os.path.join(self.cfg.data_dir, "train", part_name(self.rank))
         wrap = bool(self.cfg.wrap_final_batch)  # Q5
-        if self.cfg.model == "sparse_lr":
-            return SparseDataIter.from_file(path, self.cfg.num_feature_dim,
-                                            self.cfg.batch_size, nnz_max=self.cfg.nnz_max,
-                                            wrap_compat=wrap)
+        if self.cfg.model in ("sparse_lr", "sparse_softmax"):
+            return SparseDataIter.from_file(
+                path, self.cfg.num_feature_dim, self.cfg.batch_size,
+                nnz_max=self.cfg.nnz_max,
+                multiclass=self.cfg.model == "sparse_softmax",
+                wrap_compat=wrap)
         if self.cfg.model == "blocked_lr":
             return self._blocked_iter(path, self.cfg.batch_size, wrap=wrap)
         return DataIter.from_file(path, self.cfg.num_feature_dim, self.cfg.batch_size,
@@ -431,9 +463,11 @@ class PSWorker:
 
     def _load_test_iter(self) -> DataIter:
         path = os.path.join(self.cfg.data_dir, "test", part_name(0))
-        if self.cfg.model == "sparse_lr":
-            return SparseDataIter.from_file(path, self.cfg.num_feature_dim, -1,
-                                            nnz_max=self.cfg.nnz_max)
+        if self.cfg.model in ("sparse_lr", "sparse_softmax"):
+            return SparseDataIter.from_file(
+                path, self.cfg.num_feature_dim, -1,
+                nnz_max=self.cfg.nnz_max,
+                multiclass=self.cfg.model == "sparse_softmax")
         if self.cfg.model == "blocked_lr":
             return self._blocked_iter(path, -1)
         return DataIter.from_file(path, self.cfg.num_feature_dim, -1,
@@ -519,8 +553,14 @@ class PSWorker:
     def _run_epochs(self, start_epoch, w0, train, test, ckpt, *, eval_fn, save):
         cfg = self.cfg
 
-        sparse = cfg.model == "sparse_lr"
+        sparse = cfg.model in ("sparse_lr", "sparse_softmax")
         blocked = cfg.model == "blocked_lr"
+        # keyed rows wider than one value: blocked tables gather R-lane
+        # rows, sparse softmax gathers K-class rows — both ride the
+        # vals_per_key wire encoding where the group's ranges align
+        row_width = (cfg.block_size if blocked
+                     else cfg.num_classes if cfg.model == "sparse_softmax"
+                     else 1)
         if not (sparse or blocked):
             # Committed inputs pin each jitted step to its device; jax.jit
             # keys its executable cache on input placement, so both
@@ -556,11 +596,11 @@ class PSWorker:
                 # back to the expanded encoding, bit-identical
                 # semantics either way (the server expands at parse
                 # time onto the same code paths).
-                vpk = (cfg.block_size
-                       if blocked and self.kv.supports_vals_per_key(
-                           cfg.block_size)
+                vpk = (row_width
+                       if row_width > 1 and self.kv.supports_vals_per_key(
+                           row_width)
                        else 1)
-                if blocked and epoch == start_epoch:
+                if row_width > 1 and epoch == start_epoch:
                     # visible (and test-assertable) record of which wire
                     # encoding the keyed rounds actually used
                     log.info(
@@ -571,8 +611,8 @@ class PSWorker:
                 def prep(b):
                     ids = b[0]
                     ub, pos = np.unique(ids, return_inverse=True)
-                    if blocked and vpk == 1:
-                        keys = _expand_block_keys(ub, cfg.block_size)
+                    if row_width > 1 and vpk == 1:
+                        keys = _expand_block_keys(ub, row_width)
                     else:
                         keys = ub.astype(np.uint64)
                     return keys, (pos.reshape(ids.shape), *b[1:])
@@ -585,6 +625,11 @@ class PSWorker:
                             y, mask, cfg.l2_c, bool(cfg.l2_scale_by_batch),
                         ).reshape(-1)
                     pos, vals, y, mask = rest
+                    if cfg.model == "sparse_softmax":
+                        return _sparse_softmax_batch_grad(
+                            w_u.reshape(-1, cfg.num_classes), pos, vals,
+                            y, mask, cfg.l2_c, bool(cfg.l2_scale_by_batch),
+                        ).reshape(-1)
                     return _sparse_batch_grad(
                         w_u, pos, vals, y, mask,
                         cfg.l2_c, bool(cfg.l2_scale_by_batch),
@@ -644,7 +689,9 @@ class PSWorker:
                 and cfg.test_interval > 0
                 and (epoch + 1) % cfg.test_interval == 0
             ):
-                if sparse:
+                if cfg.model == "sparse_softmax":
+                    acc, test_ll = self._sparse_softmax_eval(test)
+                elif sparse:
                     acc, test_ll = self._sparse_eval(test)
                 elif blocked:
                     acc, test_ll = self._blocked_eval(test)
@@ -731,6 +778,28 @@ class PSWorker:
         z = (w[cols] * vals).sum(axis=-1)
         return self._eval_from_logits(z, y, mask)
 
+    def _sparse_softmax_eval(self, test) -> tuple[float, float]:
+        """Full-test-set ``(accuracy, cross-entropy)``: keyed pull of the
+        test set's unique (D, K) rows (vals_per_key=K where the group's
+        ranges align), scattered into a full table."""
+        test.reset()
+        cols, vals, y, mask = test.next_batch()
+        K = self.cfg.num_classes
+        ub = np.unique(cols).astype(np.uint64)
+        W = np.zeros((self.cfg.num_feature_dim, K), np.float32)
+        if self.kv.supports_vals_per_key(K):
+            pulled = self.kv.pull(keys=ub, vals_per_key=K)
+        else:
+            pulled = self.kv.pull(keys=_expand_block_keys(ub, K))
+        W[ub] = pulled.reshape(len(ub), K)
+        z = np.asarray((W[cols] * vals[..., None]).sum(axis=1), np.float64)
+        m = np.asarray(mask, np.float64)
+        n = max(m.sum(), 1.0)
+        acc = float(((z.argmax(axis=1) == y) * m).sum() / n)
+        zs = z - z.max(axis=1, keepdims=True)
+        ll = np.log(np.exp(zs).sum(axis=1)) - zs[np.arange(len(y)), y]
+        return acc, float((ll * m).sum() / n)
+
     @staticmethod
     def _place(device, *arrays):
         if device is None:
@@ -738,7 +807,7 @@ class PSWorker:
         return tuple(jax.device_put(a, device) for a in arrays)
 
     def _shape_params(self, flat: np.ndarray):
-        if self.cfg.model == "softmax":
+        if self.cfg.model in ("softmax", "sparse_softmax"):
             return flat.reshape(self.cfg.num_feature_dim, self.cfg.num_classes)
         return flat
 
@@ -869,7 +938,8 @@ def run_ps_workers(cfg: Config, hosts: str, ranks, *, eval_fn=None, save=False,
 def ps_param_dim(cfg: Config) -> int:
     """Flat KV key-space size for a config (must match between servers
     and workers — softmax flattens its (D, K) weight matrix)."""
-    return cfg.num_feature_dim * (cfg.num_classes if cfg.model == "softmax" else 1)
+    return cfg.num_feature_dim * (
+        cfg.num_classes if cfg.model in ("softmax", "sparse_softmax") else 1)
 
 
 def run_ps_local(cfg: Config, *, eval_fn=None, save=False, resume=False,

@@ -328,7 +328,9 @@ class Trainer:
         # A mesh with a 'model' axis selects the 2D data x feature-sharded
         # path (weights partitioned like ps-lite's server key ranges).
         self.feature_sharded = MODEL_AXIS in mesh.axis_names
-        if self.feature_sharded and cfg.model in ("sparse_lr", "blocked_lr"):
+        if self.feature_sharded and cfg.model in ("sparse_lr",
+                                                  "sparse_softmax",
+                                                  "blocked_lr"):
             # w[cols] / t[blocks] gathers arbitrary buckets; a partitioned
             # table would turn every gather into a cross-shard collective.
             # Shard the data axis instead (sparse batches are small by
@@ -434,8 +436,8 @@ class Trainer:
                     "(quantization scales come from the train split)"
                 )
         W = num_data_shards(self.mesh)
-        multiclass = self.cfg.model == "softmax"
-        sparse = self.cfg.model == "sparse_lr"
+        multiclass = self.cfg.model in ("softmax", "sparse_softmax")
+        sparse = self.cfg.model in ("sparse_lr", "sparse_softmax")
         if self.cfg.model == "blocked_lr":
             self._test_data = test or GlobalShardedData.from_raw_ctr_dir(
                 self.cfg.data_dir, "test", W, self.cfg
