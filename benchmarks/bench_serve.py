@@ -1,0 +1,199 @@
+"""Serving benchmark: scoring QPS vs batch-bucket config.
+
+Measures the online serving subsystem (``distlr_tpu/serve``) two ways:
+
+* **engine rows/s** — the jitted bucketed scoring path fed directly, per
+  bucket ladder config (the ceiling the front-end can approach);
+* **end-to-end QPS** — concurrent TCP clients through the microbatcher,
+  per (max_batch, max_wait) config, with the measured batch occupancy.
+
+Prints ONE JSON line in ``bench.py``'s format (``metric`` / ``value`` /
+``unit`` / per-config sub rows) so serving throughput joins the bench
+trajectory the driver tracks.  Backend selection follows bench.py's
+probe-in-subprocess discipline: a wedged TPU tunnel must cost the row its
+scale, never hang it (shapes are recorded so a CPU-fallback number can
+never be mistaken for an on-chip one).
+
+Run: ``python benchmarks/bench_serve.py [--quick]``
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+sys.path.insert(0, REPO)
+
+from distlr_tpu.utils.backend import force_cpu, probe_default_backend_ex  # noqa: E402
+
+
+def _make_lines(n: int, d: int, nnz: int, seed: int = 0) -> list[str]:
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    lines = []
+    for _ in range(n):
+        cols = np.sort(rng.choice(d, size=nnz, replace=False))
+        lines.append(" ".join(f"{c + 1}:1" for c in cols))
+    return lines
+
+
+def bench_engine_rows(d: int, bucket: int, batches: int, *, sparse: bool,
+                      nnz: int = 16) -> float:
+    """Steady-state rows/s of the jitted scoring path at one bucket size
+    (full buckets — the MXU-side ceiling)."""
+    import numpy as np
+
+    from distlr_tpu.config import Config
+    from distlr_tpu.serve import ScoringEngine
+
+    if sparse:
+        cfg = Config(num_feature_dim=d, model="sparse_lr", l2_c=0.0)
+    else:
+        cfg = Config(num_feature_dim=d, model="binary_lr", l2_c=0.0)
+    eng = ScoringEngine(cfg, max_batch_size=bucket, buckets=(bucket,))
+    rng = np.random.default_rng(0)
+    eng.set_weights(rng.standard_normal(d).astype(np.float32))
+    if sparse:
+        rows = (rng.integers(0, d, size=(bucket, nnz)).astype(np.int32),
+                np.ones((bucket, nnz), np.float32))
+    else:
+        rows = (rng.standard_normal((bucket, d)).astype(np.float32),)
+    eng.score(tuple(np.array(a) for a in rows))  # compile warmup
+    t0 = time.perf_counter()
+    for _ in range(batches):
+        # fresh arrays per call: the donating jit consumes its inputs
+        eng.score(tuple(np.array(a) for a in rows))
+    return bucket * batches / (time.perf_counter() - t0)
+
+
+def bench_e2e_qps(d: int, max_batch: int, max_wait_ms: float, *,
+                  clients: int, rows_per_request: int,
+                  duration_s: float) -> dict:
+    """End-to-end QPS through TCP + microbatcher with concurrent clients."""
+    import numpy as np
+
+    from distlr_tpu.config import Config
+    from distlr_tpu.serve import ScoringEngine, ScoringServer
+    from distlr_tpu.serve.server import score_lines_over_tcp
+
+    cfg = Config(num_feature_dim=d, model="sparse_lr", l2_c=0.0)
+    eng = ScoringEngine(cfg, max_batch_size=max_batch)
+    eng.set_weights(np.random.default_rng(1).standard_normal(d).astype(np.float32))
+    lines = _make_lines(rows_per_request, d, 16)
+    payload = json.dumps({"rows": lines})
+    counts = [0] * clients
+    with ScoringServer(eng, max_wait_ms=max_wait_ms) as srv:
+        score_lines_over_tcp(srv.host, srv.port, [payload])  # warmup
+        stop = time.monotonic() + duration_s
+
+        def client(i):
+            import socket
+
+            with socket.create_connection((srv.host, srv.port), timeout=30) as s:
+                f = s.makefile("rwb")
+                while time.monotonic() < stop:
+                    f.write((payload + "\n").encode())
+                    f.flush()
+                    if not f.readline():
+                        return
+                    counts[i] += 1
+
+        t0 = time.monotonic()
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(clients)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        elapsed = time.monotonic() - t0
+        occupancy = srv.batcher.stats()["mean_occupancy"]
+    reqs = sum(counts)
+    return {
+        "qps": round(reqs / elapsed, 1),
+        "rows_per_sec": round(reqs * rows_per_request / elapsed, 1),
+        "mean_occupancy": occupancy,
+        "clients": clients,
+        "rows_per_request": rows_per_request,
+    }
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true",
+                    help="tiny shapes (smoke/test mode)")
+    args = ap.parse_args()
+
+    status, probed = probe_default_backend_ex(
+        float(os.environ.get("DISTLR_PROBE_TIMEOUT_S", "60")))
+    if probed is None or probed[0] == "cpu":
+        force_cpu()
+        backend = "cpu"
+    else:
+        backend = probed[0]
+    on_cpu = backend == "cpu"
+
+    if args.quick:
+        d, batches, duration = 4096, 3, 0.5
+        buckets = (64, 256)
+        e2e_cfgs = [(256, 1.0, 4, 32)]
+    elif on_cpu:
+        d, batches, duration = 65536, 10, 2.0
+        buckets = (64, 256, 1024)
+        e2e_cfgs = [(256, 1.0, 8, 64), (1024, 2.0, 8, 64), (1024, 0.0, 1, 1)]
+    else:
+        d, batches, duration = 1_000_000, 30, 3.0
+        buckets = (64, 256, 1024, 4096)
+        e2e_cfgs = [(256, 1.0, 8, 64), (1024, 2.0, 8, 64),
+                    (4096, 2.0, 16, 256), (1024, 0.0, 1, 1)]
+
+    subs: dict[str, object] = {}
+    for bucket in buckets:
+        for name, sparse in ((f"engine_dense_b{bucket}_rows_per_sec", False),
+                             (f"engine_sparse_b{bucket}_rows_per_sec", True)):
+            if not sparse and d > 200_000 and bucket > 1024:
+                continue  # (B, D) dense tile past HBM-reasonable size
+            try:
+                subs[name] = round(
+                    bench_engine_rows(d, bucket, batches, sparse=sparse), 1)
+            except Exception as e:  # one config must not cost the artifact
+                print(f"[bench_serve] {name} failed: {e!r}", file=sys.stderr)
+                subs[name] = None
+
+    best_e2e = None
+    for max_batch, wait_ms, clients, rpr in e2e_cfgs:
+        key = f"e2e_mb{max_batch}_w{wait_ms:g}_c{clients}"
+        try:
+            r = bench_e2e_qps(d, max_batch, wait_ms, clients=clients,
+                              rows_per_request=rpr, duration_s=duration)
+            subs[key] = r
+            if best_e2e is None or r["rows_per_sec"] > best_e2e["rows_per_sec"]:
+                best_e2e = r
+        except Exception as e:
+            print(f"[bench_serve] {key} failed: {e!r}", file=sys.stderr)
+            subs[key] = None
+
+    engine_rates = [v for k, v in subs.items()
+                    if k.startswith("engine_") and isinstance(v, float)]
+    row = {
+        "metric": f"serve rows/sec, sparse LR D={d}, batched jit scoring, 1 chip",
+        "value": max(engine_rates) if engine_rates else None,
+        "unit": "rows/sec",
+        "backend": backend,
+        "D": d,
+        "probe_status": status,
+        "best_e2e": best_e2e,
+        **subs,
+    }
+    print(json.dumps(row))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

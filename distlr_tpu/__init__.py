@@ -20,6 +20,10 @@ see ``/root/reference``):
   links against.
 * **Train** (:mod:`distlr_tpu.train`) — trainer loops (sync SPMD and async
   PS), metrics, checkpointing (orbax + reference-compatible text export).
+* **Serve** (:mod:`distlr_tpu.serve`) — the online scoring tier the
+  reference never had (its ``SaveModel`` output is write-only): bucketed
+  jitted batched scoring, request microbatching, and hot weight reload
+  from checkpoints or a LIVE KV server group while training runs.
 * **Launch** (:mod:`distlr_tpu.launch`) — single-host / multi-process
   launcher replacing ``examples/local.sh``.
 

@@ -181,6 +181,20 @@ class Config:
     checkpoint_interval: int = 0      # epochs; 0 = only final save
     profile_dir: str | None = None
 
+    # ---- serving (launch serve / distlr_tpu.serve) ----
+    # Port 0 = OS-assigned ephemeral (announced as "SERVING host:port").
+    serve_port: int = 0
+    serve_host: str = "127.0.0.1"
+    # Upper bucket of the engine's padded batch ladder; also the
+    # microbatcher's flush size.
+    serve_max_batch_size: int = 1024
+    # Microbatch window: a request waits at most this long for
+    # co-batching company before flushing (latency bound per request).
+    serve_max_wait_ms: float = 2.0
+    # Weight-source poll cadence for hot reload (checkpoint watch or
+    # live-PS pull) — the serving staleness bound.
+    serve_reload_interval_s: float = 1.0
+
     def __post_init__(self):
         ref = self.compat_mode == "reference"
         if self.compat_mode not in ("correct", "reference"):
@@ -254,6 +268,21 @@ class Config:
             raise ValueError(
                 "ps_compute_backend must be auto|numpy|cpu|default, "
                 f"got {self.ps_compute_backend!r}"
+            )
+        if not 0 <= self.serve_port < 1 << 16:
+            raise ValueError(f"serve_port must be in [0, 65536), got {self.serve_port}")
+        if self.serve_max_batch_size <= 0:
+            raise ValueError(
+                f"serve_max_batch_size must be positive, got {self.serve_max_batch_size}"
+            )
+        if self.serve_max_wait_ms < 0:
+            raise ValueError(
+                f"serve_max_wait_ms must be >= 0, got {self.serve_max_wait_ms}"
+            )
+        if self.serve_reload_interval_s <= 0:
+            raise ValueError(
+                "serve_reload_interval_s must be positive, "
+                f"got {self.serve_reload_interval_s}"
             )
 
     # -- reference env-var shim ------------------------------------------------

@@ -5,6 +5,7 @@ from distlr_tpu.data.sharding import shard_libsvm_file, prepare_data_dir  # noqa
 from distlr_tpu.data.hashing import (  # noqa: F401
     HashedFeatureEncoder,
     csr_to_padded_coo,
+    csr_to_raw_ids,
     encode_blocked,
     hash_buckets,
     make_ctr_dataset,

@@ -769,6 +769,61 @@ def write_raw_ctr_shards(
             "w_true_path": w_path, "meta": meta}
 
 
+def csr_to_raw_ids(row_ptr, cols, vals, num_fields: int, *,
+                   origin: str = "input") -> np.ndarray:
+    """Validated CSR -> raw ``(N, F) int64`` id matrix — THE raw-CTR row
+    assembly, shared by the shard reader and the serving front-end so
+    training and serving parse (and REJECT) identically.
+
+    ``cols`` give the 0-based field slot, in any order; ``vals`` are the
+    raw categorical ids riding the libsvm value slot.  Rejects: a row
+    with a missing/extra field, a field number outside ``1..F``, a
+    negative / fractional / >= 2^24 id (the float32 value slot has
+    already corrupted larger ids), and a duplicated field number (which
+    passes the length check but leaves its partner slot unwritten).
+    ``origin`` names the source (file path, "request") in errors.
+    """
+    row_ptr = np.asarray(row_ptr)
+    cols = np.asarray(cols)
+    vals = np.asarray(vals)
+    n = len(row_ptr) - 1
+    lengths = np.diff(row_ptr)
+    if n and not (lengths == num_fields).all():
+        bad = int(np.argmax(lengths != num_fields))
+        raise ValueError(
+            f"{origin}: row {bad} has {int(lengths[bad])} fields, expected "
+            f"{num_fields} (raw-CTR rows carry every field)"
+        )
+    if n and ((cols < 0).any() or (cols >= num_fields).any()):
+        bad = int(cols[(cols < 0) | (cols >= num_fields)][0]) + 1
+        raise ValueError(
+            f"{origin}: field number {bad} outside 1..{num_fields}"
+        )
+    if (vals < 0).any():
+        raise ValueError(f"{origin}: raw-CTR ids must be non-negative")
+    if (vals != np.floor(vals)).any():
+        raise ValueError(
+            f"{origin}: raw-CTR ids must be integers (found fractional value)"
+        )
+    if (vals >= float(1 << 24)).any():
+        # Mirror write_raw_ctr_shards' bound: an id >= 2^24 has already
+        # been rounded in the float32 value slot, so casting it to int64
+        # would yield a silently-corrupted id, not the one on disk.
+        raise ValueError(
+            f"{origin}: raw-CTR id exceeds float32's exact-integer range "
+            "(2^24); the id was already corrupted when it was encoded"
+        )
+    raw_ids = np.full((n, num_fields), -1, np.int64)
+    raw_ids[np.repeat(np.arange(n), num_fields), cols] = vals.astype(np.int64)
+    if (raw_ids < 0).any():
+        bad = int(np.argmax((raw_ids < 0).any(axis=1)))
+        raise ValueError(
+            f"{origin}: row {bad} repeats a field number (every field must "
+            "appear exactly once)"
+        )
+    return raw_ids
+
+
 def read_raw_ctr_file(path: str, num_fields: int, *,
                       max_rows: int | None = None, stride: int = 1):
     """Parse one raw-CTR shard -> ``(raw_ids (N, F) int64, y (N,) int32)``.
@@ -800,44 +855,4 @@ def read_raw_ctr_file(path: str, num_fields: int, *,
         with open(path) as f:  # text mode: the line parser wants str
             lines = list(itertools.islice(f, 0, stop, stride))
         (row_ptr, cols, vals), y = parse_libsvm_lines(lines, None, dense=False)
-    n = len(y)
-    lengths = np.diff(row_ptr)
-    if n and not (lengths == num_fields).all():
-        bad = int(np.argmax(lengths != num_fields))
-        raise ValueError(
-            f"{path}: row {bad} has {int(lengths[bad])} fields, expected "
-            f"{num_fields} (raw-CTR rows carry every field)"
-        )
-    if n and ((cols < 0).any() or (cols >= num_fields).any()):
-        bad = int(cols[(cols < 0) | (cols >= num_fields)][0]) + 1
-        raise ValueError(
-            f"{path}: field number {bad} outside 1..{num_fields}"
-        )
-    if (vals < 0).any():
-        raise ValueError(f"{path}: raw-CTR ids must be non-negative")
-    if (vals != np.floor(vals)).any():
-        raise ValueError(
-            f"{path}: raw-CTR ids must be integers (found fractional value)"
-        )
-    if (vals >= float(1 << 24)).any():
-        # Mirror write_raw_ctr_shards' bound: an id >= 2^24 has already
-        # been rounded in the float32 value slot, so casting it to int64
-        # would yield a silently-corrupted id, not the one on disk.
-        raise ValueError(
-            f"{path}: raw-CTR id exceeds float32's exact-integer range "
-            "(2^24); the id was already corrupted when the shard was "
-            "written"
-        )
-    # rows may list fields in any order; cols give the 0-based field slot.
-    # -1 fill + post-check: a duplicated field number passes the length
-    # check but leaves its partner slot unwritten — garbage must reject,
-    # not train.
-    raw_ids = np.full((n, num_fields), -1, np.int64)
-    raw_ids[np.repeat(np.arange(n), num_fields), cols] = vals.astype(np.int64)
-    if (raw_ids < 0).any():
-        bad = int(np.argmax((raw_ids < 0).any(axis=1)))
-        raise ValueError(
-            f"{path}: row {bad} repeats a field number (every field must "
-            "appear exactly once)"
-        )
-    return raw_ids, y
+    return csr_to_raw_ids(row_ptr, cols, vals, num_fields, origin=path), y

@@ -10,6 +10,7 @@ SPMD program over the mesh), and the PS path needs server processes that
     python -m distlr_tpu.launch gen-data --data-dir D --num-samples N ...
     python -m distlr_tpu.launch sync     [--data-dir D ...]
     python -m distlr_tpu.launch ps       [--async] [--num-workers W ...]
+    python -m distlr_tpu.launch serve    [--model-file M | --ps-hosts H ...]
 
 Every algorithm knob also honors the reference's env-var contract
 (``SYNC_MODE``, ``LEARNING_RATE``, ``NUM_FEATURE_DIM``, ... — see
@@ -334,6 +335,84 @@ def cmd_ps(args: argparse.Namespace) -> int:
     return 0
 
 
+def cmd_serve(args: argparse.Namespace) -> int:
+    """Online scoring front-end over a trained model (see
+    :mod:`distlr_tpu.serve`): batched jitted scoring behind a TCP line
+    protocol, with hot weight reload from a checkpoint dir or a LIVE
+    KV server group — the latter lets a trainer and this server run
+    against the same PS simultaneously (`launch ps --async` + `launch
+    serve --ps-hosts ...`)."""
+    import os  # noqa: PLC0415
+    import signal  # noqa: PLC0415
+
+    _maybe_force_cpu_devices(args)
+    from distlr_tpu.serve import (  # noqa: PLC0415
+        CheckpointWatcher,
+        HotReloader,
+        LivePSWatcher,
+        ScoringEngine,
+        ScoringServer,
+    )
+    from distlr_tpu.train.export import load_weights  # noqa: PLC0415
+    from distlr_tpu.train.ps_trainer import ps_param_dim  # noqa: PLC0415
+
+    cfg = _config_from_args(args)
+    serve_over = {
+        "serve_port": args.port, "serve_host": args.bind,
+        "serve_max_batch_size": args.serve_max_batch_size,
+        "serve_max_wait_ms": args.max_wait_ms,
+        "serve_reload_interval_s": args.reload_interval,
+    }
+    cfg = cfg.replace(**{k: v for k, v in serve_over.items() if v is not None})
+    if not (args.model_file or cfg.checkpoint_dir or args.ps_hosts):
+        print("error: serve needs a weight source: --model-file and/or "
+              "--checkpoint-dir (watched) or --ps-hosts (live pull)",
+              file=sys.stderr)
+        return 2
+    if cfg.model == "blocked_lr" and cfg.block_size == 0:
+        if cfg.data_dir and os.path.isdir(cfg.data_dir):
+            cfg = _resolve_auto_block(cfg)
+        else:
+            print("error: blocked_lr serving needs the trained (R, groups) "
+                  "pinned (--block-size/--block-groups), or a --data-dir "
+                  "to re-resolve 'auto' from", file=sys.stderr)
+            return 2
+
+    engine = ScoringEngine(cfg, max_batch_size=cfg.serve_max_batch_size)
+    if args.model_file:
+        engine.set_weights(
+            load_weights(args.model_file, shape=engine.model.param_shape))
+    reloader = None
+    if args.ps_hosts:
+        row_width = (cfg.block_size if cfg.model == "blocked_lr"
+                     else cfg.num_classes if cfg.model == "sparse_softmax"
+                     else 1)
+        source = LivePSWatcher(
+            args.ps_hosts, ps_param_dim(cfg),
+            vals_per_key=max(row_width, 1),
+        )
+    elif cfg.checkpoint_dir:
+        source = CheckpointWatcher(cfg.checkpoint_dir)
+    else:
+        source = None
+    if source is not None:
+        reloader = HotReloader(
+            engine, source, interval_s=cfg.serve_reload_interval_s
+        ).start()
+        if not engine.has_weights:
+            reloader.wait_for_weights()
+
+    signal.signal(signal.SIGTERM, lambda *_: sys.exit(143))
+    server = ScoringServer(
+        engine, host=cfg.serve_host, port=cfg.serve_port,
+        max_wait_ms=cfg.serve_max_wait_ms, reloader=reloader,
+    )
+    # Scriptable readiness line, like ps-server's "HOSTS ..." contract.
+    print(f"SERVING {server.host}:{server.port}", flush=True)
+    server.serve_forever()
+    return 0
+
+
 def cmd_ps_server(args: argparse.Namespace) -> int:
     """Host a KV server group in the foreground (multi-host PS mode:
     the reference's ``DMLC_ROLE=server`` processes, ``local.sh:36-41``;
@@ -438,6 +517,31 @@ def main(argv=None) -> int:
                    "(fall back to the reference's serialized two-round-"
                    "trips-per-batch sequence)")
     p.set_defaults(fn=cmd_ps)
+
+    r = sub.add_parser(
+        "serve",
+        help="online scoring server (batched jit scoring + hot weight reload)",
+    )
+    _add_config_flags(r)
+    r.add_argument("--model-file", dest="model_file",
+                   help="initial weights: text model file (models/part-00N) "
+                        "or an orbax checkpoint dir")
+    r.add_argument("--ps-hosts", dest="ps_hosts",
+                   help="pull live weights from this running KV server "
+                   "group (comma-separated host:port, rank order) — serve "
+                   "WHILE `launch ps --async` trains against the same group")
+    r.add_argument("--port", type=int, help="listen port (default: "
+                   "ephemeral, announced as 'SERVING host:port')")
+    r.add_argument("--bind", help="listen address (default 127.0.0.1)")
+    r.add_argument("--serve-max-batch-size", dest="serve_max_batch_size",
+                   type=int, help="top batch bucket / microbatch flush size")
+    r.add_argument("--max-wait-ms", dest="max_wait_ms", type=float,
+                   help="microbatch window: max ms a request waits for "
+                   "co-batching company")
+    r.add_argument("--reload-interval", dest="reload_interval", type=float,
+                   help="weight-source poll period, seconds (the serving "
+                   "staleness bound)")
+    r.set_defaults(fn=cmd_serve)
 
     v = sub.add_parser("ps-server", help="host a KV server group (multi-host PS)")
     _add_config_flags(v)

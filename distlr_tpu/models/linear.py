@@ -233,6 +233,12 @@ class BinaryLR:
         # Reference decision rule: z > 0 (src/lr.cc:100-106).
         return (self.logits(w, X) > 0).astype(jnp.int32)
 
+    def proba(self, w, X):
+        """P(y=1) per row — the serving-side output (a CTR system ships
+        the probability, not the thresholded label; the reference has no
+        serving tier at all)."""
+        return jax.nn.sigmoid(self.logits(w, X))
+
     def accuracy(self, w, batch):
         X, y, mask = batch
         correct = (self.predict(w, X) == y).astype(jnp.float32)
@@ -320,6 +326,10 @@ class SoftmaxRegression:
     def predict(self, W, X):
         return jnp.argmax(self.logits(W, X), axis=-1).astype(jnp.int32)
 
+    def proba(self, W, X):
+        """(B, K) class probabilities (see BinaryLR.proba)."""
+        return jax.nn.softmax(self.logits(W, X), axis=-1)
+
     def accuracy(self, W, batch):
         X, y, mask = batch
         correct = (self.predict(W, X) == y).astype(jnp.float32)
@@ -385,6 +395,10 @@ class SparseBinaryLR:
 
     def predict(self, w, cols, vals):
         return (self.logits(w, cols, vals) > 0).astype(jnp.int32)
+
+    def proba(self, w, cols, vals):
+        """P(y=1) per row (see BinaryLR.proba)."""
+        return jax.nn.sigmoid(self.logits(w, cols, vals))
 
     def accuracy(self, w, batch):
         cols, vals, y, mask = batch
@@ -465,6 +479,10 @@ class SparseSoftmaxRegression:
     def predict(self, W, cols, vals):
         return jnp.argmax(self.logits(W, cols, vals), axis=-1).astype(jnp.int32)
 
+    def proba(self, W, cols, vals):
+        """(B, K) class probabilities (see BinaryLR.proba)."""
+        return jax.nn.softmax(self.logits(W, cols, vals), axis=-1)
+
     def accuracy(self, W, batch):
         cols, vals, y, mask = batch
         correct = (self.predict(W, cols, vals) == y).astype(jnp.float32)
@@ -533,6 +551,10 @@ class BlockedSparseLR:
 
     def predict(self, t, blocks, lane_vals):
         return (self.logits(t, blocks, lane_vals) > 0).astype(jnp.int32)
+
+    def proba(self, t, blocks, lane_vals):
+        """P(y=1) per row (see BinaryLR.proba)."""
+        return jax.nn.sigmoid(self.logits(t, blocks, lane_vals))
 
     def accuracy(self, t, batch):
         blocks, lane_vals, y, mask = batch

@@ -26,7 +26,7 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from distlr_tpu.config import Config
-from distlr_tpu.parallel.mesh import DATA_AXIS, shard_map
+from distlr_tpu.parallel.mesh import DATA_AXIS, axis_size, shard_map
 
 
 def _batch_spec(batch) -> tuple:
@@ -48,10 +48,10 @@ def make_sync_train_step(model, cfg: Config, mesh: Mesh, *, with_metrics: bool =
         if cfg.sync_last_gradient:
             # Q1 compat: psum of (g_i masked to the top rank) == g_last;
             # the reference then divides by the number of workers.
-            axis_size = lax.axis_size(DATA_AXIS)
-            is_last = (lax.axis_index(DATA_AXIS) == axis_size - 1)
+            n_shards = axis_size(DATA_AXIS)
+            is_last = (lax.axis_index(DATA_AXIS) == n_shards - 1)
             g = lax.psum(jax.tree.map(lambda t: t * is_last, g_local), DATA_AXIS)
-            g = jax.tree.map(lambda t: t / axis_size, g)
+            g = jax.tree.map(lambda t: t / n_shards, g)
         else:
             g = lax.pmean(g_local, DATA_AXIS)
         w_new = jax.tree.map(lambda p, t: p - cfg.learning_rate * t, w, g)

@@ -257,6 +257,47 @@ class KVWorker:
         self._check(ts, "pull")
         return out
 
+    def pull_chunked(self, keys: np.ndarray | None = None, *,
+                     vals_per_key: int = 1,
+                     chunk_rows: int = 1 << 16) -> np.ndarray:
+        """Pull a large key set as a sequence of bounded keyed pulls.
+
+        The serving-tier read path (:mod:`distlr_tpu.serve.reload`): a
+        D=1M CTR table pulled as ONE dense op ships an 8 MB key frame +
+        4 MB value frame in a single message; chunking caps the per-op
+        frame at ``chunk_rows`` rows (keys stay the implicit range ids,
+        one u64 per ``vals_per_key`` floats), so a periodic weight
+        refresh never monopolizes a server's receive loop against the
+        trainer pushing to the same group.  ``keys=None`` pulls the full
+        row space ``0..dim/vals_per_key``; an explicit ascending ``keys``
+        array (hot-row serving) is chunked as given.
+        """
+        vpk = int(vals_per_key)
+        if chunk_rows <= 0:
+            raise ValueError(f"chunk_rows must be positive, got {chunk_rows}")
+        if vpk > 1 and not self.supports_vals_per_key(vpk):
+            raise ValueError(
+                f"vals_per_key={vpk} rows straddle this group's range "
+                "boundaries; pull with vals_per_key=1 instead"
+            )
+        if keys is None:
+            space = self.dim // vpk
+            parts = [
+                self.pull(keys=np.arange(lo, min(lo + chunk_rows, space),
+                                         dtype=np.uint64),
+                          vals_per_key=vpk)
+                for lo in range(0, space, chunk_rows)
+            ]
+        else:
+            keys = self._validate_keys(keys, vpk)
+            parts = [
+                self.pull(keys=keys[lo:lo + chunk_rows], vals_per_key=vpk)
+                for lo in range(0, keys.shape[0], chunk_rows)
+            ]
+        if not parts:  # empty key set (e.g. an empty hot-row working set)
+            return np.empty(0, np.float32)
+        return parts[0] if len(parts) == 1 else np.concatenate(parts)
+
     def wait(self, ts: int) -> None:
         """No-op for API parity: push/pull already block (the reference
         pairs every Push/Pull with an immediate Wait)."""

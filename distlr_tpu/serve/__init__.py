@@ -1,0 +1,17 @@
+"""Online serving subsystem — batched scoring with hot weight reload.
+
+The inference half of the ROADMAP's "serves heavy traffic" north star:
+``engine`` (bucketed jitted batched scoring over every model family),
+``batcher`` (microbatch request coalescing), ``reload`` (checkpoint-watch
+and live-PS weight sources with atomic swap), ``server`` (stdlib threaded
+TCP front-end; ``python -m distlr_tpu.launch serve``).
+"""
+
+from distlr_tpu.serve.batcher import MicroBatcher  # noqa: F401
+from distlr_tpu.serve.engine import ScoringEngine  # noqa: F401
+from distlr_tpu.serve.reload import (  # noqa: F401
+    CheckpointWatcher,
+    HotReloader,
+    LivePSWatcher,
+)
+from distlr_tpu.serve.server import ScoringServer, score_lines_over_tcp  # noqa: F401

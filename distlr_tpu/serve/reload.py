@@ -1,0 +1,191 @@
+"""Hot weight reload — keep a serving engine fresh while training runs.
+
+Two weight sources behind one ``poll() -> (version, weights) | None``
+interface:
+
+* :class:`CheckpointWatcher` — watch an orbax checkpoint directory for
+  new steps (the trainer's ``checkpoint_interval`` cadence); version is
+  the checkpoint step.
+* :class:`LivePSWatcher` — pull live weights from a running native KV
+  server group through :class:`distlr_tpu.ps.KVWorker`, chunked keyed
+  pulls for CTR-scale tables (``KVWorker.pull_chunked``).  Pulls don't
+  vote in barriers or count as gradient pushes, so a trainer and a
+  serving tier run against the SAME server group simultaneously — the
+  whole point of continuous async training (PAPER.md): the model serving
+  traffic is seconds old, not checkpoint-interval old.
+
+:class:`HotReloader` polls a source on a background thread and publishes
+into ``engine.set_weights`` — an atomic reference swap the engine applies
+between batches, so in-flight requests finish on the weights they
+started with and nothing is dropped during a swap.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+from distlr_tpu.utils.logging import get_logger
+
+log = get_logger(__name__)
+
+
+class CheckpointWatcher:
+    """Poll an orbax checkpoint dir; report each NEW latest step once."""
+
+    def __init__(self, directory: str):
+        self._dir = directory
+        self._last_step: int | None = None
+
+    def poll(self):
+        from distlr_tpu.train.checkpoint import Checkpointer  # noqa: PLC0415
+
+        with Checkpointer(self._dir) as ckpt:
+            step = ckpt.latest_step()
+            if step is None or step == self._last_step:
+                return None
+            state = ckpt.restore(step)
+        self._last_step = step
+        return step, np.asarray(state["weights"]).reshape(-1)
+
+    def close(self) -> None:
+        pass
+
+
+class LivePSWatcher:
+    """Pull the current weights from a live KV server group each poll.
+
+    There is no server-side "new version" signal (the reference protocol
+    has none); every poll returns the current table with a monotonically
+    increasing local version, and the poll INTERVAL is the staleness
+    bound.  ``vals_per_key``/``chunk_rows``: see
+    :meth:`distlr_tpu.ps.KVWorker.pull_chunked`.
+    """
+
+    #: client_id for serving pulls — out of the way of trainer worker ranks
+    SERVE_CLIENT_ID = 4095
+
+    def __init__(self, hosts: str, dim: int, *, vals_per_key: int = 1,
+                 chunk_rows: int = 1 << 16, timeout_ms: int = 10_000,
+                 client_id: int | None = None):
+        from distlr_tpu.ps import KVWorker  # noqa: PLC0415
+
+        self.kv = KVWorker(
+            hosts, dim,
+            client_id=self.SERVE_CLIENT_ID if client_id is None else client_id,
+            timeout_ms=timeout_ms,
+            # pull-only client: never votes in a BSP barrier, so the
+            # async-group push shortcut flag is irrelevant either way
+            sync_group=True,
+        )
+        self.vals_per_key = int(vals_per_key)
+        if self.vals_per_key > 1 and not self.kv.supports_vals_per_key(
+                self.vals_per_key):
+            # same fallback rule as the keyed trainer: rows that straddle
+            # a range boundary ride flat keys, identical semantics
+            log.info("serve pull: vals_per_key=%d rows straddle range "
+                     "boundaries; using flat keys", self.vals_per_key)
+            self.vals_per_key = 1
+        self.chunk_rows = int(chunk_rows)
+        self._version = 0
+
+    def poll(self):
+        w = self.kv.pull_chunked(
+            vals_per_key=self.vals_per_key, chunk_rows=self.chunk_rows
+        )
+        self._version += 1
+        return self._version, w
+
+    def close(self) -> None:
+        self.kv.close()
+
+
+class HotReloader:
+    """Background poller: source -> ``engine.set_weights`` swaps.
+
+    Poll errors are counted and logged, never fatal — a serving tier must
+    keep answering on its last good weights when the trainer's PS group
+    restarts or the checkpoint dir is mid-write (both sources' errors are
+    transient by design).
+    """
+
+    def __init__(self, engine, source, *, interval_s: float = 1.0):
+        if interval_s <= 0:
+            raise ValueError(f"interval_s must be positive, got {interval_s}")
+        self.engine = engine
+        self.source = source
+        self.interval_s = float(interval_s)
+        self.reloads = 0
+        self.errors = 0
+        self.last_version = None
+        self._stop = threading.Event()
+        # serializes source.poll(): wait_for_weights (caller thread) can
+        # overlap the background loop, and sources keep per-poll state
+        self._poll_lock = threading.Lock()
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="distlr-hot-reload"
+        )
+
+    def _poll_once(self) -> bool:
+        with self._poll_lock:
+            try:
+                got = self.source.poll()
+            except Exception as e:
+                self.errors += 1
+                if self.errors in (1, 10, 100):  # log decimated, not per poll
+                    log.warning("weight source poll failed (%d so far): %s",
+                                self.errors, e)
+                return False
+            if got is None:
+                return False
+            version, weights = got
+            self.engine.set_weights(weights)
+            self.reloads += 1
+            self.last_version = version
+            return True
+
+    def _run(self):
+        while not self._stop.wait(self.interval_s):
+            self._poll_once()
+
+    def start(self) -> "HotReloader":
+        self._thread.start()
+        return self
+
+    def wait_for_weights(self, timeout_s: float = 30.0) -> None:
+        """Block until the engine has weights (first successful poll) —
+        the serve front-end's startup gate when no initial weights were
+        given."""
+        import time  # noqa: PLC0415
+
+        deadline = time.monotonic() + timeout_s
+        while not self.engine.has_weights:
+            if self._poll_once():
+                return
+            if time.monotonic() >= deadline:
+                raise TimeoutError(
+                    f"no weights from {type(self.source).__name__} within "
+                    f"{timeout_s:.0f}s"
+                )
+            time.sleep(min(self.interval_s, 0.2))
+
+    def stats(self) -> dict:
+        return {
+            "reloads": self.reloads,
+            "reload_errors": self.errors,
+            "last_version": self.last_version,
+            "interval_s": self.interval_s,
+        }
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread.is_alive():
+            self._thread.join(timeout=10.0)
+        self.source.close()
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()

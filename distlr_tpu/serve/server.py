@@ -1,0 +1,208 @@
+"""Threaded TCP scoring front-end — stdlib only.
+
+Line protocol, one request per line, one reply line per request:
+
+* **libsvm mode** — a libsvm-formatted feature line (leading label token
+  optional, ignored if present); reply: ``<label> <score>`` where score
+  is P(y=1) (binary families) or the winning class probability (softmax
+  families).
+* **JSON mode** — a line starting with ``{``:
+  ``{"rows": ["<libsvm line>", ...]}``; reply:
+  ``{"labels": [...], "scores": [...]}``.  The batch travels as ONE
+  microbatcher request (a single client can fill a bucket by itself).
+* **STATS** — reply: one JSON line of engine/batcher/latency counters
+  (p50/p99 ms, QPS, occupancy, reload stats).
+* Malformed input -> ``ERR <reason>`` for that line; the connection
+  stays up (one bad row from one client must not drop its neighbors).
+
+Concurrency model: one thread per connection (stdlib
+``ThreadingTCPServer``); all connections funnel into one
+:class:`~distlr_tpu.serve.batcher.MicroBatcher`, so cross-connection
+coalescing happens exactly when traffic is concurrent — the serving
+analogue of lockstep global batches in the sync trainer.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import socketserver
+import threading
+import time
+from collections import deque
+
+import numpy as np
+
+from distlr_tpu.serve.batcher import MicroBatcher
+from distlr_tpu.train.metrics import MetricsLogger
+from distlr_tpu.utils.logging import get_logger
+
+log = get_logger(__name__)
+
+
+def _percentile(sorted_vals, q: float) -> float:
+    if not sorted_vals:
+        return 0.0
+    i = min(int(q * (len(sorted_vals) - 1) + 0.5), len(sorted_vals) - 1)
+    return sorted_vals[i]
+
+
+class _Handler(socketserver.StreamRequestHandler):
+    def handle(self):
+        srv: ScoringServer = self.server.scoring_server  # type: ignore[attr-defined]
+        for raw in self.rfile:
+            try:
+                line = raw.decode("utf-8", errors="replace").strip()
+            except Exception:
+                continue
+            if not line:
+                continue
+            reply = srv.handle_line(line)
+            try:
+                self.wfile.write((reply + "\n").encode())
+                self.wfile.flush()
+            except (BrokenPipeError, ConnectionResetError):
+                return
+
+
+class _TCPServer(socketserver.ThreadingTCPServer):
+    daemon_threads = True
+    allow_reuse_address = True
+
+
+class ScoringServer:
+    """Engine + microbatcher behind a line-protocol TCP listener."""
+
+    def __init__(self, engine, *, host: str = "127.0.0.1", port: int = 0,
+                 max_wait_ms: float = 2.0, reloader=None,
+                 metrics: MetricsLogger | None = None):
+        self.engine = engine
+        self.reloader = reloader
+        self.batcher = MicroBatcher(
+            engine.score,
+            max_batch_size=engine.max_batch_size,
+            max_wait_ms=max_wait_ms,
+        )
+        self.metrics = metrics or MetricsLogger()
+        self._latencies_ms: deque[float] = deque(maxlen=8192)
+        self._requests = 0
+        self._errors = 0
+        self._stats_lock = threading.Lock()
+        self._t0 = time.monotonic()
+        self._tcp = _TCPServer((host, port), _Handler, bind_and_activate=True)
+        self._tcp.scoring_server = self  # type: ignore[attr-defined]
+        self.host, self.port = self._tcp.server_address[:2]
+        self._thread = threading.Thread(
+            target=self._tcp.serve_forever, daemon=True,
+            name="distlr-serve-accept",
+        )
+
+    # -- request handling --------------------------------------------------
+    def _score_lines(self, lines: list[str]):
+        rows = self.engine.encode_lines(lines)
+        labels, scores = self.batcher.submit(rows).result()
+        return np.asarray(labels), np.asarray(scores)
+
+    def handle_line(self, line: str) -> str:
+        t0 = time.monotonic()
+        try:
+            if line == "STATS":
+                return json.dumps(self.stats())
+            if line.startswith("{"):
+                req = json.loads(line)
+                batch = req.get("rows")
+                if not isinstance(batch, list) or not batch:
+                    raise ValueError('JSON request needs a non-empty "rows" list')
+                labels, scores = self._score_lines([str(r) for r in batch])
+                reply = json.dumps({
+                    "labels": [int(v) for v in labels],
+                    "scores": [round(float(v), 6) for v in scores],
+                })
+            else:
+                labels, scores = self._score_lines([line])
+                reply = f"{int(labels[0])} {float(scores[0]):.6g}"
+        except Exception as e:
+            with self._stats_lock:
+                self._errors += 1
+            return f"ERR {type(e).__name__}: {e}"
+        dt_ms = (time.monotonic() - t0) * 1000.0
+        with self._stats_lock:
+            self._requests += 1
+            self._latencies_ms.append(dt_ms)
+        return reply
+
+    # -- stats -------------------------------------------------------------
+    def stats(self) -> dict:
+        with self._stats_lock:
+            lat = sorted(self._latencies_ms)
+            n_req, n_err = self._requests, self._errors
+        elapsed = max(time.monotonic() - self._t0, 1e-9)
+        rec = {
+            "requests": n_req,
+            "errors": n_err,
+            "qps": round(n_req / elapsed, 2),
+            "p50_ms": round(_percentile(lat, 0.50), 3),
+            "p99_ms": round(_percentile(lat, 0.99), 3),
+            "batcher": self.batcher.stats(),
+            "engine": self.engine.stats(),
+        }
+        if self.reloader is not None:
+            rec["reload"] = self.reloader.stats()
+        # mirror into the structured metrics stream (train/metrics.py
+        # conventions: one flat record per observation)
+        self.metrics.log(
+            requests=rec["requests"], qps=rec["qps"],
+            p50_ms=rec["p50_ms"], p99_ms=rec["p99_ms"],
+            occupancy=rec["batcher"]["mean_occupancy"],
+        )
+        return rec
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> "ScoringServer":
+        self._thread.start()
+        log.info("serving %s on %s:%d (max_batch=%d, buckets=%s)",
+                 self.engine.cfg.model, self.host, self.port,
+                 self.engine.max_batch_size, list(self.engine.buckets))
+        return self
+
+    def serve_forever(self) -> None:
+        """Foreground mode for the CLI: start, then block until stopped."""
+        self.start()
+        try:
+            while self._thread.is_alive():
+                self._thread.join(timeout=1.0)
+        except KeyboardInterrupt:
+            pass
+        finally:
+            self.stop()
+
+    def stop(self) -> None:
+        self._tcp.shutdown()
+        self._tcp.server_close()
+        self.batcher.close()
+        if self.reloader is not None:
+            self.reloader.stop()
+        self.metrics.close()
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+
+
+def score_lines_over_tcp(host: str, port: int, lines: list[str],
+                         *, timeout_s: float = 30.0) -> list[str]:
+    """Tiny client helper (tests/benchmarks): send ``lines``, return the
+    reply line for each, over one connection."""
+    replies = []
+    with socket.create_connection((host, port), timeout=timeout_s) as s:
+        f = s.makefile("rwb")
+        for ln in lines:
+            f.write((ln.strip() + "\n").encode())
+            f.flush()
+            reply = f.readline()
+            if not reply:
+                raise ConnectionError("server closed mid-stream")
+            replies.append(reply.decode().rstrip("\n"))
+    return replies

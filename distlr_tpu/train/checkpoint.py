@@ -47,7 +47,10 @@ class Checkpointer:
         step = self.latest_step() if step is None else step
         if step is None:
             return None
-        return self._mgr.restore(step)
+        # Explicit StandardRestore: newer orbax releases refuse a bare
+        # restore() of a StandardSave item ('Item "default" ... could not
+        # be restored') unless told how to interpret it.
+        return self._mgr.restore(step, args=ocp.args.StandardRestore())
 
     def all_steps(self) -> list[int]:
         return list(self._mgr.all_steps())

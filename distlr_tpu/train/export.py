@@ -12,6 +12,8 @@ output files.  Durable checkpoint/resume lives in
 
 from __future__ import annotations
 
+import os
+
 import numpy as np
 
 
@@ -30,3 +32,21 @@ def load_model_text(path: str, shape=None) -> np.ndarray:
     if vals.shape[0] != d:
         raise ValueError(f"{path}: header says {d} weights, found {vals.shape[0]}")
     return vals.reshape(shape) if shape is not None else vals
+
+
+def load_weights(path: str, shape=None) -> np.ndarray:
+    """Load model weights from EITHER persistence format this repo
+    writes: a reference-format text model file, or an orbax checkpoint
+    directory (latest step) — the serving tier's one-stop read path
+    (``launch serve --model-file``).
+    """
+    if os.path.isdir(path):
+        from distlr_tpu.train.checkpoint import Checkpointer  # noqa: PLC0415
+
+        with Checkpointer(path) as ckpt:
+            state = ckpt.restore()
+        if state is None:
+            raise FileNotFoundError(f"{path}: no checkpoint steps found")
+        w = np.asarray(state["weights"], dtype=np.float32)
+        return w.reshape(shape) if shape is not None else w.reshape(-1)
+    return load_model_text(path, shape=shape)

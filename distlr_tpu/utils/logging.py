@@ -31,10 +31,30 @@ def check_eq(a, b, msg: str = "") -> None:
 _FORMAT = "%(asctime)s %(levelname).1s %(name)s] %(message)s"
 
 
+class _LazyStderrHandler(logging.StreamHandler):
+    """StreamHandler that re-resolves ``sys.stderr`` at every emit.
+
+    ``StreamHandler(sys.stderr)`` captures the stream object live at
+    first-logger creation, which is order-fragile: a logger created while
+    something (pytest capture, ``contextlib.redirect_stderr``) has
+    temporarily replaced ``sys.stderr`` keeps writing to that dead stream
+    forever after.  Binding lazily makes log output follow wherever
+    ``sys.stderr`` points *now* — same trick as stdlib
+    ``logging._StderrHandler``.
+    """
+
+    def __init__(self):
+        logging.Handler.__init__(self)
+
+    @property
+    def stream(self):
+        return sys.stderr
+
+
 def get_logger(name: str = "distlr_tpu") -> logging.Logger:
     logger = logging.getLogger(name)
     if not logger.handlers:
-        handler = logging.StreamHandler(sys.stderr)
+        handler = _LazyStderrHandler()
         handler.setFormatter(logging.Formatter(_FORMAT, datefmt="%H:%M:%S"))
         logger.addHandler(handler)
         logger.setLevel(logging.INFO)

@@ -127,8 +127,10 @@ def test_requality_lkg_rederives_from_fresh_frontier(tmp_path, monkeypatch):
     frontier_path = tmp_path / "frontier.json"
     monkeypatch.setattr(bench, "_LKG_PATH", str(lkg_path))
     monkeypatch.setattr(bench, "_FRONTIER_PATH", str(frontier_path))
-    lkg_path.write_text(json.dumps({
+    lkg_row = {
         "value": 165069.1,
+        "backend": "tpu",
+        "D": 1_000_000,
         "best_samples_per_sec": 15068285.2,
         "sparse_samples_per_sec": 3146969.3,
         "blocked_r8_samples_per_sec": 8096435.0,
@@ -137,7 +139,8 @@ def test_requality_lkg_rederives_from_fresh_frontier(tmp_path, monkeypatch):
         "best_samples_per_sec_quality_valid": False,
         "best_quality_valid_samples_per_sec": 10851064.2,
         "quality_frontier_valid_rs": [8, 16],
-    }))
+    }
+    lkg_path.write_text(json.dumps(lkg_row))
     # old frontier: R=32 invalid -> best quality-valid is the R=16 rate
     frontier_path.write_text(json.dumps({"frontier": {
         "correlated_tuples": {"r8": {"delta_vs_scalar_pts": 0.3},
@@ -157,7 +160,67 @@ def test_requality_lkg_rederives_from_fresh_frontier(tmp_path, monkeypatch):
     assert row["best_quality_valid_samples_per_sec"] == 15068285.2
     assert row["best_samples_per_sec_quality_valid"] is True
     assert row["quality_frontier_valid_rs"] == [8, 16, 32]
+    assert row["north_star_eligible"] is True
     assert row["north_star_cleared_with_quality"] is True
+    # a shrunken-D row (CPU-fallback vintage) can never claim the north
+    # star, whatever its rates say (VERDICT r5 weak #1)
+    lkg_path.write_text(json.dumps({**lkg_row, "backend": "cpu", "D": 65536}))
+    assert bench._requality_lkg() == 0
+    row = json.loads(lkg_path.read_text())
+    assert row["north_star_eligible"] is False
+    assert row["north_star_cleared_with_quality"] is False
+
+
+def test_quality_annotation_names_validating_regime(tmp_path, monkeypatch):
+    """The per-R annotation must carry WHICH regime validates an R (and
+    its row_load/recurrence) — the flat valid-list reads as 'always
+    safe' when e.g. R=16 loses 17pt on low-card iid at the same
+    operating point (VERDICT r5 weak #2)."""
+    import bench
+
+    art = tmp_path / "frontier.json"
+    monkeypatch.setattr(bench, "_FRONTIER_PATH", str(art))
+    art.write_text(json.dumps({"frontier": {"operating_point": {"regimes": {
+        "low_card_iid": {"dc65536": {
+            "r16": {"delta_vs_scalar_pts": -1.3, "row_load": 9.3,
+                    "min_recurrence": 1.5, "groups": 2}},
+            "dc1048576": {
+            "scalar": {"accuracy": 0.77},
+            "r16": {"delta_vs_scalar_pts": -17.0, "row_load": 0.58,
+                    "min_recurrence": 1.5, "groups": 2},
+            "r32_g3": {"delta_vs_scalar_pts": -0.4}}},  # pinned-G: skipped
+        "correlated_tuples": {"dc1048576": {
+            "r16": {"delta_vs_scalar_pts": 0.52, "row_load": 0.0156,
+                    "min_recurrence": 112.0, "groups": 2}}},
+    }}}}))
+    detail = bench._quality_valid_rs_annotated()
+    assert set(detail) == {"r16"}  # default-grouping rows only
+    r16 = detail["r16"]
+    assert r16["valid"] is True
+    # validated by the tuple regime, failing on low-card iid — BOTH
+    # visible, at the LARGEST dc only (the operating point)
+    assert [v["regime"] for v in r16["validated_by"]] == ["correlated_tuples"]
+    assert [v["regime"] for v in r16["fails_in"]] == ["low_card_iid"]
+    assert r16["fails_in"][0]["delta_vs_scalar_pts"] == -17.0
+    assert r16["validated_by"][0]["row_load"] == 0.0156
+    # missing artifact -> empty annotation, never a fabricated verdict
+    art.unlink()
+    assert bench._quality_valid_rs_annotated() == {}
+
+
+def test_bench_serve_quick_emits_bench_row():
+    """bench_serve.py joins the bench trajectory: one JSON line, bench.py
+    field conventions, engine + end-to-end sub rows."""
+    r = _run([sys.executable, "benchmarks/bench_serve.py", "--quick"],
+             timeout=600)
+    assert r.returncode == 0, r.stderr[-2000:]
+    row = json.loads(r.stdout.strip().splitlines()[-1])
+    for field in ("metric", "value", "unit", "backend", "D", "best_e2e"):
+        assert field in row, row
+    assert row["unit"] == "rows/sec"
+    assert row["value"] and row["value"] > 0
+    assert row["best_e2e"]["qps"] > 0
+    assert 0.0 <= row["best_e2e"]["mean_occupancy"] <= 1.0
 
 
 def test_update_roofline_rewrites_auto_section(tmp_path, monkeypatch):

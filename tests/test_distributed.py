@@ -70,6 +70,15 @@ def test_two_process_sync_run_agrees(tmp_path):
                 p.kill()
                 p.communicate()
     for p, out in zip(procs, outs):
+        if p.returncode != 0 and (
+            "Multiprocess computations aren't implemented on the CPU backend"
+            in out
+        ):
+            # older jaxlib CPU backends cannot run cross-process
+            # collectives at all — a platform limitation, not a repo bug
+            # (the PS-mode two-process test below still covers
+            # multi-process end-to-end on such machines)
+            pytest.skip("this jaxlib's CPU backend has no multiprocess support")
         assert p.returncode == 0, out
 
     from distlr_tpu.train.export import load_model_text
@@ -106,11 +115,17 @@ def _run_split_ps(tmp_path, gen, common_cfg, rank_groups, tag="split"):
     d_split = str(tmp_path / tag)
     gen(d_split)
     srv_log = tmp_path / f"{tag}-server.log"
-    with open(srv_log, "w") as srv_out:
+    srv_err = tmp_path / f"{tag}-server.err"
+    # stderr gets its OWN file: the native kv_server ranks inherit the
+    # ps-server process's stderr, and their "[distlr_kv_server]
+    # listening" diagnostics can interleave MID-LINE with the "HOSTS ..."
+    # announcement when both share one file — observed corrupting the
+    # parsed host list into a connect failure (flake).
+    with open(srv_log, "w") as srv_out, open(srv_err, "w") as srv_e:
         server = subprocess.Popen(
             [sys.executable, "-m", "distlr_tpu.launch", "ps-server",
              "--data-dir", d_split] + common_cfg,
-            cwd=REPO, env=env, stdout=srv_out, stderr=subprocess.STDOUT,
+            cwd=REPO, env=env, stdout=srv_out, stderr=srv_e,
             text=True,
         )
     workers = []
@@ -125,7 +140,8 @@ def _run_split_ps(tmp_path, gen, common_cfg, rank_groups, tag="split"):
             if found:
                 hosts = found[0].split(" ", 1)[1].strip()
                 break
-            assert server.poll() is None, f"ps-server died:\n{txt}"
+            assert server.poll() is None, (
+                f"ps-server died:\n{txt}\n{srv_err.read_text()}")
             time.sleep(0.1)
         assert hosts, "ps-server never announced HOSTS"
         for i, ranks in enumerate(rank_groups):
@@ -146,7 +162,8 @@ def _run_split_ps(tmp_path, gen, common_cfg, rank_groups, tag="split"):
                 p.wait()
     for p, log in zip(workers, w_logs):
         assert p.returncode == 0, log.read_text()
-    assert server.returncode == 0, srv_log.read_text()
+    assert server.returncode == 0, (
+        srv_log.read_text() + srv_err.read_text())
     return d_split, w_logs
 
 

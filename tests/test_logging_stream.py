@@ -1,0 +1,48 @@
+"""Regression tests for the lazy-stderr logging handler.
+
+The original ``StreamHandler(sys.stderr)`` bound the stream object at
+first-logger creation, so a logger created under one capture context kept
+writing to that (stale) stream in every later context — the order-dependent
+failure mode of ``test_keyed_ps_run_uses_vpk_and_converges`` under the full
+suite (VERDICT r5 weak #4).  These tests run two capture contexts in
+sequence and assert each sees exactly its own emissions.
+"""
+
+import contextlib
+import io
+import logging
+
+from distlr_tpu.utils.logging import get_logger
+
+
+def test_handler_follows_stderr_across_capture_contexts():
+    # Create the logger INSIDE the first capture context — the original
+    # bug froze the handler onto whatever sys.stderr was at this moment.
+    buf1, buf2 = io.StringIO(), io.StringIO()
+    with contextlib.redirect_stderr(buf1):
+        log = get_logger("distlr_tpu.test_lazy_stream")
+        log.warning("first-context line")
+    with contextlib.redirect_stderr(buf2):
+        log.warning("second-context line")
+    assert "first-context line" in buf1.getvalue()
+    assert "second-context line" not in buf1.getvalue()
+    assert "second-context line" in buf2.getvalue()
+    assert "first-context line" not in buf2.getvalue()
+
+
+def test_existing_package_loggers_rebind(capfd):
+    # Loggers created long ago (package import time) must also emit to the
+    # CURRENT fd-2 stream — what capfd captures.
+    log = get_logger("distlr_tpu.train.ps_trainer")
+    capfd.readouterr()
+    log.info("rebind probe line")
+    assert "rebind probe line" in capfd.readouterr().err
+
+
+def test_single_handler_per_logger():
+    # get_logger must stay idempotent: repeated calls add no handlers.
+    a = get_logger("distlr_tpu.test_idem")
+    b = get_logger("distlr_tpu.test_idem")
+    assert a is b
+    assert len(a.handlers) == 1
+    assert isinstance(a.handlers[0], logging.StreamHandler)
