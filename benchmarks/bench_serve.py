@@ -30,6 +30,7 @@ HERE = os.path.dirname(os.path.abspath(__file__))
 REPO = os.path.dirname(HERE)
 sys.path.insert(0, REPO)
 
+from distlr_tpu.obs.tracing import get_tracer, trace_phase  # noqa: E402
 from distlr_tpu.utils.backend import force_cpu, probe_default_backend_ex  # noqa: E402
 
 
@@ -65,11 +66,13 @@ def bench_engine_rows(d: int, bucket: int, batches: int, *, sparse: bool,
                 np.ones((bucket, nnz), np.float32))
     else:
         rows = (rng.standard_normal((bucket, d)).astype(np.float32),)
-    eng.score(tuple(np.array(a) for a in rows))  # compile warmup
+    with trace_phase("warmup_compile"):
+        eng.score(tuple(np.array(a) for a in rows))  # compile warmup
     t0 = time.perf_counter()
-    for _ in range(batches):
-        # fresh arrays per call: the donating jit consumes its inputs
-        eng.score(tuple(np.array(a) for a in rows))
+    with trace_phase("engine_score"):
+        for _ in range(batches):
+            # fresh arrays per call: the donating jit consumes its inputs
+            eng.score(tuple(np.array(a) for a in rows))
     return bucket * batches / (time.perf_counter() - t0)
 
 
@@ -90,7 +93,8 @@ def bench_e2e_qps(d: int, max_batch: int, max_wait_ms: float, *,
     payload = json.dumps({"rows": lines})
     counts = [0] * clients
     with ScoringServer(eng, max_wait_ms=max_wait_ms) as srv:
-        score_lines_over_tcp(srv.host, srv.port, [payload])  # warmup
+        with trace_phase("warmup_compile"):
+            score_lines_over_tcp(srv.host, srv.port, [payload])  # warmup
         stop = time.monotonic() + duration_s
 
         def client(i):
@@ -108,10 +112,11 @@ def bench_e2e_qps(d: int, max_batch: int, max_wait_ms: float, *,
         t0 = time.monotonic()
         threads = [threading.Thread(target=client, args=(i,))
                    for i in range(clients)]
-        for t in threads:
-            t.start()
-        for t in threads:
-            t.join()
+        with trace_phase("e2e_clients"):
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
         elapsed = time.monotonic() - t0
         occupancy = srv.batcher.stats()["mean_occupancy"]
     reqs = sum(counts)
@@ -181,6 +186,7 @@ def main() -> int:
 
     engine_rates = [v for k, v in subs.items()
                     if k.startswith("engine_") and isinstance(v, float)]
+    phases = get_tracer().breakdown()
     row = {
         "metric": f"serve rows/sec, sparse LR D={d}, batched jit scoring, 1 chip",
         "value": max(engine_rates) if engine_rates else None,
@@ -189,6 +195,12 @@ def main() -> int:
         "D": d,
         "probe_status": status,
         "best_e2e": best_e2e,
+        # per-phase wall sums across the whole run (obs tracer).  Unlike
+        # bench.py's headline breakdown, phases here OVERLAP across
+        # threads (serve_score runs on the flush thread inside the
+        # e2e_clients window), so the sums explain structure, not a
+        # disjoint partition of wall clock.
+        "phase_breakdown": {"phases": phases},
         **subs,
     }
     print(json.dumps(row))

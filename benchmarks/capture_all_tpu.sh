@@ -37,7 +37,12 @@ echo "== probe =="
 timeout 90 python -c "import jax, jax.numpy as j; print('tpu ok', float(j.ones((64,64)).sum()))"
 
 echo "== bench.py (headline + sub-rates, median-of-3 windows) =="
-timeout 1200 python bench.py
+# DISTLR_METRICS_SNAPSHOT: bank the run's /metrics view (obs registry
+# Prometheus text — phase histograms, op counters) next to the JSON
+# artifacts; one-shot processes can't hold a scrape port open.
+mkdir -p benchmarks/capture_logs
+DISTLR_METRICS_SNAPSHOT="benchmarks/capture_logs/bench_metrics.prom" \
+  timeout 1200 python bench.py
 
 echo "== bench_configs.py --isolate (all 6 configs + frontier refresh) =="
 timeout 5400 python -u benchmarks/bench_configs.py --isolate
@@ -53,7 +58,6 @@ echo "== update ROOFLINE.md auto-capture section =="
 python benchmarks/update_roofline.py
 
 echo "== best-effort: pallas + streaming re-measures -> capture_logs/ =="
-mkdir -p benchmarks/capture_logs
 timeout 1200 python -u benchmarks/exp_gen_roofline2.py \
   > benchmarks/capture_logs/pallas.log 2>&1 \
   && echo "pallas ok" || echo "pallas re-measure failed (non-fatal)"

@@ -180,6 +180,15 @@ class Config:
     checkpoint_dir: str | None = None
     checkpoint_interval: int = 0      # epochs; 0 = only final save
     profile_dir: str | None = None
+    # HTTP /metrics endpoint (distlr_tpu.obs): None = off, 0 = ephemeral
+    # OS-assigned port (announced as "METRICS host:port"), else the fixed
+    # port to bind.  Serves Prometheus text at /metrics and a JSON
+    # snapshot at /metrics.json for every subsystem in this process.
+    obs_metrics_port: int | None = None
+    obs_metrics_host: str = "127.0.0.1"
+    # Write the run's phase spans as Chrome trace-event JSON here at the
+    # end of the command (loadable in Perfetto / chrome://tracing).
+    obs_trace_path: str | None = None
 
     # ---- serving (launch serve / distlr_tpu.serve) ----
     # Port 0 = OS-assigned ephemeral (announced as "SERVING host:port").
@@ -268,6 +277,13 @@ class Config:
             raise ValueError(
                 "ps_compute_backend must be auto|numpy|cpu|default, "
                 f"got {self.ps_compute_backend!r}"
+            )
+        if self.obs_metrics_port is not None and not (
+            0 <= self.obs_metrics_port < 1 << 16
+        ):
+            raise ValueError(
+                "obs_metrics_port must be None (off) or in [0, 65536), "
+                f"got {self.obs_metrics_port}"
             )
         if not 0 <= self.serve_port < 1 << 16:
             raise ValueError(f"serve_port must be in [0, 65536), got {self.serve_port}")

@@ -25,12 +25,39 @@ hosts' devices into one global mesh (ICI within host, DCN across).
 from __future__ import annotations
 
 import argparse
+import contextlib
 import sys
 
 from distlr_tpu.config import Config
 from distlr_tpu.utils.logging import get_logger
 
 log = get_logger(__name__)
+
+
+@contextlib.contextmanager
+def _obs_scope(cfg: Config):
+    """Command-scoped observability: start the /metrics endpoint when
+    ``--metrics-port`` is set (announced as ``METRICS host:port``, the
+    same scriptable contract as ``SERVING``/``HOSTS``) and dump the
+    phase-span Chrome trace at command exit when ``--trace-path`` is."""
+    server = None
+    if cfg.obs_metrics_port is not None:
+        from distlr_tpu.obs import start_metrics_server  # noqa: PLC0415
+
+        server = start_metrics_server(
+            host=cfg.obs_metrics_host, port=cfg.obs_metrics_port
+        )
+        print(f"METRICS {server.host}:{server.port}", flush=True)
+    try:
+        yield
+    finally:
+        if cfg.obs_trace_path:
+            from distlr_tpu.obs import get_tracer  # noqa: PLC0415
+
+            path = get_tracer().dump_chrome_trace(cfg.obs_trace_path)
+            log.info("phase trace -> %s (load in Perfetto)", path)
+        if server is not None:
+            server.stop()
 
 
 def _add_config_flags(p: argparse.ArgumentParser) -> None:
@@ -80,6 +107,15 @@ def _add_config_flags(p: argparse.ArgumentParser) -> None:
     p.add_argument("--checkpoint-dir", dest="checkpoint_dir")
     p.add_argument("--checkpoint-interval", dest="checkpoint_interval", type=int)
     p.add_argument("--profile-dir", dest="profile_dir")
+    p.add_argument("--metrics-port", dest="obs_metrics_port", type=int,
+                   help="serve Prometheus /metrics (+ /metrics.json) on "
+                   "this port; 0 = ephemeral, announced as "
+                   "'METRICS host:port' (default: off)")
+    p.add_argument("--metrics-host", dest="obs_metrics_host",
+                   help="bind address for --metrics-port (default 127.0.0.1)")
+    p.add_argument("--trace-path", dest="obs_trace_path",
+                   help="write per-phase Chrome trace-event JSON here at "
+                   "the end of the run (open in Perfetto)")
     p.add_argument("--resume", action="store_true")
     p.add_argument("--num-workers", dest="num_workers", type=int)
     p.add_argument("--num-servers", dest="num_servers", type=int)
@@ -117,7 +153,8 @@ def _config_from_args(args: argparse.Namespace) -> Config:
             "nnz_max", "compat_mode", "checkpoint_dir", "checkpoint_interval",
             "profile_dir", "num_workers", "num_servers", "ps_compute_backend",
             "feature_dtype", "block_size", "block_groups", "ctr_fields",
-            "hash_seed", "ps_pipeline",
+            "hash_seed", "ps_pipeline", "obs_metrics_port",
+            "obs_metrics_host", "obs_trace_path",
         }
     }
     cfg = Config.from_env(**overrides)
@@ -266,13 +303,14 @@ def cmd_sync(args: argparse.Namespace) -> int:
 
     _maybe_init_distributed(args)
     cfg = _resolve_auto_block(_config_from_args(args))
-    trainer = Trainer(cfg).load_data()
-    trainer.fit(resume=args.resume)
-    path = trainer.save_model()
-    log.info(
-        "final accuracy %.4f, %.0f samples/sec, model -> %s",
-        trainer.evaluate(), trainer.timer.samples_per_sec, path,
-    )
+    with _obs_scope(cfg):
+        trainer = Trainer(cfg).load_data()
+        trainer.fit(resume=args.resume)
+        path = trainer.save_model()
+        log.info(
+            "final accuracy %.4f, %.0f samples/sec, model -> %s",
+            trainer.evaluate(), trainer.timer.samples_per_sec, path,
+        )
     return 0
 
 
@@ -285,15 +323,16 @@ def cmd_eval(args: argparse.Namespace) -> int:
     from distlr_tpu.train.export import load_model_text  # noqa: PLC0415
 
     cfg = _resolve_auto_block(_config_from_args(args))
-    trainer = Trainer(cfg).load_data(
-        # quantized dtypes derive their scale from the train split; the
-        # default float32 path skips the (dominant) train ingest
-        test_only=cfg.feature_dtype == "float32",
-    )
-    w = load_model_text(args.model_file, shape=trainer.model.param_shape)
-    trainer.weights = trainer._shard_weights(w)
-    m = trainer.evaluate_metrics()
-    print(f"accuracy: {m['accuracy']:.4f}  test_logloss: {m['logloss']:.5f}")
+    with _obs_scope(cfg):
+        trainer = Trainer(cfg).load_data(
+            # quantized dtypes derive their scale from the train split; the
+            # default float32 path skips the (dominant) train ingest
+            test_only=cfg.feature_dtype == "float32",
+        )
+        w = load_model_text(args.model_file, shape=trainer.model.param_shape)
+        trainer.weights = trainer._shard_weights(w)
+        m = trainer.evaluate_metrics()
+        print(f"accuracy: {m['accuracy']:.4f}  test_logloss: {m['logloss']:.5f}")
     return 0
 
 
@@ -317,8 +356,10 @@ def cmd_ps(args: argparse.Namespace) -> int:
             if args.worker_ranks
             else range(cfg.num_workers)
         )
-        run_ps_workers(cfg, args.hosts, ranks, save=True, resume=args.resume,
-                       max_restarts=args.max_worker_restarts)
+        with _obs_scope(cfg):
+            run_ps_workers(cfg, args.hosts, ranks, save=True,
+                           resume=args.resume,
+                           max_restarts=args.max_worker_restarts)
     else:
         if args.worker_ranks:
             print("error: --worker-ranks requires --hosts (local mode always "
@@ -329,9 +370,10 @@ def cmd_ps(args: argparse.Namespace) -> int:
                   "state cannot be reconstructed; use --checkpoint-dir + "
                   "--resume)", file=sys.stderr)
             return 2
-        run_ps_local(cfg, save=True, resume=args.resume,
-                     max_restarts=args.max_worker_restarts,
-                     supervise_servers=args.supervise_servers)
+        with _obs_scope(cfg):
+            run_ps_local(cfg, save=True, resume=args.resume,
+                         max_restarts=args.max_worker_restarts,
+                         supervise_servers=args.supervise_servers)
     return 0
 
 
@@ -407,9 +449,10 @@ def cmd_serve(args: argparse.Namespace) -> int:
         engine, host=cfg.serve_host, port=cfg.serve_port,
         max_wait_ms=cfg.serve_max_wait_ms, reloader=reloader,
     )
-    # Scriptable readiness line, like ps-server's "HOSTS ..." contract.
-    print(f"SERVING {server.host}:{server.port}", flush=True)
-    server.serve_forever()
+    with _obs_scope(cfg):
+        # Scriptable readiness line, like ps-server's "HOSTS ..." contract.
+        print(f"SERVING {server.host}:{server.port}", flush=True)
+        server.serve_forever()
     return 0
 
 
@@ -444,7 +487,7 @@ def cmd_ps_server(args: argparse.Namespace) -> int:
         bind_any=True,
     )
     try:
-        with group:
+        with _obs_scope(cfg), group:
             # Workers pass this (with this host's address substituted for
             # 127.0.0.1) as --hosts.
             print(f"HOSTS {group.hosts}", flush=True)

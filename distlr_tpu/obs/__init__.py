@@ -1,0 +1,43 @@
+"""Unified observability: metrics registry, phase tracing, exporters.
+
+The cross-cutting layer every subsystem reports through (ISSUE 2): one
+process-wide :class:`MetricsRegistry` of labeled counters/gauges/
+histograms (PS server lifecycle + supervisor events, PS client op
+latency/bytes, trainer step rate and staleness, serving occupancy and
+request latency), a :func:`trace_phase` span API whose per-phase
+breakdown explains where step time went (Chrome trace-event dumps load
+in Perfetto), and exporters: Prometheus text + JSON snapshot over a
+stdlib HTTP endpoint (``--metrics-port`` / ``Config.obs_metrics_port``).
+
+Metric namespace (see README "Observability" for the full table):
+
+* ``distlr_ps_server_*``  — ServerGroup/ServerSupervisor lifecycle
+* ``distlr_ps_client_*``  — native KV client ops, latency, bytes
+* ``distlr_train_*``      — step/sample counters, rates, staleness
+* ``distlr_serve_*``      — request/engine/batcher series
+* ``distlr_phase_seconds``— per-phase histogram behind the tracer
+"""
+
+from distlr_tpu.obs.exporters import (  # noqa: F401
+    MetricsServer,
+    install_snapshot_atexit,
+    start_metrics_server,
+    write_metrics_snapshot,
+)
+from distlr_tpu.obs.registry import (  # noqa: F401
+    DEFAULT_BUCKETS,
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    get_registry,
+)
+from distlr_tpu.obs.tracing import (  # noqa: F401
+    PhaseTracer,
+    get_tracer,
+    trace_phase,
+)
+
+# One-shot processes (bench.py under capture_all_tpu.sh) bank their
+# metrics via DISTLR_METRICS_SNAPSHOT=<path> instead of holding a port.
+install_snapshot_atexit()

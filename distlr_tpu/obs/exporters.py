@@ -1,0 +1,127 @@
+"""Metrics exporters: Prometheus/JSON over a stdlib HTTP endpoint.
+
+``MetricsServer`` is a tiny threaded ``http.server`` (no dependencies —
+the container rule) exposing the process registry:
+
+* ``GET /metrics``       -> Prometheus text exposition (0.0.4)
+* ``GET /metrics.json``  -> JSON snapshot of every family
+* ``GET /healthz``       -> ``ok`` (liveness for deployment probes)
+
+Port 0 binds an OS-assigned ephemeral port (announced by the launcher as
+``METRICS host:port``, same contract as ``SERVING``/``HOSTS``).  The
+``DISTLR_METRICS_SNAPSHOT=<path>`` env hook writes the registry's
+Prometheus text to a file at interpreter exit — how one-shot processes
+(``bench.py`` under ``capture_all_tpu.sh``) bank their metrics without
+holding a port open.
+"""
+
+from __future__ import annotations
+
+import http.server
+import json
+import os
+import threading
+
+from distlr_tpu.obs.registry import MetricsRegistry, get_registry
+
+
+class _Handler(http.server.BaseHTTPRequestHandler):
+    def do_GET(self):  # noqa: N802 (stdlib API name)
+        registry: MetricsRegistry = self.server.registry  # type: ignore[attr-defined]
+        path = self.path.split("?", 1)[0]
+        if path in ("/metrics", "/"):
+            body = registry.prometheus_text().encode()
+            ctype = "text/plain; version=0.0.4; charset=utf-8"
+        elif path == "/metrics.json":
+            body = (json.dumps(registry.snapshot()) + "\n").encode()
+            ctype = "application/json"
+        elif path == "/healthz":
+            body, ctype = b"ok\n", "text/plain"
+        else:
+            self.send_error(404)
+            return
+        self.send_response(200)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, *args):  # scrapes must not spam stderr
+        pass
+
+
+class _HTTPServer(http.server.ThreadingHTTPServer):
+    daemon_threads = True
+    allow_reuse_address = True
+
+
+class MetricsServer:
+    """Background /metrics endpoint over one registry."""
+
+    def __init__(self, registry: MetricsRegistry | None = None, *,
+                 host: str = "127.0.0.1", port: int = 0):
+        self.registry = registry or get_registry()
+        self._http = _HTTPServer((host, port), _Handler)
+        self._http.registry = self.registry  # type: ignore[attr-defined]
+        self.host, self.port = self._http.server_address[:2]
+        self._thread = threading.Thread(
+            target=self._http.serve_forever, daemon=True,
+            name="distlr-metrics-http",
+        )
+
+    def start(self) -> "MetricsServer":
+        if not self._thread.is_alive():  # idempotent: `with start_...()`
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._http.shutdown()
+        self._http.server_close()
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+
+
+def start_metrics_server(*, host: str = "127.0.0.1", port: int = 0,
+                         registry: MetricsRegistry | None = None) -> MetricsServer:
+    return MetricsServer(registry, host=host, port=port).start()
+
+
+def write_metrics_snapshot(path: str,
+                           registry: MetricsRegistry | None = None) -> str:
+    """Write the registry's Prometheus text to ``path`` (atomic)."""
+    registry = registry or get_registry()
+    d = os.path.dirname(os.path.abspath(path))
+    os.makedirs(d, exist_ok=True)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        f.write(registry.prometheus_text())
+    os.replace(tmp, path)
+    return path
+
+
+_snapshot_installed = False
+
+
+def install_snapshot_atexit() -> bool:
+    """If ``DISTLR_METRICS_SNAPSHOT`` names a file, dump the registry's
+    Prometheus text there at interpreter exit.  Returns whether a hook
+    was installed.  Idempotent per process."""
+    global _snapshot_installed
+    path = os.environ.get("DISTLR_METRICS_SNAPSHOT")
+    if not path or _snapshot_installed:
+        return _snapshot_installed
+    import atexit  # noqa: PLC0415
+
+    def _dump():
+        try:
+            write_metrics_snapshot(path)
+        except OSError:
+            pass  # a failed snapshot must never fail the process exit
+
+    atexit.register(_dump)
+    _snapshot_installed = True
+    return True

@@ -8,13 +8,64 @@ reference worker while the gradient math runs in JAX on the chip.
 
 from __future__ import annotations
 
+import contextlib
 import ctypes
+import time
 
 import numpy as np
 
+from distlr_tpu.obs.registry import get_registry
 from distlr_tpu.ps.build import build_native, client_lib
 
 _lib = None
+
+_reg = get_registry()
+#: Per-op wall latency of the blocking native client calls.  In sync mode
+#: a push's latency INCLUDES the BSP barrier wait (the deferred reply is
+#: the barrier), which is exactly what a straggler investigation needs.
+_OP_SECONDS = _reg.histogram(
+    "distlr_ps_client_op_seconds", "wall seconds per native KV op",
+    labelnames=("op",),
+)
+_OPS_TOTAL = _reg.counter(
+    "distlr_ps_client_ops_total", "native KV ops by outcome",
+    labelnames=("op", "status"),
+)
+_BYTES_TOTAL = _reg.counter(
+    "distlr_ps_client_bytes_total",
+    "key+value payload bytes moved by native KV ops",
+    labelnames=("op", "direction"),
+)
+_CHUNKED_PULLS = _reg.counter(
+    "distlr_ps_client_chunked_pulls_total",
+    "pull_chunked calls (serving-tier bounded reads)",
+)
+_CHUNKS = _reg.counter(
+    "distlr_ps_client_chunks_total",
+    "individual bounded pull ops issued by pull_chunked",
+)
+
+
+@contextlib.contextmanager
+def _observe_op(op: str, *, sent: int = 0, received: int = 0):
+    """Record one op's latency, outcome, and payload bytes.  Timeouts are
+    distinguished from hard failures (a wedged barrier vs a dead peer
+    read very differently on a dashboard)."""
+    t0 = time.perf_counter()
+    try:
+        yield
+    except PSTimeoutError:
+        _OPS_TOTAL.labels(op=op, status="timeout").inc()
+        raise
+    except Exception:
+        _OPS_TOTAL.labels(op=op, status="error").inc()
+        raise
+    _OP_SECONDS.labels(op=op).observe(time.perf_counter() - t0)
+    _OPS_TOTAL.labels(op=op, status="ok").inc()
+    if sent:
+        _BYTES_TOTAL.labels(op=op, direction="sent").inc(sent)
+    if received:
+        _BYTES_TOTAL.labels(op=op, direction="received").inc(received)
 
 #: Order of the counters a server stats probe returns (kv_protocol.h).
 STATS_FIELDS = (
@@ -185,13 +236,14 @@ class KVWorker:
             raise ValueError(
                 f"{vals.shape[0]} vals vs {keys.shape[0]} keys "
                 f"x vals_per_key {vpk}")
-        ts = self._lib.kv_push_vpk(
-            self._h,
-            keys.ctypes.data_as(ctypes.c_void_p),
-            vals.ctypes.data_as(ctypes.c_void_p),
-            keys.shape[0], vpk,
-        )
-        return self._check(ts, "push")
+        with _observe_op("push", sent=keys.nbytes + vals.nbytes):
+            ts = self._lib.kv_push_vpk(
+                self._h,
+                keys.ctypes.data_as(ctypes.c_void_p),
+                vals.ctypes.data_as(ctypes.c_void_p),
+                keys.shape[0], vpk,
+            )
+            return self._check(ts, "push")
 
     def push_init(self, vals: np.ndarray, keys: np.ndarray | None = None,
                   *, force: bool = False) -> int:
@@ -204,14 +256,15 @@ class KVWorker:
         keys = self._all_keys if keys is None else self._validate_keys(keys)
         if vals.shape[0] != keys.shape[0]:
             raise ValueError(f"{vals.shape[0]} vals vs {keys.shape[0]} keys")
-        ts = self._lib.kv_push_init(
-            self._h,
-            keys.ctypes.data_as(ctypes.c_void_p),
-            vals.ctypes.data_as(ctypes.c_void_p),
-            keys.shape[0],
-            1 if force else 0,
-        )
-        return self._check(ts, "push_init")
+        with _observe_op("push_init", sent=keys.nbytes + vals.nbytes):
+            ts = self._lib.kv_push_init(
+                self._h,
+                keys.ctypes.data_as(ctypes.c_void_p),
+                vals.ctypes.data_as(ctypes.c_void_p),
+                keys.shape[0],
+                1 if force else 0,
+            )
+            return self._check(ts, "push_init")
 
     def push_pull(self, vals: np.ndarray,
                   keys: np.ndarray | None = None,
@@ -231,14 +284,16 @@ class KVWorker:
                 f"{vals.shape[0]} vals vs {keys.shape[0]} keys "
                 f"x vals_per_key {vpk}")
         out = np.empty(keys.shape[0] * vpk, dtype=np.float32)
-        ts = self._lib.kv_push_pull_vpk(
-            self._h,
-            keys.ctypes.data_as(ctypes.c_void_p),
-            vals.ctypes.data_as(ctypes.c_void_p),
-            out.ctypes.data_as(ctypes.c_void_p),
-            keys.shape[0], vpk,
-        )
-        self._check(ts, "push_pull")
+        with _observe_op("push_pull", sent=keys.nbytes + vals.nbytes,
+                         received=out.nbytes):
+            ts = self._lib.kv_push_pull_vpk(
+                self._h,
+                keys.ctypes.data_as(ctypes.c_void_p),
+                vals.ctypes.data_as(ctypes.c_void_p),
+                out.ctypes.data_as(ctypes.c_void_p),
+                keys.shape[0], vpk,
+            )
+            self._check(ts, "push_pull")
         return out
 
     def pull(self, keys: np.ndarray | None = None,
@@ -248,13 +303,14 @@ class KVWorker:
         vpk = int(vals_per_key)
         keys = self._default_or_validated(keys, vpk)
         out = np.empty(keys.shape[0] * vpk, dtype=np.float32)
-        ts = self._lib.kv_pull_vpk(
-            self._h,
-            keys.ctypes.data_as(ctypes.c_void_p),
-            out.ctypes.data_as(ctypes.c_void_p),
-            keys.shape[0], vpk,
-        )
-        self._check(ts, "pull")
+        with _observe_op("pull", sent=keys.nbytes, received=out.nbytes):
+            ts = self._lib.kv_pull_vpk(
+                self._h,
+                keys.ctypes.data_as(ctypes.c_void_p),
+                out.ctypes.data_as(ctypes.c_void_p),
+                keys.shape[0], vpk,
+            )
+            self._check(ts, "pull")
         return out
 
     def pull_chunked(self, keys: np.ndarray | None = None, *,
@@ -280,6 +336,7 @@ class KVWorker:
                 f"vals_per_key={vpk} rows straddle this group's range "
                 "boundaries; pull with vals_per_key=1 instead"
             )
+        _CHUNKED_PULLS.inc()
         if keys is None:
             space = self.dim // vpk
             parts = [
@@ -294,6 +351,7 @@ class KVWorker:
                 self.pull(keys=keys[lo:lo + chunk_rows], vals_per_key=vpk)
                 for lo in range(0, keys.shape[0], chunk_rows)
             ]
+        _CHUNKS.inc(len(parts))
         if not parts:  # empty key set (e.g. an empty hot-row working set)
             return np.empty(0, np.float32)
         return parts[0] if len(parts) == 1 else np.concatenate(parts)
@@ -312,7 +370,8 @@ class KVWorker:
             # the wire field is u16; silent truncation could alias a
             # released generation and turn a real barrier into a no-op
             raise ValueError(f"barrier_id must fit in uint16, got {barrier_id}")
-        self._check(self._lib.kv_barrier(self._h, barrier_id), "barrier")
+        with _observe_op("barrier"):
+            self._check(self._lib.kv_barrier(self._h, barrier_id), "barrier")
 
     def stats(self, server: int = 0) -> dict:
         """Health/progress counters of one server (never deferred, so it

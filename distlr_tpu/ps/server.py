@@ -14,10 +14,41 @@ import time
 
 import numpy as np
 
+from distlr_tpu.obs.registry import get_registry
 from distlr_tpu.ps.build import build_native, server_binary
 from distlr_tpu.utils.logging import get_logger
 
 log = get_logger(__name__)
+
+_reg = get_registry()
+_SPAWNS = _reg.counter(
+    "distlr_ps_server_spawns_total",
+    "native KV server processes spawned (incl. supervisor respawns)",
+    labelnames=("rank",),
+)
+_UP = _reg.gauge(
+    "distlr_ps_server_up",
+    "1 while this server rank's process is managed and running",
+    labelnames=("rank",),
+)
+#: kStats counters of the native servers, refreshed by every health()
+#: probe (the native process cannot scrape itself — the Python side
+#: mirrors its protocol counters into the registry).
+_SERVER_STAT = _reg.gauge(
+    "distlr_ps_server_stat",
+    "latest health-probe value of each native server kStats counter",
+    labelnames=("rank", "stat"),
+)
+_SUP_EVENTS = _reg.counter(
+    "distlr_ps_supervisor_events_total",
+    "supervisor audit-trail events (respawned/reseeded/seeded-zeros/"
+    "gave-up/respawn-failed)",
+    labelnames=("event",),
+)
+_SNAPSHOT_SECONDS = _reg.histogram(
+    "distlr_ps_supervisor_snapshot_seconds",
+    "wall seconds per supervisor rolling-snapshot cycle",
+)
 
 
 class ServerGroup:
@@ -102,6 +133,8 @@ class ServerGroup:
             raise RuntimeError(
                 f"KV server rank {rank} failed to start (got {line!r})"
             )
+        _SPAWNS.labels(rank=rank).inc()
+        _UP.labels(rank=rank).set(1)
         return proc, int(line.split()[1])
 
     def start(self) -> "ServerGroup":
@@ -162,7 +195,14 @@ class ServerGroup:
         from distlr_tpu.ps.client import KVWorker  # noqa: PLC0415  (cycle)
 
         with KVWorker(self.hosts, self.dim, client_id=0xFFFF, timeout_ms=timeout_ms) as probe:
-            return [probe.stats(rank) for rank in range(self.num_servers)]
+            stats = [probe.stats(rank) for rank in range(self.num_servers)]
+        # Mirror the native counters into the registry: the server process
+        # itself has no scrape surface, so a health probe doubles as its
+        # exporter (total_pushes/total_pulls/pending_sync_pushes/...).
+        for rank, s in enumerate(stats):
+            for name, val in s.items():
+                _SERVER_STAT.labels(rank=rank, stat=name).set(val)
+        return stats
 
     def wait(self) -> None:
         """Block until every server process exits — they do after a
@@ -187,6 +227,8 @@ class ServerGroup:
                 p.wait()
             if p.stdout:
                 p.stdout.close()
+        for rank in range(len(self.procs)):
+            _UP.labels(rank=rank).set(0)
         self.procs.clear()
 
     def __enter__(self):
@@ -256,6 +298,10 @@ class ServerSupervisor:
         #: "reseeded", "seeded-zeros", "gave-up", "respawn-failed"
         self.events: list[tuple[float, int, str]] = []
 
+    def _record_event(self, when: float, rank: int, event: str) -> None:
+        self.events.append((when, rank, event))
+        _SUP_EVENTS.labels(event=event).inc()
+
     # -- lifecycle --------------------------------------------------------
     def start(self) -> "ServerSupervisor":
         self._stop.clear()
@@ -295,6 +341,10 @@ class ServerSupervisor:
                         timeout_ms=self._timeout_ms, sync_group=False)
 
     def _try_snapshot(self) -> None:
+        with _SNAPSHOT_SECONDS.time():
+            self._try_snapshot_inner()
+
+    def _try_snapshot_inner(self) -> None:
         if self._snapshot is None:
             self._snapshot = np.zeros(self._group.dim, np.float32)
         for r in range(self._group.num_servers):
@@ -351,7 +401,7 @@ class ServerSupervisor:
             # the weights (the server's first-push-init branch)
             log.warning("supervisor: re-seed of server %d failed: %s", rank, e)
             return False
-        self.events.append((time.monotonic(), rank, event))
+        self._record_event(time.monotonic(), rank, event)
         # The respawned process restarted its push counter; forget the
         # old count so the next snapshot cycle always re-pulls this range
         # (a coincidental count match must not skip it).
@@ -381,6 +431,12 @@ class ServerSupervisor:
                 r for r, p in enumerate(procs)
                 if p.poll() is not None and p.returncode != 0
             ]
+            for r in dead:
+                # mark down at DETECTION: a gave-up or respawn-failed
+                # rank must scrape as 0, not hold the spawn-time 1 —
+                # this gauge exists to signal exactly that outage
+                # (_spawn sets it back to 1 on a successful respawn)
+                _UP.labels(rank=r).set(0)
             for rank in list(self._needs_reseed):
                 # a previously-respawned rank whose re-seed failed (e.g. a
                 # second rank was still down, so the probe could not
@@ -394,7 +450,7 @@ class ServerSupervisor:
                     ):
                         log.error("supervisor: server %d exceeded %d respawns; "
                                   "leaving it down", rank, self._max_respawns)
-                        self.events.append((now, rank, "gave-up"))
+                        self._record_event(now, rank, "gave-up")
                     continue
                 self._respawns[rank] += 1
                 try:
@@ -403,11 +459,11 @@ class ServerSupervisor:
                 except RuntimeError as e:  # spawn failure / stolen port
                     log.warning("supervisor: respawn of server %d failed: %s",
                                 rank, e)
-                    self.events.append((now, rank, "respawn-failed"))
+                    self._record_event(now, rank, "respawn-failed")
                     continue
                 log.warning("supervisor: server %d died; respawned (%d/%d)",
                             rank, self._respawns[rank], self._max_respawns)
-                self.events.append((now, rank, "respawned"))
+                self._record_event(now, rank, "respawned")
                 if not self._reseed(rank):
                     self._needs_reseed.add(rank)
             if now - self._snapshot_at >= self._snapshot_interval:

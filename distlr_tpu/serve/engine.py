@@ -36,8 +36,30 @@ import numpy as np
 
 from distlr_tpu.config import Config
 from distlr_tpu.models import get_model
+from distlr_tpu.obs.registry import get_registry
+from distlr_tpu.obs.tracing import trace_phase
 
 DEFAULT_BUCKETS = (64, 256, 1024)
+
+_reg = get_registry()
+_SCORE_SECONDS = _reg.histogram(
+    "distlr_serve_engine_seconds",
+    "wall seconds per engine score() call (pad + jit dispatch + readback)",
+)
+_ROWS_SCORED = _reg.counter(
+    "distlr_serve_engine_rows_total", "rows scored across all engines",
+)
+_BATCHES_SCORED = _reg.counter(
+    "distlr_serve_engine_batches_total", "score() calls across all engines",
+)
+_BUCKET_HITS = _reg.counter(
+    "distlr_serve_engine_bucket_hits_total",
+    "padded-batch bucket selections", labelnames=("bucket",),
+)
+_WEIGHT_SWAPS = _reg.counter(
+    "distlr_serve_weight_swaps_total",
+    "atomic weight publishes into serving engines",
+)
 
 
 def _next_bucket(n: int, ladder: tuple[int, ...]) -> int:
@@ -122,13 +144,15 @@ class ScoringEngine:
         returns the new version.  Swaps are atomic wrt ``score``: calls
         already past the reference read finish on the old weights, the
         next batch sees the new ones."""
-        w = jax.device_put(
-            np.asarray(weights, dtype=np.float32).reshape(self.model.param_shape)
-        )
-        with self._lock:
-            self._weights = w
-            self.weights_version += 1
-            return self.weights_version
+        with trace_phase("weight_swap"):
+            w = jax.device_put(
+                np.asarray(weights, dtype=np.float32).reshape(self.model.param_shape)
+            )
+            with self._lock:
+                self._weights = w
+                self.weights_version += 1
+                _WEIGHT_SWAPS.inc()
+                return self.weights_version
 
     @property
     def has_weights(self) -> bool:
@@ -155,6 +179,7 @@ class ScoringEngine:
         n = rows[0].shape[0]
         bucket = _next_bucket(n, self.buckets)
         self._bucket_hits[bucket] = self._bucket_hits.get(bucket, 0) + 1
+        _BUCKET_HITS.labels(bucket=bucket).inc()
         w = self._weights  # atomic reference read — the swap point
         labels, scores = _resolve_jit_score()(
             self.model, w, self._pad_rows(rows, bucket))
@@ -177,13 +202,16 @@ class ScoringEngine:
         if n == 0:
             return np.empty(0, np.int32), np.empty(0, np.float32)
         labels_out, scores_out = [], []
-        for lo in range(0, n, self.max_batch_size):
-            chunk = tuple(leaf[lo:lo + self.max_batch_size] for leaf in rows)
-            lab, sc = self._score_bucket(chunk)
-            labels_out.append(lab)
-            scores_out.append(sc)
+        with _SCORE_SECONDS.time():
+            for lo in range(0, n, self.max_batch_size):
+                chunk = tuple(leaf[lo:lo + self.max_batch_size] for leaf in rows)
+                lab, sc = self._score_bucket(chunk)
+                labels_out.append(lab)
+                scores_out.append(sc)
         self.batches_scored += 1
         self.rows_scored += n
+        _BATCHES_SCORED.inc()
+        _ROWS_SCORED.inc(n)
         return np.concatenate(labels_out), np.concatenate(scores_out)
 
     # -- request encoding --------------------------------------------------

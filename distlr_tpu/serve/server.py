@@ -29,22 +29,34 @@ import socket
 import socketserver
 import threading
 import time
-from collections import deque
 
 import numpy as np
 
+from distlr_tpu.obs.registry import get_registry
 from distlr_tpu.serve.batcher import MicroBatcher
 from distlr_tpu.train.metrics import MetricsLogger
 from distlr_tpu.utils.logging import get_logger
 
 log = get_logger(__name__)
 
-
-def _percentile(sorted_vals, q: float) -> float:
-    if not sorted_vals:
-        return 0.0
-    i = min(int(q * (len(sorted_vals) - 1) + 0.5), len(sorted_vals) - 1)
-    return sorted_vals[i]
+_reg = get_registry()
+#: Per-listener series ("host:port" label): several servers can share one
+#: process (tests, multi-engine front-ends) without aliasing counts.  The
+#: STATS reply answers from these — the old hand-rolled percentile deque
+#: is gone; p50/p99 are histogram-bucket estimates now (same fixed-bucket
+#: memory no matter how many requests pass).
+_REQ_SECONDS = _reg.histogram(
+    "distlr_serve_request_seconds",
+    "wall seconds per front-end request line", labelnames=("listener",),
+)
+_REQUESTS = _reg.counter(
+    "distlr_serve_requests_total", "request lines answered OK",
+    labelnames=("listener",),
+)
+_ERRORS = _reg.counter(
+    "distlr_serve_errors_total", "request lines answered ERR",
+    labelnames=("listener",),
+)
 
 
 class _Handler(socketserver.StreamRequestHandler):
@@ -84,14 +96,21 @@ class ScoringServer:
             max_wait_ms=max_wait_ms,
         )
         self.metrics = metrics or MetricsLogger()
-        self._latencies_ms: deque[float] = deque(maxlen=8192)
-        self._requests = 0
-        self._errors = 0
-        self._stats_lock = threading.Lock()
         self._t0 = time.monotonic()
         self._tcp = _TCPServer((host, port), _Handler, bind_and_activate=True)
         self._tcp.scoring_server = self  # type: ignore[attr-defined]
         self.host, self.port = self._tcp.server_address[:2]
+        listener = f"{self.host}:{self.port}"
+        self._req_seconds = _REQ_SECONDS.labels(listener=listener)
+        self._requests_c = _REQUESTS.labels(listener=listener)
+        self._errors_c = _ERRORS.labels(listener=listener)
+        # Registry children are process-lifetime: a restarted server on
+        # the same FIXED port resolves the same label set, so STATS
+        # reports deltas against construction-time baselines (the scrape
+        # stays cumulative, as Prometheus counters should).  Percentiles
+        # still aggregate the listener's full process history.
+        self._req_base = self._requests_c.value
+        self._err_base = self._errors_c.value
         self._thread = threading.Thread(
             target=self._tcp.serve_forever, daemon=True,
             name="distlr-serve-accept",
@@ -122,39 +141,42 @@ class ScoringServer:
                 labels, scores = self._score_lines([line])
                 reply = f"{int(labels[0])} {float(scores[0]):.6g}"
         except Exception as e:
-            with self._stats_lock:
-                self._errors += 1
+            self._errors_c.inc()
             return f"ERR {type(e).__name__}: {e}"
-        dt_ms = (time.monotonic() - t0) * 1000.0
-        with self._stats_lock:
-            self._requests += 1
-            self._latencies_ms.append(dt_ms)
+        self._req_seconds.observe(time.monotonic() - t0)
+        self._requests_c.inc()
         return reply
 
     # -- stats -------------------------------------------------------------
     def stats(self) -> dict:
-        with self._stats_lock:
-            lat = sorted(self._latencies_ms)
-            n_req, n_err = self._requests, self._errors
+        """STATS reply, answered from the obs registry (schema unchanged
+        from the pre-registry accumulator: requests/errors/qps/p50_ms/
+        p99_ms + batcher/engine sub-objects — pinned by the regression
+        test in tests/test_serve.py)."""
+        n_req = int(self._requests_c.value - self._req_base)
+        n_err = int(self._errors_c.value - self._err_base)
         elapsed = max(time.monotonic() - self._t0, 1e-9)
         rec = {
             "requests": n_req,
             "errors": n_err,
             "qps": round(n_req / elapsed, 2),
-            "p50_ms": round(_percentile(lat, 0.50), 3),
-            "p99_ms": round(_percentile(lat, 0.99), 3),
+            "p50_ms": round(self._req_seconds.percentile(0.50) * 1e3, 3),
+            "p99_ms": round(self._req_seconds.percentile(0.99) * 1e3, 3),
             "batcher": self.batcher.stats(),
             "engine": self.engine.stats(),
         }
         if self.reloader is not None:
             rec["reload"] = self.reloader.stats()
         # mirror into the structured metrics stream (train/metrics.py
-        # conventions: one flat record per observation)
-        self.metrics.log(
-            requests=rec["requests"], qps=rec["qps"],
-            p50_ms=rec["p50_ms"], p99_ms=rec["p99_ms"],
-            occupancy=rec["batcher"]["mean_occupancy"],
-        )
+        # conventions: one flat record per observation) — unless the
+        # logger was closed by stop(): final stats after shutdown must
+        # still be readable, only the mirror is gone
+        if not self.metrics.closed:
+            self.metrics.log(
+                requests=rec["requests"], qps=rec["qps"],
+                p50_ms=rec["p50_ms"], p99_ms=rec["p99_ms"],
+                occupancy=rec["batcher"]["mean_occupancy"],
+            )
         return rec
 
     # -- lifecycle ---------------------------------------------------------

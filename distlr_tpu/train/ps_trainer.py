@@ -40,12 +40,30 @@ from distlr_tpu.data import DataIter
 from distlr_tpu.data.iterator import SparseDataIter
 from distlr_tpu.data.sharding import part_name
 from distlr_tpu.models import get_model
+from distlr_tpu.obs.registry import get_registry
+from distlr_tpu.obs.tracing import trace_phase
 from distlr_tpu.ps import KVWorker, ServerGroup
 from distlr_tpu.train.export import save_model_text
-from distlr_tpu.train.metrics import MetricsLogger
+from distlr_tpu.train.metrics import MetricsLogger, StepTimer
 from distlr_tpu.utils.logging import get_logger, log_eval_line
 
 log = get_logger(__name__)
+
+#: Gradient staleness, measured as WEIGHT AGE: seconds between pulling
+#: the weights a gradient was computed from and that gradient landing on
+#: the servers.  In sync BSP this is just the round latency; in async
+#: (Hogwild) it is the real staleness bound the convergence analyses
+#: (arXiv:1508.05711) reason about — peers' pushes during this window are
+#: what the gradient is stale against.
+_STALENESS = get_registry().gauge(
+    "distlr_train_staleness_seconds",
+    "age of the weights behind the most recent gradient push",
+    labelnames=("rank",),
+)
+_RESTARTS = get_registry().counter(
+    "distlr_ps_worker_restarts_total",
+    "PS workers rebuilt in place after a failure (max_restarts path)",
+)
 
 
 # Below this many per-batch elements (param_dim * batch), the gradient
@@ -411,12 +429,17 @@ class PSWorker:
             self._grad_fn = _compiled_fns(self.model, cfg.l2_c, bool(cfg.l2_scale_by_batch))
             self._acc_fn = _compiled_acc(self.model)
         self.metrics = MetricsLogger()
+        # Registry-backed step accounting; "ps" counters are cumulative
+        # across the process's worker threads (Hogwild runs several),
+        # while each worker's throughput gauge is its own instance.
+        self.timer = StepTimer(loop="ps", instance=str(rank))
         self.final_weights: np.ndarray | None = None
         self._barrier_base = 0
         self._sidecar_attempt = 0
         # pipelined dense path state: last fused-reply weights, and a
         # single comm thread (KV ops must never overlap on one connection)
         self._w_cache: np.ndarray | None = None
+        self._w_time = 0.0  # when _w_cache was pulled (staleness gauge)
         self._comm = None
         if cfg.model in ("sparse_lr", "blocked_lr") and cfg.l2_c > 0:
             # Keyed PS applies L2 lazily (only a batch's touched keys/rows
@@ -515,10 +538,12 @@ class PSWorker:
             # wrong state.  A restarted worker (rejoin) must NOT force:
             # it would roll peers back mid-run.
             force = resume and not rejoin
-            self.kv.wait(self.kv.push_init(w0, force=force))
+            with trace_phase("push"):
+                self.kv.wait(self.kv.push_init(w0, force=force))
         self._barrier_base = 0 if attempt is None else 2 * (attempt + 1)
         self._sidecar_attempt = 0 if attempt is None else attempt
-        self.kv.barrier(self._barrier_base)
+        with trace_phase("barrier_wait"):
+            self.kv.barrier(self._barrier_base)
 
         ckpt = None
         if self.rank == 0 and cfg.checkpoint_dir:
@@ -646,26 +671,52 @@ class PSWorker:
                 # dense path, there is no fused op here to REMOVE a round
                 # trip (pull and push key sets differ per batch).
                 for b in train:
-                    keys, rest = prep(b)
-                    w_u = self.kv.pull(keys=keys, vals_per_key=vpk)
-                    self.kv.wait(self.kv.push(kgrad(w_u, rest), keys=keys,
-                                              vals_per_key=vpk))
+                    self.timer.start()
+                    with trace_phase("data_load"):
+                        keys, rest = prep(b)
+                    t_pull = time.perf_counter()
+                    with trace_phase("pull"):
+                        w_u = self.kv.pull(keys=keys, vals_per_key=vpk)
+                    with trace_phase("compute"):
+                        g = kgrad(w_u, rest)
+                    if not cfg.sync_mode:
+                        _STALENESS.labels(rank=self.rank).set(
+                            time.perf_counter() - t_pull)
+                    with trace_phase("push"):
+                        self.kv.wait(self.kv.push(g, keys=keys,
+                                                  vals_per_key=vpk))
+                    self.timer.stop(int(b[-1].sum()))
             elif not cfg.ps_pipeline:
                 # Reference-faithful serialized protocol: two blocking
                 # round trips per batch (src/lr.cc:116-132).
                 for X, y, mask in train:
-                    w = self.kv.pull()
-                    self.kv.wait(self.kv.push(compute_g(w, X, y, mask)))
+                    self.timer.start()
+                    t_pull = time.perf_counter()
+                    with trace_phase("pull"):
+                        w = self.kv.pull()
+                    with trace_phase("compute"):
+                        g = compute_g(w, X, y, mask)
+                    if not cfg.sync_mode:
+                        _STALENESS.labels(rank=self.rank).set(
+                            time.perf_counter() - t_pull)
+                    with trace_phase("push"):
+                        self.kv.wait(self.kv.push(g))
+                    self.timer.stop(int(mask.sum()))
             elif cfg.sync_mode:
                 # Fused BSP: ONE deferred round trip per batch; the reply
                 # is the post-round weights = what the next pull would
                 # return (rounds totally ordered -> bit-identical
                 # trajectory, pinned by the oracle parity tests).
                 if self._w_cache is None:
-                    self._w_cache = self.kv.pull()
+                    with trace_phase("pull"):
+                        self._w_cache = self.kv.pull()
                 for X, y, mask in train:
-                    self._w_cache = self.kv.push_pull(
-                        compute_g(self._w_cache, X, y, mask))
+                    self.timer.start()
+                    with trace_phase("compute"):
+                        g = compute_g(self._w_cache, X, y, mask)
+                    with trace_phase("push"):
+                        self._w_cache = self.kv.push_pull(g)
+                    self.timer.stop(int(mask.sum()))
             else:
                 # Pipelined async (Hogwild): fused round trips double-
                 # buffered against compute — batch k+1's gradient is
@@ -674,40 +725,56 @@ class PSWorker:
                 # push; KV ops stay serialized on the comm thread (one
                 # connection, never two ops concurrently).
                 if self._w_cache is None:
-                    self._w_cache = self.kv.pull()
+                    with trace_phase("pull"):
+                        self._w_cache = self.kv.pull()
+                    self._w_time = time.perf_counter()
                 fut = None
                 for X, y, mask in train:
-                    g = compute_g(self._w_cache, X, y, mask)
+                    self.timer.start()
+                    with trace_phase("compute"):
+                        g = compute_g(self._w_cache, X, y, mask)
+                    # g rides weights pulled at _w_time; its round trip
+                    # starts now — the age at landing is ~this (+ one
+                    # in-flight RTT, bounded by the next result() wait)
+                    _STALENESS.labels(rank=self.rank).set(
+                        time.perf_counter() - self._w_time)
                     if fut is not None:
-                        self._w_cache = fut.result()
+                        with trace_phase("push"):
+                            self._w_cache = fut.result()
+                        self._w_time = time.perf_counter()
                     fut = self._comm_pool().submit(self.kv.push_pull, g)
+                    self.timer.stop(int(mask.sum()))
                 if fut is not None:
-                    self._w_cache = fut.result()
+                    with trace_phase("push"):
+                        self._w_cache = fut.result()
+                    self._w_time = time.perf_counter()
             if (
                 self.rank == 0
                 and test is not None
                 and cfg.test_interval > 0
                 and (epoch + 1) % cfg.test_interval == 0
             ):
-                if cfg.model == "sparse_softmax":
-                    acc, test_ll = self._sparse_softmax_eval(test)
-                elif sparse:
-                    acc, test_ll = self._sparse_eval(test)
-                elif blocked:
-                    acc, test_ll = self._blocked_eval(test)
-                else:
-                    w = self.kv.pull()
-                    test.reset()
-                    Xt, yt, mt = test.next_batch()
-                    if eval_dev == "numpy":
-                        acc, test_ll = _np_dense_eval(
-                            w.reshape(cfg.num_feature_dim, K) if K else w,
-                            Xt, yt, mt.astype(np.float32), K)
+                with trace_phase("eval"):
+                    if cfg.model == "sparse_softmax":
+                        acc, test_ll = self._sparse_softmax_eval(test)
+                    elif sparse:
+                        acc, test_ll = self._sparse_eval(test)
+                    elif blocked:
+                        acc, test_ll = self._blocked_eval(test)
                     else:
-                        a, ll = self._acc_fn(*self._place(eval_dev, self._shape_params(w), Xt, yt, mt))
-                        acc, test_ll = float(a), float(ll)
+                        w = self.kv.pull()
+                        test.reset()
+                        Xt, yt, mt = test.next_batch()
+                        if eval_dev == "numpy":
+                            acc, test_ll = _np_dense_eval(
+                                w.reshape(cfg.num_feature_dim, K) if K else w,
+                                Xt, yt, mt.astype(np.float32), K)
+                        else:
+                            a, ll = self._acc_fn(*self._place(eval_dev, self._shape_params(w), Xt, yt, mt))
+                            acc, test_ll = float(a), float(ll)
                 self.metrics.log(epoch=epoch + 1, accuracy=acc,
-                                 test_logloss=test_ll)
+                                 test_logloss=test_ll,
+                                 samples_per_sec=self.timer.samples_per_sec)
                 if eval_fn is not None:
                     eval_fn(epoch + 1, acc)
                 else:
@@ -717,16 +784,19 @@ class PSWorker:
                 and cfg.checkpoint_interval > 0
                 and (epoch + 1) % cfg.checkpoint_interval == 0
             ):
-                self._checkpoint(ckpt, epoch + 1)
+                with trace_phase("checkpoint"):
+                    self._checkpoint(ckpt, epoch + 1)
 
         if (
             ckpt is not None
             and cfg.num_iteration > start_epoch
             and ckpt.latest_step() != cfg.num_iteration
         ):
-            self._checkpoint(ckpt, cfg.num_iteration)
+            with trace_phase("checkpoint"):
+                self._checkpoint(ckpt, cfg.num_iteration)
 
-        self.final_weights = self.kv.pull()
+        with trace_phase("pull"):
+            self.final_weights = self.kv.pull()
         if save:
             path = os.path.join(cfg.data_dir, "models", part_name(self.rank))
             os.makedirs(os.path.dirname(path), exist_ok=True)
@@ -737,7 +807,8 @@ class PSWorker:
         # group — this is what lets foreground `launch ps-server` hosts
         # exit when training is done (local mode: ServerGroup.stop()
         # finds the procs exited).
-        self.kv.barrier(self._barrier_base + 1)
+        with trace_phase("barrier_wait"):
+            self.kv.barrier(self._barrier_base + 1)
         if self.rank == 0:
             self.kv.shutdown_servers()
         return self.final_weights
@@ -900,6 +971,7 @@ def run_ps_workers(cfg: Config, hosts: str, ranks, *, eval_fn=None, save=False,
                         # straggler failure, SURVEY.md §5.3).
                         on_error()
                     return
+                _RESTARTS.inc()
                 log.warning("worker %d failed (%s); restart %d/%d",
                             r, e, attempts, max_restarts)
                 # Rebuild with a short reconnect window: when the failure

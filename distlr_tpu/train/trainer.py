@@ -31,6 +31,7 @@ from distlr_tpu.config import Config
 from distlr_tpu.data import DataIter, parse_libsvm_file
 from distlr_tpu.data.sharding import part_name
 from distlr_tpu.models import get_model
+from distlr_tpu.obs.tracing import trace_phase
 from distlr_tpu.parallel import (
     make_eval_step,
     make_mesh,
@@ -523,16 +524,22 @@ class Trainer:
             if ckpt is not None:
                 stack.callback(ckpt.close)
 
+            def shard_traced(hb):
+                with trace_phase("h2d"):
+                    return self._shard_batch(hb)
+
             for epoch in range(start_epoch, epochs):
                 host_iter = self._train_data.batches(
                     cfg.batch_size, wrap=bool(cfg.wrap_final_batch)
                 )
                 if cfg.prefetch > 1:
+                    # h2d spans land on the producer thread's timeline —
+                    # the trace shows the overlap the prefetch buys
                     pairs = _prefetch_to_device(
-                        self._shard_batch, host_iter, cfg.prefetch - 1
+                        shard_traced, host_iter, cfg.prefetch - 1
                     )
                 else:  # prefetch=1: the strictly-serial reference shape
-                    pairs = ((hb, self._shard_batch(hb)) for hb in host_iter)
+                    pairs = ((hb, shard_traced(hb)) for hb in host_iter)
                 # closing() runs the generator's finally DETERMINISTICALLY
                 # when a step raises — relying on GC leaves the producer
                 # thread blocked on the queue for as long as the caller
@@ -540,14 +547,25 @@ class Trainer:
                 # does), and a retried fit() would stack a second
                 # producer on top.
                 with contextlib.closing(pairs):
-                    for host_batch, batch in pairs:
+                    it = iter(pairs)
+                    while True:
+                        # data_load = time this consumer spent WAITING for
+                        # the next device-ready batch (0-ish when prefetch
+                        # keeps up; the ingest wall when it does not)
+                        with trace_phase("data_load"):
+                            pair = next(it, None)
+                        if pair is None:
+                            break
+                        host_batch, batch = pair
                         self.timer.start()
-                        self.weights, step_metrics = self.train_step(self.weights, batch)
-                        jax.block_until_ready(self.weights)
+                        with trace_phase("compute"):
+                            self.weights, step_metrics = self.train_step(self.weights, batch)
+                            jax.block_until_ready(self.weights)
                         self.timer.stop(int(host_batch[-1].sum()))
                 if test_batch is not None and cfg.test_interval > 0 and (epoch + 1) % cfg.test_interval == 0:
-                    em = self.eval_step(self.weights, test_batch)
-                    acc = float(em["accuracy"])
+                    with trace_phase("eval"):
+                        em = self.eval_step(self.weights, test_batch)
+                        acc = float(em["accuracy"])
                     self.metrics.log(
                         epoch=epoch + 1,
                         accuracy=acc,
@@ -566,10 +584,12 @@ class Trainer:
                     and cfg.checkpoint_interval > 0
                     and (epoch + 1) % cfg.checkpoint_interval == 0
                 ):
-                    ckpt.save(epoch + 1, self.weights, extra={"epoch": epoch + 1})
+                    with trace_phase("checkpoint"):
+                        ckpt.save(epoch + 1, self.weights, extra={"epoch": epoch + 1})
 
             if ckpt is not None and epochs > start_epoch and ckpt.latest_step() != epochs:
-                ckpt.save(epochs, self.weights, extra={"epoch": epochs})
+                with trace_phase("checkpoint"):
+                    ckpt.save(epochs, self.weights, extra={"epoch": epochs})
         return self.weights
 
     def evaluate(self) -> float:
@@ -577,9 +597,10 @@ class Trainer:
 
     def evaluate_metrics(self) -> dict:
         """Full-test-set ``{"accuracy", "logloss"}`` as Python floats."""
-        test_batch = self._shard_batch(self._test_data.full_batch())
-        em = self.eval_step(self.weights, test_batch)
-        return {k: float(v) for k, v in em.items()}
+        with trace_phase("eval"):
+            test_batch = self._shard_batch(self._test_data.full_batch())
+            em = self.eval_step(self.weights, test_batch)
+            return {k: float(v) for k, v in em.items()}
 
     def save_model(self, path: str | None = None) -> str:
         """Text export, reference format & layout: ``models/part-00{i+1}``

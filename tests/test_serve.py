@@ -472,3 +472,79 @@ class TestHotReload:
             # empty hot-row working set: empty result, not a crash
             empty = kv.pull_chunked(np.array([], np.uint64), chunk_rows=4)
             assert empty.shape == (0,) and empty.dtype == np.float32
+
+
+class TestStatsSchemaRegression:
+    """Satellite (ISSUE 2): STATS now answers from the obs registry
+    histogram instead of a hand-rolled percentile deque — the reply
+    schema must stay byte-compatible (keys, types, rounding) so existing
+    scrapers keep parsing."""
+
+    def _server(self):
+        cfg = Config(num_feature_dim=8, model="binary_lr", l2_c=0.0)
+        eng = ScoringEngine(cfg, max_batch_size=64)
+        eng.set_weights(np.linspace(-1, 1, 8).astype(np.float32))
+        return ScoringServer(eng, max_wait_ms=0.5)
+
+    def test_stats_schema_and_types(self):
+        with self._server() as srv:
+            for _ in range(5):
+                score_lines_over_tcp(srv.host, srv.port, ["1:1 3:1"])
+            score_lines_over_tcp(srv.host, srv.port, ['{"rows": []}'])  # ERR
+            (raw,) = score_lines_over_tcp(srv.host, srv.port, ["STATS"])
+        stats = json.loads(raw)
+        # exact top-level key set of the pre-registry accumulator
+        assert set(stats) == {"requests", "errors", "qps", "p50_ms",
+                              "p99_ms", "batcher", "engine"}
+        assert isinstance(stats["requests"], int) and stats["requests"] >= 5
+        assert isinstance(stats["errors"], int) and stats["errors"] == 1
+        assert isinstance(stats["qps"], (int, float)) and stats["qps"] > 0
+        for k in ("p50_ms", "p99_ms"):
+            assert isinstance(stats[k], (int, float)) and stats[k] >= 0
+        assert stats["p50_ms"] <= stats["p99_ms"]
+        # rounding contract: qps to 2 decimals, percentiles to 3
+        assert round(stats["qps"], 2) == stats["qps"]
+        assert round(stats["p50_ms"], 3) == stats["p50_ms"]
+        # sub-object schemas unchanged
+        assert set(stats["batcher"]) == {
+            "batches", "requests", "rows", "mean_occupancy",
+            "mean_requests_per_batch", "max_batch_size", "max_wait_ms"}
+        assert set(stats["engine"]) == {
+            "weights_version", "batches_scored", "rows_scored",
+            "bucket_hits", "buckets"}
+
+    def test_percentiles_track_real_latency_scale(self):
+        """Bucket-interpolated percentiles stay on the right order of
+        magnitude (a localhost scoring line answers in well under 10 s
+        and in more than 0 ms)."""
+        with self._server() as srv:
+            for _ in range(20):
+                score_lines_over_tcp(srv.host, srv.port, ["1:1"])
+            stats = json.loads(
+                score_lines_over_tcp(srv.host, srv.port, ["STATS"])[0])
+        assert 0.0 < stats["p50_ms"] < 10_000.0
+        assert stats["p50_ms"] <= stats["p99_ms"] < 10_000.0
+
+    def test_stats_readable_after_stop(self):
+        """Final stats must survive shutdown: stop() closes the
+        structured-metrics sink, but stats() still answers from the
+        registry (only the record mirror is skipped)."""
+        with self._server() as srv:
+            score_lines_over_tcp(srv.host, srv.port, ["1:1", "2:1"])
+        post = srv.stats()  # after the with-block: server is stopped
+        assert post["requests"] == 2 and post["errors"] == 0
+        assert post["p50_ms"] >= 0
+
+    def test_per_listener_isolation(self):
+        """Two servers in one process must not alias each other's
+        request counts (per-listener registry labels)."""
+        with self._server() as a:
+            score_lines_over_tcp(a.host, a.port, ["1:1", "2:1", "3:1"])
+            with self._server() as b:
+                score_lines_over_tcp(b.host, b.port, ["1:1"])
+                sb = json.loads(
+                    score_lines_over_tcp(b.host, b.port, ["STATS"])[0])
+            sa = json.loads(
+                score_lines_over_tcp(a.host, a.port, ["STATS"])[0])
+        assert sb["requests"] == 1
+        assert sa["requests"] == 3
