@@ -39,9 +39,11 @@ timeout 90 python -c "import jax, jax.numpy as j; print('tpu ok', float(j.ones((
 echo "== bench.py (headline + sub-rates, median-of-3 windows) =="
 # DISTLR_METRICS_SNAPSHOT: bank the run's /metrics view (obs registry
 # Prometheus text — phase histograms, op counters) next to the JSON
-# artifacts; one-shot processes can't hold a scrape port open.
-mkdir -p benchmarks/capture_logs
-DISTLR_METRICS_SNAPSHOT="benchmarks/capture_logs/bench_metrics.prom" \
+# artifacts; one-shot processes can't hold a scrape port open.  The
+# second (pathsep-separated) target banks the JSON twin into the fleet
+# run dir's snapshots/ — what `launch obs-agg --once` federates below.
+mkdir -p benchmarks/capture_logs/fleet/snapshots
+DISTLR_METRICS_SNAPSHOT="benchmarks/capture_logs/bench_metrics.prom:benchmarks/capture_logs/fleet/snapshots/bench-0.json" \
   timeout 1200 python bench.py
 
 echo "== bench_configs.py --isolate (all 6 configs + frontier refresh) =="
@@ -53,6 +55,16 @@ python bench.py --requality-lkg
 echo "== exp_blocked_batch.py (B sweep + G variants; best-effort) =="
 timeout 1800 python -u benchmarks/exp_blocked_batch.py \
   || echo "exp_blocked_batch failed (non-fatal; artifact not refreshed)"
+
+echo "== bank the fleet metrics snapshot (merged view; best-effort) =="
+# Federates every snapshot banked into the window's fleet dir (today:
+# bench.py; any --obs-run-dir'd process that joins a future window rides
+# along) into ONE merged scrape next to the per-process bank — jax-free,
+# so it cannot perturb the chip between steps.
+python -m distlr_tpu.launch obs-agg \
+  --obs-run-dir benchmarks/capture_logs/fleet --once \
+  --snapshot-path benchmarks/capture_logs/fleet_metrics.prom \
+  || echo "fleet snapshot failed (non-fatal; per-process bank still exists)"
 
 echo "== update ROOFLINE.md auto-capture section =="
 python benchmarks/update_roofline.py

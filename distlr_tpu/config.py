@@ -186,6 +186,13 @@ class Config:
     # snapshot at /metrics.json for every subsystem in this process.
     obs_metrics_port: int | None = None
     obs_metrics_host: str = "127.0.0.1"
+    # Fleet-observability rendezvous dir shared by every process of one
+    # run: each launched process publishes its scrape endpoint as
+    # <obs_run_dir>/endpoints/<role>-<rank>.json (and, when set, a
+    # missing obs_metrics_port defaults to 0 — an ephemeral endpoint is
+    # the whole point of joining a fleet).  `launch obs-agg` polls the
+    # dir and serves the merged fleet scrape; `launch top` renders it.
+    obs_run_dir: str | None = None
     # Write the run's phase spans as Chrome trace-event JSON here at the
     # end of the command (loadable in Perfetto / chrome://tracing).
     obs_trace_path: str | None = None

@@ -34,20 +34,46 @@ from distlr_tpu.utils.logging import get_logger
 log = get_logger(__name__)
 
 
+def _obs_rank(args: argparse.Namespace) -> int:
+    """This process's fleet rank (the <rank> of its endpoint file):
+    the explicit multi-host process id when given, else the lowest
+    worker rank this process runs, else 0."""
+    pid = getattr(args, "process_id", None)
+    if pid is not None:  # an explicit process id 0 counts too
+        return pid
+    ranks = getattr(args, "worker_ranks", None)
+    if ranks:
+        return min(int(s) for s in ranks.split(","))
+    return 0
+
+
 @contextlib.contextmanager
-def _obs_scope(cfg: Config):
+def _obs_scope(cfg: Config, role: str | None = None, rank: int = 0):
     """Command-scoped observability: start the /metrics endpoint when
     ``--metrics-port`` is set (announced as ``METRICS host:port``, the
     same scriptable contract as ``SERVING``/``HOSTS``) and dump the
-    phase-span Chrome trace at command exit when ``--trace-path`` is."""
+    phase-span Chrome trace at command exit when ``--trace-path`` is.
+
+    With ``--obs-run-dir`` the process additionally joins the fleet:
+    the endpoint (defaulting to an ephemeral port when no explicit
+    ``--metrics-port`` was given) is published as
+    ``<run_dir>/endpoints/<role>-<rank>.json`` for ``launch obs-agg``
+    to discover and federate."""
     server = None
-    if cfg.obs_metrics_port is not None:
+    endpoint = None
+    port = cfg.obs_metrics_port
+    if port is None and cfg.obs_run_dir and role is not None:
+        port = 0  # joining a fleet implies a scrape endpoint
+    if port is not None:
         from distlr_tpu.obs import start_metrics_server  # noqa: PLC0415
 
-        server = start_metrics_server(
-            host=cfg.obs_metrics_host, port=cfg.obs_metrics_port
-        )
+        server = start_metrics_server(host=cfg.obs_metrics_host, port=port)
         print(f"METRICS {server.host}:{server.port}", flush=True)
+        if cfg.obs_run_dir and role is not None:
+            from distlr_tpu.obs import write_endpoint  # noqa: PLC0415
+
+            endpoint = write_endpoint(cfg.obs_run_dir, role, rank,
+                                      server.host, server.port)
     try:
         yield
     finally:
@@ -58,6 +84,15 @@ def _obs_scope(cfg: Config):
             log.info("phase trace -> %s (load in Perfetto)", path)
         if server is not None:
             server.stop()
+        if endpoint is not None:
+            # A clean exit leaves the fleet, so the aggregator forgets
+            # this rank instead of alerting it down forever; a CRASH
+            # never reaches this finally — the lingering endpoint file
+            # is exactly what makes the outage scrape as down.
+            import os  # noqa: PLC0415
+
+            with contextlib.suppress(OSError):
+                os.unlink(endpoint)
 
 
 def _add_config_flags(p: argparse.ArgumentParser) -> None:
@@ -113,6 +148,12 @@ def _add_config_flags(p: argparse.ArgumentParser) -> None:
                    "'METRICS host:port' (default: off)")
     p.add_argument("--metrics-host", dest="obs_metrics_host",
                    help="bind address for --metrics-port (default 127.0.0.1)")
+    p.add_argument("--obs-run-dir", dest="obs_run_dir",
+                   help="fleet rendezvous dir shared by every process of "
+                   "this run: publishes this process's scrape endpoint as "
+                   "endpoints/<role>-<rank>.json (implies --metrics-port 0 "
+                   "when none is given); `launch obs-agg` federates the "
+                   "dir, `launch top` watches it")
     p.add_argument("--trace-path", dest="obs_trace_path",
                    help="write per-phase Chrome trace-event JSON here at "
                    "the end of the run (open in Perfetto)")
@@ -154,7 +195,7 @@ def _config_from_args(args: argparse.Namespace) -> Config:
             "profile_dir", "num_workers", "num_servers", "ps_compute_backend",
             "feature_dtype", "block_size", "block_groups", "ctr_fields",
             "hash_seed", "ps_pipeline", "obs_metrics_port",
-            "obs_metrics_host", "obs_trace_path",
+            "obs_metrics_host", "obs_trace_path", "obs_run_dir",
         }
     }
     cfg = Config.from_env(**overrides)
@@ -303,7 +344,7 @@ def cmd_sync(args: argparse.Namespace) -> int:
 
     _maybe_init_distributed(args)
     cfg = _resolve_auto_block(_config_from_args(args))
-    with _obs_scope(cfg):
+    with _obs_scope(cfg, "sync", _obs_rank(args)):
         trainer = Trainer(cfg).load_data()
         trainer.fit(resume=args.resume)
         path = trainer.save_model()
@@ -323,7 +364,7 @@ def cmd_eval(args: argparse.Namespace) -> int:
     from distlr_tpu.train.export import load_model_text  # noqa: PLC0415
 
     cfg = _resolve_auto_block(_config_from_args(args))
-    with _obs_scope(cfg):
+    with _obs_scope(cfg, "eval", _obs_rank(args)):
         trainer = Trainer(cfg).load_data(
             # quantized dtypes derive their scale from the train split; the
             # default float32 path skips the (dominant) train ingest
@@ -356,7 +397,7 @@ def cmd_ps(args: argparse.Namespace) -> int:
             if args.worker_ranks
             else range(cfg.num_workers)
         )
-        with _obs_scope(cfg):
+        with _obs_scope(cfg, "ps", _obs_rank(args)):
             run_ps_workers(cfg, args.hosts, ranks, save=True,
                            resume=args.resume,
                            max_restarts=args.max_worker_restarts)
@@ -370,7 +411,7 @@ def cmd_ps(args: argparse.Namespace) -> int:
                   "state cannot be reconstructed; use --checkpoint-dir + "
                   "--resume)", file=sys.stderr)
             return 2
-        with _obs_scope(cfg):
+        with _obs_scope(cfg, "ps", _obs_rank(args)):
             run_ps_local(cfg, save=True, resume=args.resume,
                          max_restarts=args.max_worker_restarts,
                          supervise_servers=args.supervise_servers)
@@ -449,7 +490,7 @@ def cmd_serve(args: argparse.Namespace) -> int:
         engine, host=cfg.serve_host, port=cfg.serve_port,
         max_wait_ms=cfg.serve_max_wait_ms, reloader=reloader,
     )
-    with _obs_scope(cfg):
+    with _obs_scope(cfg, "serve", _obs_rank(args)):
         # Scriptable readiness line, like ps-server's "HOSTS ..." contract.
         print(f"SERVING {server.host}:{server.port}", flush=True)
         server.serve_forever()
@@ -487,7 +528,7 @@ def cmd_ps_server(args: argparse.Namespace) -> int:
         bind_any=True,
     )
     try:
-        with _obs_scope(cfg), group:
+        with _obs_scope(cfg, "ps-server", _obs_rank(args)), group:
             # Workers pass this (with this host's address substituted for
             # 127.0.0.1) as --hosts.
             print(f"HOSTS {group.hosts}", flush=True)
@@ -495,6 +536,95 @@ def cmd_ps_server(args: argparse.Namespace) -> int:
     except KeyboardInterrupt:
         return 130  # interrupted != clean worker-driven shutdown
     return 0
+
+
+def cmd_obs_agg(args: argparse.Namespace) -> int:
+    """Fleet metrics aggregator (:mod:`distlr_tpu.obs.federate`): poll
+    every endpoint published under ``--obs-run-dir``, merge the per-rank
+    registries (counters sum, histograms merge bucket-wise, gauges gain
+    ``role``/``rank`` identity), derive the ``distlr_alert_*`` gauges,
+    and re-serve the fleet as ``/metrics`` + ``/metrics.json`` +
+    ``/fleet.json``.  Deliberately jax-free: it starts in well under a
+    second and can watch a wedged run without competing for the chip."""
+    import signal  # noqa: PLC0415
+
+    from distlr_tpu.obs import MetricsServer, write_metrics_snapshot  # noqa: PLC0415
+    from distlr_tpu.obs.federate import FleetScraper, write_endpoint  # noqa: PLC0415
+
+    cfg = _config_from_args(args)
+    if not cfg.obs_run_dir:
+        print("error: obs-agg needs --obs-run-dir (the rendezvous dir the "
+              "fleet's processes publish their endpoints into)",
+              file=sys.stderr)
+        return 2
+    scraper = FleetScraper(cfg.obs_run_dir, interval_s=args.interval,
+                           stale_after_s=args.stale_after)
+    if args.once:
+        # One-shot federation: merge whatever the run dir holds right
+        # now (live endpoints AND banked snapshots/ files) and emit it —
+        # how capture_all_tpu.sh banks a fleet snapshot without a daemon.
+        scraper.scrape_once()
+        fleet = scraper.fleet_json()
+        if args.snapshot_path:
+            write_metrics_snapshot(args.snapshot_path, scraper.merged)
+            log.info("fleet snapshot -> %s", args.snapshot_path)
+        else:
+            print(scraper.prometheus_text(), end="")
+        t = fleet["totals"]
+        print(f"FLEET ranks={t['ranks']} up={t['up']} stale={t['stale']} "
+              f"down={t['down']}", file=sys.stderr)
+        return 0
+
+    signal.signal(signal.SIGTERM, lambda *_: sys.exit(143))
+    port = cfg.obs_metrics_port if cfg.obs_metrics_port is not None else 0
+    server = MetricsServer(
+        registry=scraper, host=cfg.obs_metrics_host, port=port,
+        extra_json={"/fleet.json": scraper.fleet_json},
+    ).start()
+    print(f"METRICS {server.host}:{server.port}", flush=True)
+    # Published under its own role so `launch top --obs-run-dir` can find
+    # the aggregator; the scraper skips obs-agg endpoints when merging.
+    endpoint = write_endpoint(cfg.obs_run_dir, "obs-agg", 0,
+                              server.host, server.port)
+    try:
+        scraper.run_forever()
+    except KeyboardInterrupt:
+        return 130
+    finally:
+        scraper.stop()
+        server.stop()
+        import os  # noqa: PLC0415
+
+        with contextlib.suppress(OSError):
+            # leave cleanly so `launch top` gets the "start obs-agg
+            # first" error instead of polling a dead endpoint
+            os.unlink(endpoint)
+    return 0
+
+
+def cmd_top(args: argparse.Namespace) -> int:
+    """Live ANSI dashboard over the fleet scrape (`launch top`)."""
+    from distlr_tpu.obs.federate import discover_endpoints  # noqa: PLC0415
+    from distlr_tpu.obs.top import run_top  # noqa: PLC0415
+
+    url = args.fleet
+    if not url:
+        if not args.obs_run_dir:
+            print("error: top needs --fleet http://host:port or "
+                  "--obs-run-dir (to discover a running obs-agg)",
+                  file=sys.stderr)
+            return 2
+        aggs = [e for e in discover_endpoints(args.obs_run_dir)
+                if e["role"] == "obs-agg"]
+        if not aggs:
+            print(f"error: no obs-agg endpoint under {args.obs_run_dir} — "
+                  "start `python -m distlr_tpu.launch obs-agg --obs-run-dir "
+                  f"{args.obs_run_dir}` first", file=sys.stderr)
+            return 2
+        url = f"http://{aggs[-1]['host']}:{aggs[-1]['port']}"
+    color = False if args.no_color else None
+    return run_top(url, interval=args.interval, iterations=args.iterations,
+                   color=color)
 
 
 def main(argv=None) -> int:
@@ -591,6 +721,45 @@ def main(argv=None) -> int:
     v.add_argument("--async", dest="asynchronous", action="store_true")
     v.add_argument("--ports", help="fixed ports, comma-separated (default: ephemeral)")
     v.set_defaults(fn=cmd_ps_server)
+
+    a = sub.add_parser(
+        "obs-agg",
+        help="fleet metrics aggregator: merge every rank's /metrics into "
+             "one scrape + /fleet.json (+ derived distlr_alert_* gauges)",
+    )
+    _add_config_flags(a)
+    a.add_argument("--interval", type=float, default=2.0,
+                   help="scrape period, seconds (default 2)")
+    a.add_argument("--stale-after", dest="stale_after", type=float,
+                   default=10.0,
+                   help="seconds without a successful scrape before a rank "
+                   "counts stale->down and distlr_alert_scrape_stale fires")
+    a.add_argument("--once", action="store_true",
+                   help="scrape+merge once and exit: print the fleet "
+                   "Prometheus text (or write --snapshot-path) instead of "
+                   "serving — how capture scripts bank a fleet snapshot")
+    a.add_argument("--snapshot-path", dest="snapshot_path",
+                   help="with --once: write the merged fleet registry here "
+                   "(.json = JSON snapshot, else Prometheus text)")
+    a.set_defaults(fn=cmd_obs_agg)
+
+    t = sub.add_parser(
+        "top",
+        help="live terminal dashboard over a fleet scrape (per-rank step "
+             "rate, op latencies, staleness, firing alerts)",
+    )
+    t.add_argument("--obs-run-dir", dest="obs_run_dir",
+                   help="fleet run dir: discovers the running obs-agg's "
+                   "endpoint file")
+    t.add_argument("--fleet", help="aggregator URL (http://host:port) — "
+                   "overrides --obs-run-dir discovery")
+    t.add_argument("--interval", type=float, default=1.0,
+                   help="refresh period, seconds (default 1)")
+    t.add_argument("--iterations", type=int,
+                   help="render N frames then exit (default: until Ctrl-C)")
+    t.add_argument("--no-color", dest="no_color", action="store_true",
+                   help="plain text frames (no ANSI colors/clears)")
+    t.set_defaults(fn=cmd_top)
 
     args = parser.parse_args(argv)
     return args.fn(args)

@@ -14,15 +14,29 @@ Metric namespace (see README "Observability" for the full table):
 * ``distlr_ps_server_*``  — ServerGroup/ServerSupervisor lifecycle
 * ``distlr_ps_client_*``  — native KV client ops, latency, bytes
 * ``distlr_train_*``      — step/sample counters, rates, staleness
+  (seconds gauge AND the ``_staleness_pushes`` Hogwild histogram)
 * ``distlr_serve_*``      — request/engine/batcher series
 * ``distlr_phase_seconds``— per-phase histogram behind the tracer
+* ``distlr_fleet_*`` / ``distlr_alert_*`` — fleet-scrape meta-series
+  and derived alert gauges (:mod:`distlr_tpu.obs.federate`, served by
+  ``launch obs-agg`` and rendered live by ``launch top``)
 """
 
 from distlr_tpu.obs.exporters import (  # noqa: F401
     MetricsServer,
     install_snapshot_atexit,
+    snapshot_env_paths,
     start_metrics_server,
     write_metrics_snapshot,
+)
+from distlr_tpu.obs.federate import (  # noqa: F401
+    AlertThresholds,
+    FleetMergeError,
+    FleetScraper,
+    discover_endpoints,
+    evaluate_alerts,
+    merge_snapshots,
+    write_endpoint,
 )
 from distlr_tpu.obs.registry import (  # noqa: F401
     DEFAULT_BUCKETS,

@@ -41,6 +41,43 @@ DEFAULT_BUCKETS = (
     0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
 )
 
+#: Ladder for count-valued histograms (e.g. pushes-behind staleness):
+#: 0 = perfectly fresh, then doublings to deeply stale, with one wide
+#: 4096 top bucket.  Shared as a constant because the fleet merge
+#: rejects mismatched boundary ladders — two call sites retuning the
+#: "same" metric independently would drop it from every federated view.
+COUNT_BUCKETS = (
+    0.0, 1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0, 256.0, 512.0,
+    1024.0, 4096.0,
+)
+
+
+def percentile_from_counts(bounds: tuple[float, ...], counts,
+                           q: float) -> float:
+    """q-quantile (q in [0, 1]) by linear interpolation inside the
+    owning bucket, over decomposed per-bucket counts (last slot =
+    +Inf).  Observations past the top bucket clamp to the largest
+    finite boundary — fixed buckets trade tail resolution for O(1)
+    memory; widen the ladder if the tail matters.  ONE implementation,
+    shared by live histogram children and the fleet aggregator's
+    snapshot math, so /metrics and /fleet.json can never disagree on
+    the same data."""
+    if not 0.0 <= q <= 1.0:
+        raise ValueError(f"quantile must be in [0, 1], got {q}")
+    total = sum(counts)
+    if total == 0:
+        return 0.0
+    rank, cum = q * total, 0.0
+    for i, c in enumerate(counts[:-1]):
+        prev_cum = cum
+        cum += c
+        if cum >= rank:
+            lo = bounds[i - 1] if i > 0 else 0.0
+            hi = bounds[i]
+            frac = (rank - prev_cum) / c if c else 0.0
+            return lo + (hi - lo) * frac
+    return bounds[-1]
+
 
 def _format_value(v: float) -> str:
     """Prometheus sample value: integral floats print as integers."""
@@ -145,28 +182,11 @@ class _HistogramChild:
         return {"buckets": out, "inf": total, "sum": s, "count": total}
 
     def percentile(self, q: float) -> float:
-        """Estimate the q-quantile (q in [0, 1]) by linear interpolation
-        inside the owning bucket.  Observations past the top bucket clamp
-        to the largest finite boundary — fixed buckets trade tail
-        resolution for O(1) memory; widen the ladder if the tail matters."""
-        if not 0.0 <= q <= 1.0:
-            raise ValueError(f"quantile must be in [0, 1], got {q}")
+        """Estimate the q-quantile via :func:`percentile_from_counts`
+        over this child's live bucket counts."""
         with self._lock:
             counts = list(self._counts)
-            total = self._count
-        if total == 0:
-            return 0.0
-        rank = q * total
-        cum = 0.0
-        for i, c in enumerate(counts[:-1]):
-            prev_cum = cum
-            cum += c
-            if cum >= rank:
-                lo = self._buckets[i - 1] if i > 0 else 0.0
-                hi = self._buckets[i]
-                frac = (rank - prev_cum) / c if c else 0.0
-                return lo + (hi - lo) * frac
-        return self._buckets[-1]
+        return percentile_from_counts(self._buckets, counts, q)
 
 
 class _Family:

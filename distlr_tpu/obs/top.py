@@ -1,0 +1,136 @@
+"""``launch top`` — a live ANSI terminal dashboard over the fleet scrape.
+
+Renders the aggregator's ``/fleet.json`` (per-rank step rate, pull/push
+p50/p99, staleness in seconds AND pushes-behind, firing alerts) the way
+``top`` renders processes: one frame per poll, in-place.  Pure text in,
+pure text out — :func:`render_fleet` takes the parsed summary and
+returns the frame, so tests assert on content without a terminal.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+import urllib.request
+
+_RESET = "\x1b[0m"
+_BOLD = "\x1b[1m"
+_DIM = "\x1b[2m"
+_RED = "\x1b[31m"
+_GREEN = "\x1b[32m"
+_YELLOW = "\x1b[33m"
+#: Home + clear-to-end: repaint without the flicker of a full 2J clear.
+CLEAR = "\x1b[H\x1b[J"
+
+_STATE_COLOR = {"up": _GREEN, "stale": _YELLOW, "down": _RED}
+
+_COLUMNS = (
+    ("role", 9), ("rank", 4), ("state", 6), ("steps", 8),
+    ("samples/s", 10), ("step p50", 9), ("pull p50/p99", 13),
+    ("push p50/p99", 13), ("stale s", 8), ("stale pushes", 13),
+)
+
+
+def _c(text: str, code: str, color: bool) -> str:
+    return f"{code}{text}{_RESET}" if color else text
+
+
+def _ms(v) -> str:
+    if v is None:
+        return "-"
+    return f"{v:.2f}" if v < 100 else f"{v:.0f}"
+
+
+def _pair(p50, p99) -> str:
+    if p50 is None and p99 is None:
+        return "-"
+    return f"{_ms(p50)}/{_ms(p99)}"
+
+
+def _num(v, fmt="{:.1f}") -> str:
+    return "-" if v is None else fmt.format(v)
+
+
+def _rank_cells(r: dict) -> list[str]:
+    return [
+        str(r.get("role", "?")), str(r.get("rank", "?")),
+        str(r.get("state", "?")),
+        _num(r.get("steps"), "{:d}"), _num(r.get("samples_per_s")),
+        _ms(r.get("step_p50_ms")),
+        _pair(r.get("pull_p50_ms"), r.get("pull_p99_ms")),
+        _pair(r.get("push_p50_ms"), r.get("push_p99_ms")),
+        _num(r.get("staleness_s"), "{:.3f}"),
+        _pair(r.get("staleness_pushes_p50"), r.get("staleness_pushes_p99")),
+    ]
+
+
+def render_fleet(fleet: dict, *, color: bool = True,
+                 clear: bool = False) -> str:
+    """One dashboard frame from a parsed ``/fleet.json`` document."""
+    lines: list[str] = []
+    tot = fleet.get("totals", {})
+    updated = fleet.get("updated")
+    age = f"{max(0.0, time.time() - updated):.1f}s ago" if updated else "never"
+    head = (f"distlr fleet top — {fleet.get('run_dir', '?')} — "
+            f"{tot.get('up', 0)}/{tot.get('ranks', 0)} up — "
+            f"{tot.get('samples_per_s', 0):,.0f} samples/s — updated {age}")
+    lines.append(_c(head, _BOLD, color))
+
+    firing = [a for a in fleet.get("alerts", []) if a.get("firing")]
+    if firing:
+        for a in firing:
+            labels = ",".join(f"{k}={v}" for k, v in a.get("labels", {}).items())
+            val = a.get("value")
+            lines.append(_c(
+                f"ALERT {a['name']}{{{labels}}}"
+                + (f" value={val}" if val is not None else ""),
+                _RED + _BOLD, color))
+    else:
+        lines.append(_c("alerts: none firing", _DIM, color))
+    lines.append("")
+
+    header = "  ".join(name.ljust(w) for name, w in _COLUMNS)
+    lines.append(_c(header, _BOLD, color))
+    for r in fleet.get("ranks", []):
+        cells = _rank_cells(r)
+        row = "  ".join(c.ljust(w) for c, (_, w) in zip(cells, _COLUMNS))
+        state_color = _STATE_COLOR.get(r.get("state"), "")
+        lines.append(_c(row, state_color, color) if state_color else row)
+    if not fleet.get("ranks"):
+        lines.append(_c("  (no ranks discovered yet — are processes "
+                        "running with --obs-run-dir?)", _DIM, color))
+    body = "\n".join(lines) + "\n"
+    return (CLEAR + body) if clear else body
+
+
+def run_top(url: str, *, interval: float = 1.0,
+            iterations: int | None = None, color: bool | None = None,
+            timeout_s: float = 2.0, out=None) -> int:
+    """Poll ``<url>/fleet.json`` and repaint until interrupted (or for
+    ``iterations`` frames — what scripts and tests use).  Returns a
+    shell-style exit code."""
+    out = out or sys.stdout
+    if color is None:
+        color = bool(getattr(out, "isatty", lambda: False)())
+    n = 0
+    try:
+        while iterations is None or n < iterations:
+            if n:
+                time.sleep(interval)
+            try:
+                with urllib.request.urlopen(url + "/fleet.json",
+                                            timeout=timeout_s) as r:
+                    fleet = json.load(r)
+                frame = render_fleet(fleet, color=color, clear=color)
+            except Exception as e:  # noqa: BLE001 — show, keep polling
+                frame = (CLEAR if color else "") + \
+                    f"fleet aggregator unreachable at {url}: {e}\n"
+            out.write(frame)
+            out.flush()
+            n += 1
+    except KeyboardInterrupt:
+        if color:
+            out.write(_RESET + "\n")
+        return 130
+    return 0

@@ -385,6 +385,25 @@ class KVWorker:
         self._check(n, "stats")
         return dict(zip(STATS_FIELDS, (int(v) for v in out[:n])))
 
+    def global_pushes(self, *, per_worker_scale: bool = True) -> float:
+        """The group's monotonic global push clock: the sum of every
+        server rank's ``total_pushes`` kStats counter, divided by the
+        server count (``per_worker_scale``) so one dense worker batch —
+        which lands on ALL ranges — ticks the clock by exactly 1.
+
+        This is the unit Hogwild staleness bounds are stated in
+        (pushes-behind, arXiv:1508.05711): sampling the clock at pull
+        time and again at push time measures how many peer updates the
+        in-flight gradient is stale against.  Keyed pushes may skip
+        ranges they don't touch, so for sparse traffic the clock ticks
+        by the touched fraction — the per-key-range average, which is
+        the quantity the per-range convergence bound actually sees.
+        Stats replies are never deferred, so the clock works mid-barrier.
+        """
+        total = sum(self.stats(r)["total_pushes"]
+                    for r in range(self.num_servers))
+        return total / self.num_servers if per_worker_scale else float(total)
+
     def shutdown_servers(self) -> None:
         self._lib.kv_shutdown_servers(self._h)
 

@@ -204,6 +204,15 @@ class ServerGroup:
                 _SERVER_STAT.labels(rank=rank, stat=name).set(val)
         return stats
 
+    def global_pushes(self, *, timeout_ms: int = 2000) -> float:
+        """Server-side view of the group's monotonic push clock (see
+        :meth:`distlr_tpu.ps.client.KVWorker.global_pushes`): mean
+        ``total_pushes`` across ranks, probed over a dedicated
+        connection.  The probe doubles as a ``health()`` cycle, so the
+        ``distlr_ps_server_stat`` gauges refresh too."""
+        stats = self.health(timeout_ms=timeout_ms)
+        return sum(s["total_pushes"] for s in stats) / max(len(stats), 1)
+
     def wait(self) -> None:
         """Block until every server process exits — they do after a
         client's ``shutdown_servers()``.  This is the foreground mode

@@ -40,7 +40,7 @@ from distlr_tpu.data import DataIter
 from distlr_tpu.data.iterator import SparseDataIter
 from distlr_tpu.data.sharding import part_name
 from distlr_tpu.models import get_model
-from distlr_tpu.obs.registry import get_registry
+from distlr_tpu.obs.registry import COUNT_BUCKETS, get_registry
 from distlr_tpu.obs.tracing import trace_phase
 from distlr_tpu.ps import KVWorker, ServerGroup
 from distlr_tpu.train.export import save_model_text
@@ -60,6 +60,26 @@ _STALENESS = get_registry().gauge(
     "age of the weights behind the most recent gradient push",
     labelnames=("rank",),
 )
+#: The SAME staleness, but in the unit the Hogwild convergence analyses
+#: actually bound (arXiv:1508.05711 states tau in *updates*, not
+#: seconds): the server group's global push clock
+#: (:meth:`KVWorker.global_pushes`) sampled after the pull and again
+#: just before the push — the delta is how many peer updates landed on
+#: the weights this gradient was computed from.  Sampling is throttled
+#: (one probed pair per _PUSHES_SAMPLE_INTERVAL_S per worker) so the
+#: extra stats round trips never show up in the step rate.
+_STALENESS_PUSHES = get_registry().histogram(
+    "distlr_train_staleness_pushes",
+    "Hogwild gradient staleness in pushes-behind: peer updates applied "
+    "between this worker's pull and its push",
+    labelnames=("rank",),
+    buckets=COUNT_BUCKETS,
+)
+#: Min seconds between probed pull/push clock pairs per worker.  A stats
+#: probe costs one round trip per server rank; at 20 samples/s the
+#: overhead is noise even for the ~1 ms localhost dense steps, while a
+#: multi-epoch run still banks thousands of histogram observations.
+_PUSHES_SAMPLE_INTERVAL_S = 0.05
 _RESTARTS = get_registry().counter(
     "distlr_ps_worker_restarts_total",
     "PS workers rebuilt in place after a failure (max_restarts path)",
@@ -416,6 +436,15 @@ class PSWorker:
             hosts, self._param_dim(), client_id=rank,
             timeout_ms=cfg.ps_timeout_ms, sync_group=cfg.sync_mode,
         )
+        self._hosts = hosts
+        # Push-clock probe for the pushes-behind staleness histogram
+        # (async only): a DEDICATED connection, because the main one may
+        # have a fused op in flight on the comm thread, and KV ops must
+        # never overlap on one stream.  Lazy: first sample connects.
+        self._push_probe: KVWorker | None = None
+        self._push_probe_dead = cfg.sync_mode  # sync BSP: staleness is 0
+        self._last_pushes_sample = float("-inf")
+        self._staleness_pushes = _STALENESS_PUSHES.labels(rank=str(rank))
         self._train_iter = train_iter
         self._test_iter = test_iter
         # Keyed models never use the jitted dense-batch fns (their
@@ -440,6 +469,7 @@ class PSWorker:
         # single comm thread (KV ops must never overlap on one connection)
         self._w_cache: np.ndarray | None = None
         self._w_time = 0.0  # when _w_cache was pulled (staleness gauge)
+        self._w_pushes: float | None = None  # push clock at _w_cache arrival
         self._comm = None
         if cfg.model in ("sparse_lr", "blocked_lr") and cfg.l2_c > 0:
             # Keyed PS applies L2 lazily (only a batch's touched keys/rows
@@ -454,6 +484,61 @@ class PSWorker:
 
     def _param_dim(self) -> int:
         return ps_param_dim(self.cfg)
+
+    # -- pushes-behind staleness probing (async/Hogwild only) -------------
+    def _sample_push_clock(self) -> float | None:
+        """The group's global push clock now, or None when throttled or
+        the probe is unavailable.  A non-None return arms one
+        :meth:`_record_pushes_behind` call at push time."""
+        now = time.perf_counter()
+        if now - self._last_pushes_sample < _PUSHES_SAMPLE_INTERVAL_S:
+            return None
+        if self._push_probe is None:
+            if self._push_probe_dead:
+                return None
+            try:
+                self._push_probe = KVWorker(
+                    self._hosts, self._param_dim(),
+                    client_id=0xFD00 + self.rank, timeout_ms=2000,
+                    sync_group=False,
+                )
+            except Exception:
+                # No probe, no histogram — observability must never take
+                # the training loop down (or spin on reconnects).
+                self._push_probe_dead = True
+                return None
+        try:
+            clock = self._push_probe.global_pushes()
+        except Exception:
+            self._drop_push_probe()
+            return None
+        self._last_pushes_sample = now
+        return clock
+
+    def _record_pushes_behind(self, pulled_clock: float | None) -> None:
+        """Observe the staleness of the gradient about to be pushed:
+        push-time clock minus ``pulled_clock`` (the pull-time sample) =
+        peer updates the weights aged by while this worker computed."""
+        if pulled_clock is None or self._push_probe is None:
+            return
+        try:
+            clock = self._push_probe.global_pushes()
+        except Exception:
+            self._drop_push_probe()
+            return
+        self._staleness_pushes.observe(max(0.0, clock - pulled_clock))
+
+    def _drop_push_probe(self) -> None:
+        # A failed probe usually means the server group is dying or
+        # gone; the worker's own ops will surface that.  Don't re-probe
+        # every batch — a restarted worker builds a fresh PSWorker.
+        probe, self._push_probe = self._push_probe, None
+        self._push_probe_dead = True
+        if probe is not None:
+            try:
+                probe.close()
+            except Exception:
+                pass
 
     def _blocked_iter(self, path: str, batch_size: int, *, wrap=False):
         from distlr_tpu.data.hashing import resolve_ctr_fields  # noqa: PLC0415
@@ -677,11 +762,13 @@ class PSWorker:
                     t_pull = time.perf_counter()
                     with trace_phase("pull"):
                         w_u = self.kv.pull(keys=keys, vals_per_key=vpk)
+                    p0 = None if cfg.sync_mode else self._sample_push_clock()
                     with trace_phase("compute"):
                         g = kgrad(w_u, rest)
                     if not cfg.sync_mode:
                         _STALENESS.labels(rank=self.rank).set(
                             time.perf_counter() - t_pull)
+                        self._record_pushes_behind(p0)
                     with trace_phase("push"):
                         self.kv.wait(self.kv.push(g, keys=keys,
                                                   vals_per_key=vpk))
@@ -694,11 +781,13 @@ class PSWorker:
                     t_pull = time.perf_counter()
                     with trace_phase("pull"):
                         w = self.kv.pull()
+                    p0 = None if cfg.sync_mode else self._sample_push_clock()
                     with trace_phase("compute"):
                         g = compute_g(w, X, y, mask)
                     if not cfg.sync_mode:
                         _STALENESS.labels(rank=self.rank).set(
                             time.perf_counter() - t_pull)
+                        self._record_pushes_behind(p0)
                     with trace_phase("push"):
                         self.kv.wait(self.kv.push(g))
                     self.timer.stop(int(mask.sum()))
@@ -728,6 +817,7 @@ class PSWorker:
                     with trace_phase("pull"):
                         self._w_cache = self.kv.pull()
                     self._w_time = time.perf_counter()
+                    self._w_pushes = self._sample_push_clock()
                 fut = None
                 for X, y, mask in train:
                     self.timer.start()
@@ -738,16 +828,23 @@ class PSWorker:
                     # in-flight RTT, bounded by the next result() wait)
                     _STALENESS.labels(rank=self.rank).set(
                         time.perf_counter() - self._w_time)
+                    # pushes-behind twin: clock now minus the clock when
+                    # _w_cache arrived — peer updates plus our own (<=1)
+                    # in-flight fused push, i.e. exactly how many updates
+                    # behind the weights under this gradient are
+                    self._record_pushes_behind(self._w_pushes)
                     if fut is not None:
                         with trace_phase("push"):
                             self._w_cache = fut.result()
                         self._w_time = time.perf_counter()
+                        self._w_pushes = self._sample_push_clock()
                     fut = self._comm_pool().submit(self.kv.push_pull, g)
                     self.timer.stop(int(mask.sum()))
                 if fut is not None:
                     with trace_phase("push"):
                         self._w_cache = fut.result()
                     self._w_time = time.perf_counter()
+                    self._w_pushes = self._sample_push_clock()
             if (
                 self.rank == 0
                 and test is not None
@@ -892,6 +989,7 @@ class PSWorker:
         return self._comm
 
     def close(self, *, wait: bool = True):
+        self._drop_push_probe()
         comm, self._comm = self._comm, None
         if comm is None:
             self.kv.close()

@@ -19,6 +19,7 @@ from distlr_tpu.config import Config
 from distlr_tpu.data.synthetic import write_synthetic_shards
 from distlr_tpu.obs import (
     MetricsRegistry,
+    MetricsServer,
     PhaseTracer,
     get_registry,
     get_tracer,
@@ -253,6 +254,45 @@ class TestExporters:
         path = str(tmp_path / "metrics.prom")
         write_metrics_snapshot(path, reg)
         assert "g 2" in open(path).read()
+
+    def test_write_snapshot_json_twin(self, tmp_path):
+        """A .json path banks the machine-readable registry snapshot —
+        what the fleet aggregator and capture_all_tpu.sh consume."""
+        reg = MetricsRegistry()
+        reg.counter("c_total").inc(3)
+        path = str(tmp_path / "metrics.json")
+        write_metrics_snapshot(path, reg)
+        doc = json.load(open(path))
+        assert doc["c_total"]["series"][0]["value"] == 3
+        assert doc["c_total"]["type"] == "counter"
+
+    def test_snapshot_env_multiple_paths(self):
+        """DISTLR_METRICS_SNAPSHOT may name several os.pathsep-separated
+        targets (text + JSON twins banked from one process)."""
+        from distlr_tpu.obs import snapshot_env_paths
+
+        val = os.pathsep.join(["a.prom", "b.json"])
+        assert snapshot_env_paths(val) == ["a.prom", "b.json"]
+        assert snapshot_env_paths("") == []
+
+    def test_stop_without_start_does_not_deadlock(self):
+        """Regression: stop() before/without start() used to block
+        forever inside HTTPServer.shutdown() (waiting on an event only
+        serve_forever sets); it must return immediately and release the
+        port, and stay idempotent."""
+        srv = MetricsServer(registry=MetricsRegistry(), port=0)
+        t = threading.Thread(target=srv.stop, daemon=True)
+        t.start()
+        t.join(timeout=5)
+        assert not t.is_alive(), "stop() without start() deadlocked"
+        srv.stop()  # idempotent
+        with pytest.raises(RuntimeError, match="stopped"):
+            srv.start()  # a stopped server cannot come back
+
+    def test_stop_idempotent_after_start(self):
+        srv = MetricsServer(registry=MetricsRegistry(), port=0).start()
+        srv.stop()
+        srv.stop()
 
 
 class TestMetricsLoggerLifecycle:
