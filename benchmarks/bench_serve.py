@@ -1,11 +1,15 @@
 """Serving benchmark: scoring QPS vs batch-bucket config.
 
-Measures the online serving subsystem (``distlr_tpu/serve``) two ways:
+Measures the online serving subsystem (``distlr_tpu/serve``) three ways:
 
 * **engine rows/s** — the jitted bucketed scoring path fed directly, per
   bucket ladder config (the ceiling the front-end can approach);
 * **end-to-end QPS** — concurrent TCP clients through the microbatcher,
-  per (max_batch, max_wait) config, with the measured batch occupancy.
+  per (max_batch, max_wait) config, with the measured batch occupancy;
+* **multi-engine QPS** — concurrent TCP clients through the
+  :class:`~distlr_tpu.serve.router.ScoringRouter` front-end over N real
+  engine replicas (the ISSUE-4 serving tier), with the router's shed /
+  retry accounting in the row.
 
 Prints ONE JSON line in ``bench.py``'s format (``metric`` / ``value`` /
 ``unit`` / per-config sub rows) so serving throughput joins the bench
@@ -14,7 +18,7 @@ probe-in-subprocess discipline: a wedged TPU tunnel must cost the row its
 scale, never hang it (shapes are recorded so a CPU-fallback number can
 never be mistaken for an on-chip one).
 
-Run: ``python benchmarks/bench_serve.py [--quick]``
+Run: ``python benchmarks/bench_serve.py [--quick|--smoke]``
 """
 
 from __future__ import annotations
@@ -129,11 +133,92 @@ def bench_e2e_qps(d: int, max_batch: int, max_wait_ms: float, *,
     }
 
 
+def bench_router_qps(d: int, n_replicas: int, max_batch: int,
+                     max_wait_ms: float, *, clients: int,
+                     rows_per_request: int, duration_s: float) -> dict:
+    """Multi-engine end-to-end QPS: concurrent TCP clients through the
+    routing front-end over ``n_replicas`` real engine replicas."""
+    import numpy as np
+
+    from distlr_tpu.config import Config
+    from distlr_tpu.serve import ScoringEngine, ScoringRouter, ScoringServer
+    from distlr_tpu.serve.server import score_lines_over_tcp
+
+    cfg = Config(num_feature_dim=d, model="sparse_lr", l2_c=0.0)
+    w = np.random.default_rng(2).standard_normal(d).astype(np.float32)
+    servers = []
+    for _ in range(n_replicas):
+        eng = ScoringEngine(cfg, max_batch_size=max_batch)
+        eng.set_weights(w)
+        servers.append(ScoringServer(eng, max_wait_ms=max_wait_ms).start())
+    lines = _make_lines(rows_per_request, d, 16, seed=3)
+    payload = json.dumps({"rows": lines})
+    counts = [0] * clients
+    router = ScoringRouter([f"{s.host}:{s.port}" for s in servers],
+                           max_inflight=max(2 * clients, 4)).start()
+    try:
+        with trace_phase("warmup_compile"):
+            # warm EVERY replica directly — one request through the
+            # router reaches a single engine, and the others' first-use
+            # jit compile would land inside the timed window
+            for s in servers:
+                score_lines_over_tcp(s.host, s.port, [payload])
+            score_lines_over_tcp(router.host, router.port, [payload])
+        stop = time.monotonic() + duration_s
+
+        def client(i):
+            import socket
+
+            with socket.create_connection((router.host, router.port),
+                                          timeout=30) as s:
+                f = s.makefile("rwb")
+                while time.monotonic() < stop:
+                    f.write((payload + "\n").encode())
+                    f.flush()
+                    reply = f.readline()
+                    if not reply:
+                        return
+                    if not reply.startswith(b"ERR"):
+                        # shed/route errors are answered lines but not
+                        # scored work — counting them would inflate qps
+                        counts[i] += 1
+
+        t0 = time.monotonic()
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(clients)]
+        with trace_phase("route_clients"):
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+        elapsed = time.monotonic() - t0
+        stats = router.stats()
+    finally:
+        router.stop()
+        for s in servers:
+            s.stop()
+    reqs = sum(counts)
+    return {
+        "qps": round(reqs / elapsed, 1),
+        "rows_per_sec": round(reqs * rows_per_request / elapsed, 1),
+        "replicas": n_replicas,
+        "shed": stats["shed"],
+        "retries": stats["retries"],
+        "clients": clients,
+        "rows_per_request": rows_per_request,
+    }
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true",
                     help="tiny shapes (smoke/test mode)")
+    ap.add_argument("--smoke", action="store_true",
+                    help="alias of --quick (the `make -C benchmarks "
+                    "serve-smoke` entry point)")
     args = ap.parse_args()
+    if args.smoke:
+        args.quick = True
 
     status, probed = probe_default_backend_ex(
         float(os.environ.get("DISTLR_PROBE_TIMEOUT_S", "60")))
@@ -148,15 +233,18 @@ def main() -> int:
         d, batches, duration = 4096, 3, 0.5
         buckets = (64, 256)
         e2e_cfgs = [(256, 1.0, 4, 32)]
+        route_cfgs = [(2, 256, 1.0, 4, 32)]
     elif on_cpu:
         d, batches, duration = 65536, 10, 2.0
         buckets = (64, 256, 1024)
         e2e_cfgs = [(256, 1.0, 8, 64), (1024, 2.0, 8, 64), (1024, 0.0, 1, 1)]
+        route_cfgs = [(2, 1024, 2.0, 8, 64)]
     else:
         d, batches, duration = 1_000_000, 30, 3.0
         buckets = (64, 256, 1024, 4096)
         e2e_cfgs = [(256, 1.0, 8, 64), (1024, 2.0, 8, 64),
                     (4096, 2.0, 16, 256), (1024, 0.0, 1, 1)]
+        route_cfgs = [(2, 4096, 2.0, 16, 256), (4, 4096, 2.0, 16, 256)]
 
     subs: dict[str, object] = {}
     for bucket in buckets:
@@ -184,6 +272,19 @@ def main() -> int:
             print(f"[bench_serve] {key} failed: {e!r}", file=sys.stderr)
             subs[key] = None
 
+    best_route = None
+    for n, max_batch, wait_ms, clients, rpr in route_cfgs:
+        key = f"route_e2e_r{n}_mb{max_batch}_c{clients}"
+        try:
+            r = bench_router_qps(d, n, max_batch, wait_ms, clients=clients,
+                                 rows_per_request=rpr, duration_s=duration)
+            subs[key] = r
+            if best_route is None or r["rows_per_sec"] > best_route["rows_per_sec"]:
+                best_route = r
+        except Exception as e:
+            print(f"[bench_serve] {key} failed: {e!r}", file=sys.stderr)
+            subs[key] = None
+
     engine_rates = [v for k, v in subs.items()
                     if k.startswith("engine_") and isinstance(v, float)]
     phases = get_tracer().breakdown()
@@ -195,6 +296,7 @@ def main() -> int:
         "D": d,
         "probe_status": status,
         "best_e2e": best_e2e,
+        "best_route": best_route,
         # per-phase wall sums across the whole run (obs tracer).  Unlike
         # bench.py's headline breakdown, phases here OVERLAP across
         # threads (serve_score runs on the flush thread inside the

@@ -210,6 +210,39 @@ class Config:
     # Weight-source poll cadence for hot reload (checkpoint watch or
     # live-PS pull) — the serving staleness bound.
     serve_reload_interval_s: float = 1.0
+    # Hot-row keyed reload (live-PS serving only): capacity of the
+    # request-fed HotSetTracker.  0 = off (every reload pulls the full
+    # D-dim table); N > 0 = reload only the ~N-row working set through
+    # keyed pulls, with full-refresh fallback below.
+    serve_hot_rows: int = 0
+    # Fall back to a full-table refresh when the published hot set
+    # covers less than this fraction of recently requested keys (the
+    # shifting-distribution guard).
+    serve_hot_min_coverage: float = 0.95
+    # Also force a full refresh every N polls (bounds cold-row staleness
+    # to N poll intervals); 0 = only coverage-driven refreshes.
+    serve_hot_full_every: int = 10
+
+    # ---- serving router (launch route / distlr_tpu.serve.router) ----
+    # Port 0 = OS-assigned ephemeral (announced as "ROUTING host:port").
+    route_port: int = 0
+    route_host: str = "127.0.0.1"
+    # Admission control: per-replica in-flight request budget; a request
+    # finding no replica with a free slot is shed with an explicit
+    # "ERR SHED" reply (never a silent hang).
+    route_max_inflight: int = 64
+    # Passive failure detection: consecutive transport failures before a
+    # replica is ejected from rotation.
+    route_eject_after: int = 3
+    # Active health probe cadence for in-rotation replicas that carried
+    # no recent traffic.
+    route_health_interval_s: float = 1.0
+    # Reinstatement probes for ejected replicas: exponential backoff
+    # from base to max.
+    route_probe_backoff_s: float = 0.5
+    route_probe_backoff_max_s: float = 30.0
+    # Per-exchange socket timeout toward replicas (connect + reply read).
+    route_backend_timeout_s: float = 30.0
 
     def __post_init__(self):
         ref = self.compat_mode == "reference"
@@ -306,6 +339,48 @@ class Config:
             raise ValueError(
                 "serve_reload_interval_s must be positive, "
                 f"got {self.serve_reload_interval_s}"
+            )
+        if self.serve_hot_rows < 0:
+            raise ValueError(
+                f"serve_hot_rows must be >= 0 (0 = off), got {self.serve_hot_rows}"
+            )
+        if not 0.0 < self.serve_hot_min_coverage <= 1.0:
+            raise ValueError(
+                "serve_hot_min_coverage must be in (0, 1], "
+                f"got {self.serve_hot_min_coverage}"
+            )
+        if self.serve_hot_full_every < 0:
+            raise ValueError(
+                "serve_hot_full_every must be >= 0 (0 = coverage-driven "
+                f"only), got {self.serve_hot_full_every}"
+            )
+        if not 0 <= self.route_port < 1 << 16:
+            raise ValueError(
+                f"route_port must be in [0, 65536), got {self.route_port}")
+        if self.route_max_inflight <= 0:
+            raise ValueError(
+                f"route_max_inflight must be positive, got {self.route_max_inflight}"
+            )
+        if self.route_eject_after < 1:
+            raise ValueError(
+                f"route_eject_after must be >= 1, got {self.route_eject_after}"
+            )
+        if self.route_health_interval_s <= 0:
+            raise ValueError(
+                "route_health_interval_s must be positive, "
+                f"got {self.route_health_interval_s}"
+            )
+        if (self.route_probe_backoff_s <= 0
+                or self.route_probe_backoff_max_s < self.route_probe_backoff_s):
+            raise ValueError(
+                "need 0 < route_probe_backoff_s <= route_probe_backoff_max_s, "
+                f"got {self.route_probe_backoff_s}/"
+                f"{self.route_probe_backoff_max_s}"
+            )
+        if self.route_backend_timeout_s <= 0:
+            raise ValueError(
+                "route_backend_timeout_s must be positive, "
+                f"got {self.route_backend_timeout_s}"
             )
 
     # -- reference env-var shim ------------------------------------------------

@@ -11,6 +11,7 @@ SPMD program over the mesh), and the PS path needs server processes that
     python -m distlr_tpu.launch sync     [--data-dir D ...]
     python -m distlr_tpu.launch ps       [--async] [--num-workers W ...]
     python -m distlr_tpu.launch serve    [--model-file M | --ps-hosts H ...]
+    python -m distlr_tpu.launch route    --replicas host:p1,host:p2 ...
 
 Every algorithm knob also honors the reference's env-var contract
 (``SYNC_MODE``, ``LEARNING_RATE``, ``NUM_FEATURE_DIM``, ... — see
@@ -418,6 +419,19 @@ def cmd_ps(args: argparse.Namespace) -> int:
     return 0
 
 
+def _serve_row_width(cfg: Config) -> int:
+    """PS row width for serving pulls: how many flat KV slots one engine
+    row key owns.  MUST match the key space ``ScoringEngine.row_keys``
+    feeds the hot tracker — blocked rows own ``block_size`` lanes, and
+    BOTH softmax families (``ps_param_dim`` flattens the (D, K) matrix
+    row-major) own ``num_classes`` slots per feature key."""
+    if cfg.model == "blocked_lr":
+        return cfg.block_size
+    if cfg.model in ("softmax", "sparse_softmax"):
+        return cfg.num_classes
+    return 1
+
+
 def cmd_serve(args: argparse.Namespace) -> int:
     """Online scoring front-end over a trained model (see
     :mod:`distlr_tpu.serve`): batched jitted scoring behind a TCP line
@@ -445,12 +459,20 @@ def cmd_serve(args: argparse.Namespace) -> int:
         "serve_max_batch_size": args.serve_max_batch_size,
         "serve_max_wait_ms": args.max_wait_ms,
         "serve_reload_interval_s": args.reload_interval,
+        "serve_hot_rows": args.hot_rows,
+        "serve_hot_min_coverage": args.hot_min_coverage,
+        "serve_hot_full_every": args.hot_full_every,
     }
     cfg = cfg.replace(**{k: v for k, v in serve_over.items() if v is not None})
     if not (args.model_file or cfg.checkpoint_dir or args.ps_hosts):
         print("error: serve needs a weight source: --model-file and/or "
               "--checkpoint-dir (watched) or --ps-hosts (live pull)",
               file=sys.stderr)
+        return 2
+    if cfg.serve_hot_rows and not args.ps_hosts:
+        print("error: --hot-rows applies to live-PS reload only "
+              "(--ps-hosts); checkpoint/model-file sources always load "
+              "the full table", file=sys.stderr)
         return 2
     if cfg.model == "blocked_lr" and cfg.block_size == 0:
         if cfg.data_dir and os.path.isdir(cfg.data_dir):
@@ -466,13 +488,19 @@ def cmd_serve(args: argparse.Namespace) -> int:
         engine.set_weights(
             load_weights(args.model_file, shape=engine.model.param_shape))
     reloader = None
+    hot_tracker = None
     if args.ps_hosts:
-        row_width = (cfg.block_size if cfg.model == "blocked_lr"
-                     else cfg.num_classes if cfg.model == "sparse_softmax"
-                     else 1)
+        row_width = _serve_row_width(cfg)
+        if cfg.serve_hot_rows:
+            from distlr_tpu.serve import HotSetTracker  # noqa: PLC0415
+
+            hot_tracker = HotSetTracker(cfg.serve_hot_rows)
         source = LivePSWatcher(
             args.ps_hosts, ps_param_dim(cfg),
             vals_per_key=max(row_width, 1),
+            hot_tracker=hot_tracker,
+            min_coverage=cfg.serve_hot_min_coverage,
+            full_refresh_every=cfg.serve_hot_full_every,
         )
     elif cfg.checkpoint_dir:
         source = CheckpointWatcher(cfg.checkpoint_dir)
@@ -489,11 +517,59 @@ def cmd_serve(args: argparse.Namespace) -> int:
     server = ScoringServer(
         engine, host=cfg.serve_host, port=cfg.serve_port,
         max_wait_ms=cfg.serve_max_wait_ms, reloader=reloader,
+        hot_tracker=hot_tracker,
     )
     with _obs_scope(cfg, "serve", _obs_rank(args)):
         # Scriptable readiness line, like ps-server's "HOSTS ..." contract.
         print(f"SERVING {server.host}:{server.port}", flush=True)
         server.serve_forever()
+    return 0
+
+
+def cmd_route(args: argparse.Namespace) -> int:
+    """Serving-tier routing front-end (:mod:`distlr_tpu.serve.router`):
+    load-balance the serve line protocol across engine replicas with
+    health-check ejection/reinstatement, bounded per-replica in-flight
+    admission control (explicit ``ERR SHED``, never a silent hang), and
+    retry-once failover for the idempotent score requests.  Deliberately
+    jax-free — like ``obs-agg``, it starts in well under a second and
+    never competes with the replicas for a chip."""
+    import signal  # noqa: PLC0415
+
+    from distlr_tpu.serve.router import ScoringRouter  # noqa: PLC0415
+
+    cfg = _config_from_args(args)
+    route_over = {
+        "route_port": args.port, "route_host": args.bind,
+        "route_max_inflight": args.max_inflight,
+        "route_eject_after": args.eject_after,
+        "route_health_interval_s": args.health_interval,
+        "route_probe_backoff_s": args.probe_backoff,
+        "route_probe_backoff_max_s": args.probe_backoff_max,
+        "route_backend_timeout_s": args.backend_timeout,
+    }
+    signal.signal(signal.SIGTERM, lambda *_: sys.exit(143))
+    try:
+        cfg = cfg.replace(
+            **{k: v for k, v in route_over.items() if v is not None})
+        router = ScoringRouter(
+            args.replicas, host=cfg.route_host, port=cfg.route_port,
+            max_inflight=cfg.route_max_inflight,
+            eject_after=cfg.route_eject_after,
+            health_interval_s=cfg.route_health_interval_s,
+            probe_backoff_s=cfg.route_probe_backoff_s,
+            probe_backoff_max_s=cfg.route_probe_backoff_max_s,
+            backend_timeout_s=cfg.route_backend_timeout_s,
+        )
+    except ValueError as e:
+        # config and replica-list errors get the argparse-style contract
+        # (bad host:port, duplicates, out-of-range knobs), not a traceback
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    with _obs_scope(cfg, "route", _obs_rank(args)):
+        # Scriptable readiness line, like serve's "SERVING host:port".
+        print(f"ROUTING {router.host}:{router.port}", flush=True)
+        router.serve_forever()
     return 0
 
 
@@ -549,7 +625,11 @@ def cmd_obs_agg(args: argparse.Namespace) -> int:
     import signal  # noqa: PLC0415
 
     from distlr_tpu.obs import MetricsServer, write_metrics_snapshot  # noqa: PLC0415
-    from distlr_tpu.obs.federate import FleetScraper, write_endpoint  # noqa: PLC0415
+    from distlr_tpu.obs.federate import (  # noqa: PLC0415
+        AlertThresholds,
+        FleetScraper,
+        write_endpoint,
+    )
 
     cfg = _config_from_args(args)
     if not cfg.obs_run_dir:
@@ -557,8 +637,25 @@ def cmd_obs_agg(args: argparse.Namespace) -> int:
               "fleet's processes publish their endpoints into)",
               file=sys.stderr)
         return 2
+    # Effective alert thresholds: dataclass defaults < --thresholds-file
+    # JSON < explicit CLI flags.  The distlr_alert_* threshold labels are
+    # rendered from this instance, so a scrape always names the values
+    # that were actually in force.
+    try:
+        thresholds = AlertThresholds.resolve(
+            args.thresholds_file,
+            barrier_wait_ratio=args.alert_barrier_wait_ratio,
+            barrier_min_count=args.alert_barrier_min_count,
+            push_error_rate=args.alert_push_error_rate,
+            weight_age_ratio=args.alert_weight_age_ratio,
+            scrape_stale_s=args.stale_after,
+        )
+    except (OSError, ValueError) as e:
+        print(f"error: bad alert thresholds: {e}", file=sys.stderr)
+        return 2
     scraper = FleetScraper(cfg.obs_run_dir, interval_s=args.interval,
-                           stale_after_s=args.stale_after)
+                           stale_after_s=thresholds.scrape_stale_s,
+                           thresholds=thresholds)
     if args.once:
         # One-shot federation: merge whatever the run dir holds right
         # now (live endpoints AND banked snapshots/ files) and emit it —
@@ -713,8 +810,58 @@ def main(argv=None) -> int:
                    "co-batching company")
     r.add_argument("--reload-interval", dest="reload_interval", type=float,
                    help="weight-source poll period, seconds (the serving "
-                   "staleness bound)")
+                   "staleness bound; jittered ±20%% so replicas "
+                   "desynchronize)")
+    r.add_argument("--hot-rows", dest="hot_rows", type=int,
+                   help="with --ps-hosts: track the request traffic's hot "
+                   "working set (capacity N row keys) and reload only that "
+                   "slice via keyed pulls instead of the full D-dim table; "
+                   "falls back to a full refresh when coverage drops "
+                   "(default 0 = always full)")
+    r.add_argument("--hot-min-coverage", dest="hot_min_coverage", type=float,
+                   help="full-refresh fallback: minimum fraction of recent "
+                   "request keys the hot set must cover (default 0.95)")
+    r.add_argument("--hot-full-every", dest="hot_full_every", type=int,
+                   help="also force a full refresh every N polls, bounding "
+                   "cold-row staleness (default 10; 0 = coverage-driven "
+                   "only)")
     r.set_defaults(fn=cmd_serve)
+
+    rt = sub.add_parser(
+        "route",
+        help="serving-tier front-end: load-balance the serve protocol over "
+             "engine replicas with health checks, admission control "
+             "(explicit load shed), and retry-once failover",
+    )
+    _add_config_flags(rt)
+    rt.add_argument("--replicas", required=True,
+                    help="comma-separated host:port of running `launch "
+                    "serve` replicas (rank order); replicas may die, "
+                    "reload, and rejoin under live traffic")
+    rt.add_argument("--port", type=int, help="listen port (default: "
+                    "ephemeral, announced as 'ROUTING host:port')")
+    rt.add_argument("--bind", help="listen address (default 127.0.0.1)")
+    rt.add_argument("--max-inflight", dest="max_inflight", type=int,
+                    help="admission control: per-replica in-flight request "
+                    "budget; past it requests shed with an explicit "
+                    "'ERR SHED' reply (default 64)")
+    rt.add_argument("--eject-after", dest="eject_after", type=int,
+                    help="consecutive transport failures before a replica "
+                    "is ejected from rotation (default 3)")
+    rt.add_argument("--health-interval", dest="health_interval", type=float,
+                    help="active STATS probe period for idle in-rotation "
+                    "replicas, seconds (default 1)")
+    rt.add_argument("--probe-backoff", dest="probe_backoff", type=float,
+                    help="base of the exponential reinstatement-probe "
+                    "backoff for ejected replicas, seconds (default 0.5)")
+    rt.add_argument("--probe-backoff-max", dest="probe_backoff_max",
+                    type=float,
+                    help="cap of the reinstatement-probe backoff, seconds "
+                    "(default 30)")
+    rt.add_argument("--backend-timeout", dest="backend_timeout", type=float,
+                    help="per-exchange socket timeout toward replicas, "
+                    "seconds (default 30)")
+    rt.set_defaults(fn=cmd_route)
 
     v = sub.add_parser("ps-server", help="host a KV server group (multi-host PS)")
     _add_config_flags(v)
@@ -731,9 +878,32 @@ def main(argv=None) -> int:
     a.add_argument("--interval", type=float, default=2.0,
                    help="scrape period, seconds (default 2)")
     a.add_argument("--stale-after", dest="stale_after", type=float,
-                   default=10.0,
                    help="seconds without a successful scrape before a rank "
-                   "counts stale->down and distlr_alert_scrape_stale fires")
+                   "counts stale->down and distlr_alert_scrape_stale fires "
+                   "(default 10; overrides a thresholds-file value)")
+    a.add_argument("--thresholds-file", dest="thresholds_file",
+                   help="JSON object overriding AlertThresholds fields "
+                   "(barrier_wait_ratio, barrier_min_count, "
+                   "push_error_rate, scrape_stale_s, weight_age_ratio); "
+                   "explicit CLI flags win over the file, and the "
+                   "distlr_alert_* threshold labels reflect the effective "
+                   "values")
+    a.add_argument("--alert-barrier-wait-ratio",
+                   dest="alert_barrier_wait_ratio", type=float,
+                   help="barrier-wait p99 alert fires above this multiple "
+                   "of the median step time (default 2)")
+    a.add_argument("--alert-barrier-min-count",
+                   dest="alert_barrier_min_count", type=int,
+                   help="minimum barrier-wait observations before the "
+                   "stall alert may fire (default 8)")
+    a.add_argument("--alert-push-error-rate", dest="alert_push_error_rate",
+                   type=float,
+                   help="PS push error+timeout rate above which "
+                   "distlr_alert_ps_push_errors fires (default 0.01)")
+    a.add_argument("--alert-weight-age-ratio", dest="alert_weight_age_ratio",
+                   type=float,
+                   help="async weight age alert fires above this multiple "
+                   "of the median step time (default 10)")
     a.add_argument("--once", action="store_true",
                    help="scrape+merge once and exit: print the fleet "
                    "Prometheus text (or write --snapshot-path) instead of "

@@ -105,7 +105,11 @@ def write_endpoint(run_dir: str, role: str, rank: int | str, host: str,
         "pid": os.getpid() if pid is None else int(pid),
         "started_at": time.time(),
     }
-    tmp = path + ".tmp"
+    # per-pid tmp name: two processes racing to publish the same (role,
+    # rank) — e.g. replicas launched in the same instant without
+    # --process-id — must land on the warning above, not crash in
+    # os.replace because one mv'd the other's shared tmp file away
+    tmp = f"{path}.{os.getpid()}.tmp"
     with open(tmp, "w") as f:
         json.dump(doc, f)
     os.replace(tmp, path)
@@ -356,6 +360,52 @@ class AlertThresholds:
     #: (Hogwild self-staleness is ~1 in-flight step; 10x means a worker
     #: is computing on ancient weights).
     weight_age_ratio: float = 10.0
+
+    @classmethod
+    def resolve(cls, path: str | None = None, **overrides) -> "AlertThresholds":
+        """Effective thresholds for one run: dataclass defaults, overlaid
+        by a JSON thresholds file, overlaid by non-``None`` explicit
+        overrides (the ``launch obs-agg`` CLI flags).  Unknown keys —
+        in the file or the overrides — raise: a typo must not silently
+        leave a default in force."""
+        names = {f.name for f in dataclasses.fields(cls)}
+        kw: dict = {}
+        if path:
+            with open(path) as f:
+                doc = json.load(f)
+            if not isinstance(doc, dict):
+                raise ValueError(
+                    f"thresholds file {path} must hold a JSON object")
+            unknown = sorted(set(doc) - names)
+            if unknown:
+                raise ValueError(
+                    f"unknown threshold(s) {unknown} in {path}; "
+                    f"known: {sorted(names)}")
+            kw.update(doc)
+        for k, v in overrides.items():
+            if k not in names:
+                raise ValueError(f"unknown threshold override {k!r}; "
+                                 f"known: {sorted(names)}")
+            if v is not None:
+                kw[k] = v
+        for k, v in list(kw.items()):
+            # values must be numbers NOW, not when evaluate_alerts
+            # formats a threshold label mid-cycle (where the daemon's
+            # bad-cycle guard would swallow the crash every scrape and
+            # the alert gauges would silently never publish)
+            if isinstance(v, bool) or not isinstance(v, (int, float)):
+                raise ValueError(
+                    f"threshold {k!r} must be a number, got {v!r}")
+            if k == "barrier_min_count":
+                if v != int(v):
+                    # truncating 8.7 -> 8 would label an effective value
+                    # the operator never wrote
+                    raise ValueError(
+                        f"threshold {k!r} must be an integer, got {v!r}")
+                kw[k] = int(v)
+            else:
+                kw[k] = float(v)
+        return cls(**kw)
 
 
 def _merged_hist_child(reg: MetricsRegistry, name: str,
@@ -722,6 +772,15 @@ class FleetScraper:
                 if p is not None:
                     row["staleness_pushes_p50"] = round(p[0], 1)
                     row["staleness_pushes_p99"] = round(p[1], 1)
+                # routing-tier ranks (`launch route`): surface the
+                # admission/health signals next to the trainer rows
+                if snap.get("distlr_route_requests_total") is not None:
+                    row["route_requests"] = int(
+                        _snap_sum(snap, "distlr_route_requests_total"))
+                    row["route_shed"] = int(
+                        _snap_sum(snap, "distlr_route_shed_total"))
+                    row["replicas_up"] = int(
+                        _snap_sum(snap, "distlr_route_replica_up"))
             ranks.append(row)
         states = [r["state"] for r in ranks]
         return {

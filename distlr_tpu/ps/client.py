@@ -356,6 +356,37 @@ class KVWorker:
             return np.empty(0, np.float32)
         return parts[0] if len(parts) == 1 else np.concatenate(parts)
 
+    def pull_rows_into(self, table: np.ndarray, keys: np.ndarray, *,
+                       vals_per_key: int = 1,
+                       chunk_rows: int = 1 << 16) -> int:
+        """Keyed hot-slice pull: fetch only ``keys`` rows and scatter
+        them into ``table`` in place — the serving tier's working-set
+        refresh (:mod:`distlr_tpu.serve.hotset`).  A hot refresh moves
+        ``rows * (8 + 4*vpk)`` wire bytes instead of the full D-dim
+        table's; the caller's ``table`` keeps the last full pull's
+        values for every cold row (the documented staleness trade).
+
+        ``table`` must be a C-contiguous float32 array of ``dim``
+        elements (flat or ``(rows, vals_per_key)``); returns the number
+        of rows pulled (0 for an empty key set).
+        """
+        vpk = int(vals_per_key)
+        table = np.asarray(table)
+        if (table.dtype != np.float32 or table.size != self.dim
+                or not table.flags["C_CONTIGUOUS"]):
+            raise ValueError(
+                f"table must be C-contiguous float32 with {self.dim} "
+                f"elements, got {table.dtype} shape {table.shape}"
+            )
+        keys = self._validate_keys(keys, vpk)
+        if keys.size == 0:
+            return 0
+        vals = self.pull_chunked(keys, vals_per_key=vpk,
+                                 chunk_rows=chunk_rows)
+        view = table.reshape(self.dim // vpk, vpk)
+        view[keys.astype(np.int64)] = vals.reshape(-1, vpk)
+        return int(keys.size)
+
     def wait(self, ts: int) -> None:
         """No-op for API parity: push/pull already block (the reference
         pairs every Push/Pull with an immediate Wait)."""

@@ -1,17 +1,43 @@
-"""Online serving subsystem — batched scoring with hot weight reload.
+"""Online serving subsystem — batched scoring behind a routed front-end.
 
 The inference half of the ROADMAP's "serves heavy traffic" north star:
 ``engine`` (bucketed jitted batched scoring over every model family),
 ``batcher`` (microbatch request coalescing), ``reload`` (checkpoint-watch
-and live-PS weight sources with atomic swap), ``server`` (stdlib threaded
-TCP front-end; ``python -m distlr_tpu.launch serve``).
+and live-PS weight sources with atomic swap + jittered polling),
+``hotset`` (working-set tracking for hot-row keyed reload), ``server``
+(stdlib threaded TCP front-end; ``python -m distlr_tpu.launch serve``),
+and ``router`` (the serving-tier control plane: health-checked engine
+replicas, admission control, retry-once failover;
+``python -m distlr_tpu.launch route``).
+
+Attributes resolve lazily (PEP 562) so the jax-free pieces — the router
+and the hot-set tracker — import without touching jax: ``launch route``
+starts in well under a second, like ``launch obs-agg``.
 """
 
-from distlr_tpu.serve.batcher import MicroBatcher  # noqa: F401
-from distlr_tpu.serve.engine import ScoringEngine  # noqa: F401
-from distlr_tpu.serve.reload import (  # noqa: F401
-    CheckpointWatcher,
-    HotReloader,
-    LivePSWatcher,
-)
-from distlr_tpu.serve.server import ScoringServer, score_lines_over_tcp  # noqa: F401
+import importlib
+
+_LAZY = {
+    "MicroBatcher": "distlr_tpu.serve.batcher",
+    "ScoringEngine": "distlr_tpu.serve.engine",
+    "HotSetTracker": "distlr_tpu.serve.hotset",
+    "CheckpointWatcher": "distlr_tpu.serve.reload",
+    "HotReloader": "distlr_tpu.serve.reload",
+    "LivePSWatcher": "distlr_tpu.serve.reload",
+    "ScoringRouter": "distlr_tpu.serve.router",
+    "ScoringServer": "distlr_tpu.serve.server",
+    "score_lines_over_tcp": "distlr_tpu.serve.server",
+}
+
+__all__ = sorted(_LAZY)
+
+
+def __getattr__(name: str):
+    mod = _LAZY.get(name)
+    if mod is None:
+        raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+    return getattr(importlib.import_module(mod), name)
+
+
+def __dir__():
+    return sorted(set(globals()) | set(_LAZY))

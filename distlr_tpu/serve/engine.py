@@ -291,6 +291,20 @@ class ScoringEngine:
             X = np.clip(np.rint(X / scale), -127, 127).astype(np.int8)
         return (X,)
 
+    def row_keys(self, rows: tuple[np.ndarray, ...]) -> np.ndarray:
+        """PS row keys a request batch touches (``rows`` in this family's
+        leaf layout) — what a :class:`~distlr_tpu.serve.hotset.
+        HotSetTracker` observes.  Keys are row ids in the PS row space:
+        sparse COO column ids, blocked table row ids, or (dense) the
+        feature columns any row in the batch exercises.  Sparse padding
+        (col 0 / val 0) may contribute key 0 — one spuriously-hot row,
+        harmless."""
+        if self.cfg.model in ("sparse_lr", "sparse_softmax", "blocked_lr"):
+            return np.unique(
+                np.asarray(rows[0], dtype=np.int64)).astype(np.uint64)
+        X = np.asarray(rows[0])
+        return np.flatnonzero((X != 0).any(axis=0)).astype(np.uint64)
+
     # -- stats -------------------------------------------------------------
     def stats(self) -> dict:
         return {

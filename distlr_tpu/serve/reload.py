@@ -14,21 +14,47 @@ interface:
   whole point of continuous async training (PAPER.md): the model serving
   traffic is seconds old, not checkpoint-interval old.
 
+  With a :class:`~distlr_tpu.serve.hotset.HotSetTracker` attached, polls
+  refresh only the traffic's hot row slice (``pull_rows_into``) against
+  a cached full table — at D=1M with a concentrated key distribution a
+  refresh moves <1% of the full-table bytes.  Cold rows stay at their
+  last full-refresh value (the staleness trade); a full refresh runs
+  whenever tracker coverage drops below ``min_coverage`` or every
+  ``full_refresh_every`` polls.
+
 :class:`HotReloader` polls a source on a background thread and publishes
 into ``engine.set_weights`` — an atomic reference swap the engine applies
 between batches, so in-flight requests finish on the weights they
-started with and nothing is dropped during a swap.
+started with and nothing is dropped during a swap.  Poll timing is
+JITTERED (``interval_s`` ± ``jitter``): N engine replicas launched
+together would otherwise pull the PS in lockstep forever, stacking N
+chunked table reads onto the same server receive loops at the same
+instant every interval.
 """
 
 from __future__ import annotations
 
+import random
 import threading
 
 import numpy as np
 
+from distlr_tpu.obs.registry import get_registry
 from distlr_tpu.utils.logging import get_logger
 
 log = get_logger(__name__)
+
+_reg = get_registry()
+_RELOADS = _reg.counter(
+    "distlr_serve_reloads_total",
+    "live-PS weight reloads by kind (full table vs hot working-set slice)",
+    labelnames=("kind",),
+)
+_RELOAD_ROWS = _reg.counter(
+    "distlr_serve_reload_rows_total",
+    "parameter rows fetched by live-PS weight reloads",
+    labelnames=("kind",),
+)
 
 
 class CheckpointWatcher:
@@ -61,6 +87,13 @@ class LivePSWatcher:
     increasing local version, and the poll INTERVAL is the staleness
     bound.  ``vals_per_key``/``chunk_rows``: see
     :meth:`distlr_tpu.ps.KVWorker.pull_chunked`.
+
+    ``hot_tracker``: a :class:`~distlr_tpu.serve.hotset.HotSetTracker`
+    fed by the front-end; when set, polls refresh only the hot row slice
+    into a cached table (see module docstring), falling back to a full
+    refresh when ``coverage() < min_coverage``, every
+    ``full_refresh_every`` polls (0 = never forced), or on the first
+    poll (no cached table yet).
     """
 
     #: client_id for serving pulls — out of the way of trainer worker ranks
@@ -68,7 +101,8 @@ class LivePSWatcher:
 
     def __init__(self, hosts: str, dim: int, *, vals_per_key: int = 1,
                  chunk_rows: int = 1 << 16, timeout_ms: int = 10_000,
-                 client_id: int | None = None):
+                 client_id: int | None = None, hot_tracker=None,
+                 min_coverage: float = 0.95, full_refresh_every: int = 10):
         from distlr_tpu.ps import KVWorker  # noqa: PLC0415
 
         self.kv = KVWorker(
@@ -79,7 +113,11 @@ class LivePSWatcher:
             # async-group push shortcut flag is irrelevant either way
             sync_group=True,
         )
-        self.vals_per_key = int(vals_per_key)
+        #: requested row width — the unit the engine's row keys and the
+        #: hot tracker are stated in, even when the wire falls back to
+        #: flat keys below
+        self.row_width = max(int(vals_per_key), 1)
+        self.vals_per_key = self.row_width
         if self.vals_per_key > 1 and not self.kv.supports_vals_per_key(
                 self.vals_per_key):
             # same fallback rule as the keyed trainer: rows that straddle
@@ -88,14 +126,98 @@ class LivePSWatcher:
                      "boundaries; using flat keys", self.vals_per_key)
             self.vals_per_key = 1
         self.chunk_rows = int(chunk_rows)
+        if not 0.0 < min_coverage <= 1.0:
+            raise ValueError(
+                f"min_coverage must be in (0, 1], got {min_coverage}")
+        if full_refresh_every < 0:
+            raise ValueError(
+                f"full_refresh_every must be >= 0, got {full_refresh_every}")
+        self.hot_tracker = hot_tracker
+        self.min_coverage = float(min_coverage)
+        self.full_refresh_every = int(full_refresh_every)
         self._version = 0
+        self._table: np.ndarray | None = None
+        self._since_full = 0
+        self.full_reloads = 0
+        self.hot_reloads = 0
+        self.last_kind: str | None = None
+        self.last_rows = 0
+
+    def _pull_full(self) -> np.ndarray:
+        return self.kv.pull_chunked(
+            vals_per_key=self.vals_per_key, chunk_rows=self.chunk_rows)
+
+    def _hot_pull_keys(self, row_keys: np.ndarray) -> np.ndarray:
+        """Tracker row ids -> the key space the wire actually uses: when
+        vals_per_key fell back to flat keys, each R-lane row id expands
+        to its R flat slots (ascending in, ascending out)."""
+        if self.vals_per_key == self.row_width:
+            return row_keys
+        r = self.row_width
+        return (row_keys[:, None] * r
+                + np.arange(r, dtype=np.uint64)[None, :]).reshape(-1)
 
     def poll(self):
-        w = self.kv.pull_chunked(
-            vals_per_key=self.vals_per_key, chunk_rows=self.chunk_rows
-        )
+        if self.hot_tracker is None:
+            w = self._pull_full()
+            self._version += 1
+            self.full_reloads += 1
+            self.last_kind, self.last_rows = "full", w.size // self.row_width
+            _RELOADS.labels(kind="full").inc()
+            _RELOAD_ROWS.labels(kind="full").inc(self.last_rows)
+            return self._version, w
+        full = (self._table is None
+                or self.hot_tracker.coverage() < self.min_coverage
+                or (self.full_refresh_every > 0
+                    and self._since_full >= self.full_refresh_every))
+        if full:
+            self._table = np.ascontiguousarray(
+                self._pull_full(), dtype=np.float32)
+            self._since_full = 0
+            self.full_reloads += 1
+            rows = self._table.size // self.row_width
+            # publish the snapshot so the coverage window restarts over
+            # the fresh table (everything is hot right after a full pull)
+            self.hot_tracker.hot_keys()
+            kind = "full"
+        else:
+            keys = self._hot_pull_keys(self.hot_tracker.hot_keys())
+            if keys.size == 0:
+                # idle replica: nothing hot to refresh and the cached
+                # table is already published — reporting a "new" version
+                # here would make the reloader re-upload an identical
+                # D-dim table to the device every poll
+                return None
+            pulled = self.kv.pull_rows_into(
+                self._table, keys, vals_per_key=self.vals_per_key,
+                chunk_rows=self.chunk_rows)
+            rows = pulled if self.vals_per_key == self.row_width \
+                else pulled // self.row_width
+            self._since_full += 1
+            self.hot_reloads += 1
+            kind = "hot"
         self._version += 1
-        return self._version, w
+        self.last_kind, self.last_rows = kind, rows
+        _RELOADS.labels(kind=kind).inc()
+        _RELOAD_ROWS.labels(kind=kind).inc(rows)
+        # hand out a COPY: the next hot poll scatters into self._table in
+        # place, and jax.device_put of an aligned float32 host array can
+        # be zero-copy — returning the live buffer would let in-flight
+        # requests read torn, half-patched weights (the atomic-swap
+        # contract says they finish on the weights they started with)
+        return self._version, self._table.copy()
+
+    def stats(self) -> dict:
+        rec = {
+            "mode": "hot" if self.hot_tracker is not None else "full",
+            "full_reloads": self.full_reloads,
+            "hot_reloads": self.hot_reloads,
+            "last_kind": self.last_kind,
+            "last_rows": self.last_rows,
+        }
+        if self.hot_tracker is not None:
+            rec["hot_set"] = self.hot_tracker.stats()
+        return rec
 
     def close(self) -> None:
         self.kv.close()
@@ -108,14 +230,24 @@ class HotReloader:
     keep answering on its last good weights when the trainer's PS group
     restarts or the checkpoint dir is mid-write (both sources' errors are
     transient by design).
+
+    Each wait is drawn from ``interval_s * (1 ± jitter)`` so replicas
+    launched together DESYNCHRONIZE instead of pulling the PS in
+    lockstep forever (each reloader seeds its own RNG); ``jitter=0``
+    restores the fixed cadence.
     """
 
-    def __init__(self, engine, source, *, interval_s: float = 1.0):
+    def __init__(self, engine, source, *, interval_s: float = 1.0,
+                 jitter: float = 0.2, _seed: int | None = None):
         if interval_s <= 0:
             raise ValueError(f"interval_s must be positive, got {interval_s}")
+        if not 0.0 <= jitter < 1.0:
+            raise ValueError(f"jitter must be in [0, 1), got {jitter}")
         self.engine = engine
         self.source = source
         self.interval_s = float(interval_s)
+        self.jitter = float(jitter)
+        self._rng = random.Random(_seed)
         self.reloads = 0
         self.errors = 0
         self.last_version = None
@@ -126,6 +258,12 @@ class HotReloader:
         self._thread = threading.Thread(
             target=self._run, daemon=True, name="distlr-hot-reload"
         )
+
+    def _next_wait(self) -> float:
+        if not self.jitter:
+            return self.interval_s
+        return self.interval_s * (
+            1.0 + self.jitter * (2.0 * self._rng.random() - 1.0))
 
     def _poll_once(self) -> bool:
         with self._poll_lock:
@@ -146,7 +284,7 @@ class HotReloader:
             return True
 
     def _run(self):
-        while not self._stop.wait(self.interval_s):
+        while not self._stop.wait(self._next_wait()):
             self._poll_once()
 
     def start(self) -> "HotReloader":
@@ -171,12 +309,16 @@ class HotReloader:
             time.sleep(min(self.interval_s, 0.2))
 
     def stats(self) -> dict:
-        return {
+        rec = {
             "reloads": self.reloads,
             "reload_errors": self.errors,
             "last_version": self.last_version,
             "interval_s": self.interval_s,
         }
+        source_stats = getattr(self.source, "stats", None)
+        if callable(source_stats):
+            rec["source"] = source_stats()
+        return rec
 
     def stop(self) -> None:
         self._stop.set()

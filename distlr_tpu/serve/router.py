@@ -1,0 +1,562 @@
+"""Serving-tier routing front-end — one listener over N engine replicas.
+
+The piece that turns "a scoring process" into "a serving tier": Hogwild
+training tolerates slightly-stale replicas (arXiv:1508.05711), so N
+independently-reloading :class:`~distlr_tpu.serve.server.ScoringServer`
+replicas can answer the same traffic — this router is the control plane
+that lets them die, reload, and rejoin under live load without the
+front-end dropping accepted requests.
+
+Speaks exactly the replica line protocol (libsvm line / JSON batch /
+``STATS``), so clients cannot tell a router from a single engine:
+
+* **load balancing** — least-in-flight among healthy replicas, rotated
+  tie-break so idle-time traffic still spreads.
+* **admission control** — a bounded per-replica in-flight budget
+  (``max_inflight``); a request that finds every HEALTHY replica's
+  budget full gets an explicit ``ERR SHED`` reply and ticks
+  ``distlr_route_shed_total`` (overload = scale up), while a tier with
+  zero healthy replicas answers ``ERR ROUTE`` and ticks the error
+  counter (outage = page someone).  Never a silent hang: every
+  accepted byte is answered or refused loudly.
+* **failure detection** — passive (``eject_after`` consecutive
+  transport failures ejects a replica from rotation) and active
+  (periodic ``STATS`` probes catch a silently-dead replica without
+  traffic); ejected replicas are probed on exponential backoff and
+  reinstated on the first success.
+* **retry-once failover** — scoring is idempotent, so a request whose
+  replica dies mid-exchange is transparently retried on another replica
+  (once); application-level ``ERR`` replies from a replica (malformed
+  input) pass through untouched — they are deterministic, not failures.
+
+Stdlib-only and jax-free: ``python -m distlr_tpu.launch route`` starts
+in well under a second and never competes with replicas for a chip.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import socketserver
+import threading
+import time
+
+from distlr_tpu.obs.registry import get_registry
+from distlr_tpu.utils.logging import get_logger
+
+log = get_logger(__name__)
+
+_reg = get_registry()
+_REQ_SECONDS = _reg.histogram(
+    "distlr_route_request_seconds",
+    "wall seconds per routed request line (admission to reply, incl. "
+    "retries)", labelnames=("listener",),
+)
+_REQUESTS = _reg.counter(
+    "distlr_route_requests_total",
+    "request lines answered from a replica", labelnames=("listener",),
+)
+_ERRORS = _reg.counter(
+    "distlr_route_errors_total",
+    "accepted request lines that failed on every tried replica",
+    labelnames=("listener",),
+)
+_SHED = _reg.counter(
+    "distlr_route_shed_total",
+    "request lines shed at admission (no healthy replica with a free "
+    "in-flight slot)", labelnames=("listener",),
+)
+_RETRIES = _reg.counter(
+    "distlr_route_retries_total",
+    "transparent retries on another replica after a transport failure",
+    labelnames=("listener",),
+)
+_REPLICA_UP = _reg.gauge(
+    "distlr_route_replica_up",
+    "1 while the replica is in rotation (0 = ejected)",
+    labelnames=("replica",),
+)
+_REPLICA_INFLIGHT = _reg.gauge(
+    "distlr_route_replica_inflight",
+    "requests currently in flight to the replica", labelnames=("replica",),
+)
+_EJECTIONS = _reg.counter(
+    "distlr_route_ejections_total",
+    "replica ejections after consecutive transport failures",
+    labelnames=("replica",),
+)
+_REINSTATES = _reg.counter(
+    "distlr_route_reinstates_total",
+    "ejected replicas reinstated by a successful backoff probe",
+    labelnames=("replica",),
+)
+
+
+class _Replica:
+    """One engine replica: address, bounded in-flight budget, a pool of
+    persistent connections, and health state (owned by the router's
+    health lock except for the connection pool's own lock)."""
+
+    def __init__(self, addr: str, *, max_inflight: int, timeout_s: float):
+        host, _, port = addr.rpartition(":")
+        if not host or not port.isdigit():
+            raise ValueError(f"replica must be host:port, got {addr!r}")
+        if "[" in host or "]" in host or ":" in host:
+            # fail at construction, not as per-request gaierrors after
+            # the router already announced ROUTING
+            raise ValueError(
+                f"IPv6 replica addresses are not supported, got {addr!r} "
+                "(use a hostname or IPv4 host:port)")
+        self.addr = addr
+        self.host, self.port = host, int(port)
+        self.timeout_s = timeout_s
+        self._sem = threading.BoundedSemaphore(max_inflight)
+        self._pool_lock = threading.Lock()
+        self._idle: list[tuple] = []
+        self.healthy = True
+        self.consecutive_errors = 0
+        self.inflight = 0
+        self.requests = 0
+        self.errors = 0
+        self.ejections = 0
+        self.reinstates = 0
+        self.backoff_s = 0.0
+        self.next_probe_at = 0.0
+        self.last_ok = 0.0      # monotonic: last successful exchange/probe
+        self.last_probe = 0.0
+        self._up_g = _REPLICA_UP.labels(replica=addr)
+        self._inflight_g = _REPLICA_INFLIGHT.labels(replica=addr)
+        self._up_g.set(1.0)
+        self._inflight_g.set(0.0)
+
+    # -- in-flight budget (admission control) -----------------------------
+    def try_acquire(self) -> bool:
+        if self._sem.acquire(blocking=False):
+            self.inflight += 1
+            self._inflight_g.inc()
+            return True
+        return False
+
+    def release(self) -> None:
+        self.inflight -= 1
+        self._inflight_g.dec()
+        self._sem.release()
+
+    # -- connection pool ---------------------------------------------------
+    def _dial(self):
+        s = socket.create_connection((self.host, self.port),
+                                     timeout=self.timeout_s)
+        return s, s.makefile("rwb")
+
+    def _checkin(self, conn) -> None:
+        with self._pool_lock:
+            if self.healthy:
+                self._idle.append(conn)
+                return
+        self._close(conn)
+
+    @staticmethod
+    def _close(conn) -> None:
+        sock, f = conn
+        for closer in (f.close, sock.close):
+            try:
+                closer()
+            except OSError:
+                pass
+
+    def drain_pool(self) -> None:
+        with self._pool_lock:
+            idle, self._idle = self._idle, []
+        for conn in idle:
+            self._close(conn)
+
+    def _roundtrip(self, conn, line: str) -> str:
+        sock, f = conn
+        f.write((line + "\n").encode())
+        f.flush()
+        reply = f.readline()
+        if not reply:
+            raise ConnectionError(
+                f"replica {self.addr} closed the connection")
+        return reply.decode().rstrip("\n")
+
+    def exchange(self, line: str) -> str:
+        """One request/reply toward this replica.  Raises on transport
+        failure (the retry/eject trigger); an ``ERR ...`` reply from the
+        replica is a successful exchange.
+
+        A failure on a POOLED connection is retried once on a freshly
+        dialed one before it propagates: an idle socket gone stale (the
+        replica restarted cleanly between bursts) is evidence about the
+        socket, not the replica — without this, ``eject_after`` stale
+        pool entries would eject a healthy replica.  Scores are
+        idempotent, so the maybe-delivered first write is safe to
+        resend."""
+        conn = None
+        with self._pool_lock:
+            if self._idle:
+                conn = self._idle.pop()
+        if conn is not None:
+            try:
+                reply = self._roundtrip(conn, line)
+            except Exception:
+                self._close(conn)
+                conn = None  # stale pooled socket: fall through to a dial
+            else:
+                self._checkin(conn)
+                return reply
+        conn = self._dial()
+        try:
+            reply = self._roundtrip(conn, line)
+        except Exception:
+            self._close(conn)
+            raise
+        self._checkin(conn)
+        return reply
+
+
+class _RouterHandler(socketserver.StreamRequestHandler):
+    def handle(self):
+        router: ScoringRouter = self.server.router  # type: ignore[attr-defined]
+        for raw in self.rfile:
+            try:
+                line = raw.decode("utf-8", errors="replace").strip()
+            except Exception:
+                continue
+            if not line:
+                continue
+            reply = router.handle_line(line)
+            try:
+                self.wfile.write((reply + "\n").encode())
+                self.wfile.flush()
+            except (BrokenPipeError, ConnectionResetError):
+                return
+
+
+class _TCPServer(socketserver.ThreadingTCPServer):
+    daemon_threads = True
+    allow_reuse_address = True
+
+
+class ScoringRouter:
+    """Health-checked load-balancing front-end over engine replicas.
+
+    ``replicas``: list (or comma-separated string) of ``host:port``
+    addresses of running :class:`ScoringServer` listeners (or nested
+    routers — the protocol is identical).
+    """
+
+    def __init__(self, replicas, *, host: str = "127.0.0.1", port: int = 0,
+                 max_inflight: int = 64, eject_after: int = 3,
+                 health_interval_s: float = 1.0,
+                 probe_backoff_s: float = 0.5,
+                 probe_backoff_max_s: float = 30.0,
+                 backend_timeout_s: float = 30.0, retries: int = 1):
+        if isinstance(replicas, str):
+            replicas = [a.strip() for a in replicas.split(",") if a.strip()]
+        if not replicas:
+            raise ValueError("router needs at least one replica address")
+        if len(set(replicas)) != len(replicas):
+            raise ValueError(f"duplicate replica addresses in {replicas}")
+        if max_inflight <= 0:
+            raise ValueError(f"max_inflight must be positive, got {max_inflight}")
+        if eject_after < 1:
+            raise ValueError(f"eject_after must be >= 1, got {eject_after}")
+        if health_interval_s <= 0:
+            raise ValueError(
+                f"health_interval_s must be positive, got {health_interval_s}")
+        if probe_backoff_s <= 0 or probe_backoff_max_s < probe_backoff_s:
+            raise ValueError(
+                "need 0 < probe_backoff_s <= probe_backoff_max_s, got "
+                f"{probe_backoff_s}/{probe_backoff_max_s}")
+        if retries < 0:
+            raise ValueError(f"retries must be >= 0, got {retries}")
+        self.replicas = [
+            _Replica(a, max_inflight=max_inflight, timeout_s=backend_timeout_s)
+            for a in replicas
+        ]
+        self.max_inflight = int(max_inflight)
+        self.eject_after = int(eject_after)
+        self.health_interval_s = float(health_interval_s)
+        self.probe_backoff_s = float(probe_backoff_s)
+        self.probe_backoff_max_s = float(probe_backoff_max_s)
+        self.probe_timeout_s = min(float(backend_timeout_s), 2.0)
+        self._retries = int(retries)
+        self._lock = threading.Lock()   # health state + rotation counter
+        self._rr = 0
+        self._t0 = time.monotonic()
+        self._tcp = _TCPServer((host, port), _RouterHandler,
+                               bind_and_activate=True)
+        self._tcp.router = self  # type: ignore[attr-defined]
+        self.host, self.port = self._tcp.server_address[:2]
+        listener = f"{self.host}:{self.port}"
+        self._req_seconds = _REQ_SECONDS.labels(listener=listener)
+        self._requests_c = _REQUESTS.labels(listener=listener)
+        self._errors_c = _ERRORS.labels(listener=listener)
+        self._shed_c = _SHED.labels(listener=listener)
+        self._retries_c = _RETRIES.labels(listener=listener)
+        # construction-time baselines: registry children are
+        # process-lifetime, STATS reports this router instance's deltas
+        # (same contract as ScoringServer)
+        self._req_base = self._requests_c.value
+        self._err_base = self._errors_c.value
+        self._shed_base = self._shed_c.value
+        self._retry_base = self._retries_c.value
+        self._stop = threading.Event()
+        self._started = False
+        self._accept_thread = threading.Thread(
+            target=self._tcp.serve_forever, daemon=True,
+            name="distlr-route-accept")
+        self._health_thread = threading.Thread(
+            target=self._health_loop, daemon=True, name="distlr-route-health")
+
+    # -- replica selection / health ---------------------------------------
+    def _acquire(self, excluded: list) -> _Replica | None:
+        """A healthy replica with a free in-flight slot: least in-flight
+        first, rotating tie-break so serial traffic still spreads."""
+        with self._lock:
+            cands = [r for r in self.replicas
+                     if r.healthy and r not in excluded]
+            if not cands:
+                return None
+            self._rr = (self._rr + 1) % len(cands)
+            cands = cands[self._rr:] + cands[:self._rr]
+            cands.sort(key=lambda r: r.inflight)  # stable: rotation = tie-break
+            for rep in cands:
+                if rep.try_acquire():
+                    return rep
+            return None
+
+    def _release(self, rep: _Replica) -> None:
+        with self._lock:
+            rep.release()
+
+    def _note_success(self, rep: _Replica) -> None:
+        with self._lock:
+            rep.requests += 1
+            rep.consecutive_errors = 0
+            rep.last_ok = time.monotonic()
+
+    def _note_failure(self, rep: _Replica) -> None:
+        with self._lock:
+            rep.errors += 1
+            rep.consecutive_errors += 1
+            if rep.healthy and rep.consecutive_errors >= self.eject_after:
+                self._eject_locked(rep)
+
+    def _eject_locked(self, rep: _Replica) -> None:
+        rep.healthy = False
+        rep.ejections += 1
+        rep.backoff_s = self.probe_backoff_s
+        rep.next_probe_at = time.monotonic() + rep.backoff_s
+        rep._up_g.set(0.0)
+        _EJECTIONS.labels(replica=rep.addr).inc()
+        log.warning("replica %s ejected after %d consecutive failures; "
+                    "probing with %.2fs backoff", rep.addr,
+                    rep.consecutive_errors, rep.backoff_s)
+        rep.drain_pool()  # pooled sockets to a suspect replica are suspect
+
+    def _probe(self, rep: _Replica) -> bool:
+        """Active health check: a STATS round trip on a fresh connection.
+        Success reinstates an ejected replica; failure backs off (or
+        counts toward ejection for a replica still in rotation)."""
+        try:
+            with socket.create_connection(
+                    (rep.host, rep.port), timeout=self.probe_timeout_s) as s:
+                f = s.makefile("rwb")
+                f.write(b"STATS\n")
+                f.flush()
+                reply = f.readline()
+            ok = bool(reply)
+            if ok:
+                try:
+                    doc = json.loads(reply)
+                    if isinstance(doc, dict) and doc.get("replicas_up") == 0:
+                        # a nested child router answers STATS even when
+                        # its whole tier is down — don't reinstate a
+                        # subtree that cannot serve anything
+                        ok = False
+                except ValueError:
+                    pass
+        except OSError:
+            ok = False
+        with self._lock:
+            rep.last_probe = time.monotonic()
+            if ok:
+                rep.consecutive_errors = 0
+                rep.last_ok = rep.last_probe
+                rep.backoff_s = 0.0
+                if not rep.healthy:
+                    rep.healthy = True
+                    rep.reinstates += 1
+                    rep._up_g.set(1.0)
+                    _REINSTATES.labels(replica=rep.addr).inc()
+                    log.info("replica %s reinstated", rep.addr)
+            elif rep.healthy:
+                rep.errors += 1
+                rep.consecutive_errors += 1
+                if rep.consecutive_errors >= self.eject_after:
+                    self._eject_locked(rep)
+            else:
+                rep.backoff_s = min(max(rep.backoff_s * 2,
+                                        self.probe_backoff_s),
+                                    self.probe_backoff_max_s)
+                rep.next_probe_at = rep.last_probe + rep.backoff_s
+        return ok
+
+    def _health_loop(self) -> None:
+        tick = max(0.01, min(self.health_interval_s, 0.25))
+        while not self._stop.wait(tick):
+            now = time.monotonic()
+            for rep in self.replicas:
+                with self._lock:
+                    if rep.healthy:
+                        due = (now - max(rep.last_ok, rep.last_probe)
+                               >= self.health_interval_s)
+                    else:
+                        due = now >= rep.next_probe_at
+                        if due:
+                            # pre-push the next slot so a fast-failing
+                            # probe cannot hot-loop inside one backoff
+                            rep.next_probe_at = now + max(
+                                rep.backoff_s, self.probe_backoff_s)
+                if due:
+                    self._probe(rep)
+
+    # -- request path ------------------------------------------------------
+    def handle_line(self, line: str) -> str:
+        if line == "STATS":
+            return json.dumps(self.stats())
+        t0 = time.monotonic()
+        excluded: list[_Replica] = []
+        last_err = "no healthy replica in rotation"
+        shed_only = True  # every failure so far was overload, not death
+        for attempt in range(self._retries + 1):
+            rep = self._acquire(excluded)
+            if rep is None:
+                if attempt == 0:
+                    with self._lock:
+                        any_healthy = any(r.healthy for r in self.replicas)
+                    if not any_healthy:
+                        # total outage, not overload: shed means "scale
+                        # up"; this means "the tier is down" — it must
+                        # tick the error counter, not the shed counter
+                        self._errors_c.inc()
+                        return ("ERR ROUTE: no healthy replica in "
+                                "rotation (all ejected)")
+                    # admission refusal — the request was never accepted
+                    self._shed_c.inc()
+                    return ("ERR SHED: no replica with free capacity "
+                            "(load shed)")
+                break  # accepted, but no retry target left: fail loudly
+            if attempt > 0:
+                # counted only once a replacement replica was actually
+                # acquired — a failed exchange with nowhere to go is an
+                # error, not a retry
+                self._retries_c.inc()
+            try:
+                reply = rep.exchange(line)
+            except Exception as e:  # noqa: BLE001 — any transport failure
+                last_err = f"{type(e).__name__}: {e}"
+                shed_only = False
+                self._note_failure(rep)
+                excluded.append(rep)
+                continue
+            finally:
+                self._release(rep)
+            if reply.startswith(("ERR SHED", "ERR ROUTE")):
+                # only routers emit these (an engine's ERR carries the
+                # exception name): a nested child tier answering SHED is
+                # overloaded — retry a sibling but DON'T count toward
+                # ejection (overload is not death); a child answering
+                # ROUTE has a dead subtree — retry AND eject, so it
+                # stops eating traffic
+                last_err = reply
+                if reply.startswith("ERR ROUTE"):
+                    shed_only = False
+                    self._note_failure(rep)
+                excluded.append(rep)
+                continue
+            self._note_success(rep)
+            self._req_seconds.observe(time.monotonic() - t0)
+            self._requests_c.inc()
+            return reply
+        if shed_only and excluded:
+            # every tried child shed: the tier-wide truth is still
+            # overload ("scale up"), not outage ("page someone")
+            self._shed_c.inc()
+            return last_err
+        self._errors_c.inc()
+        return (f"ERR ROUTE: request failed on {len(excluded)} "
+                f"replica(s): {last_err}")
+
+    # -- stats -------------------------------------------------------------
+    def stats(self) -> dict:
+        """Same scalar schema as :meth:`ScoringServer.stats` (requests/
+        errors/qps/p50_ms/p99_ms/shed/retries/replica_count) plus the
+        per-replica state list — one parser covers both tiers."""
+        n_req = int(self._requests_c.value - self._req_base)
+        n_err = int(self._errors_c.value - self._err_base)
+        elapsed = max(time.monotonic() - self._t0, 1e-9)
+        with self._lock:
+            reps = [{
+                "addr": r.addr,
+                "healthy": r.healthy,
+                "inflight": r.inflight,
+                "requests": r.requests,
+                "errors": r.errors,
+                "ejections": r.ejections,
+                "reinstates": r.reinstates,
+            } for r in self.replicas]
+        return {
+            "requests": n_req,
+            "errors": n_err,
+            "qps": round(n_req / elapsed, 2),
+            "p50_ms": round(self._req_seconds.percentile(0.50) * 1e3, 3),
+            "p99_ms": round(self._req_seconds.percentile(0.99) * 1e3, 3),
+            "shed": int(self._shed_c.value - self._shed_base),
+            "retries": int(self._retries_c.value - self._retry_base),
+            "replica_count": len(reps),
+            "replicas_up": sum(r["healthy"] for r in reps),
+            "replicas": reps,
+        }
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> "ScoringRouter":
+        self._started = True
+        self._accept_thread.start()
+        self._health_thread.start()
+        log.info("routing on %s:%d over %d replica(s): %s",
+                 self.host, self.port, len(self.replicas),
+                 ",".join(r.addr for r in self.replicas))
+        return self
+
+    def serve_forever(self) -> None:
+        """Foreground mode for the CLI: start, then block until stopped."""
+        self.start()
+        try:
+            while self._accept_thread.is_alive():
+                self._accept_thread.join(timeout=1.0)
+        except KeyboardInterrupt:
+            pass
+        finally:
+            self.stop()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._started:
+            # shutdown() blocks forever unless serve_forever actually
+            # ran (the MetricsServer.stop() bug class from ISSUE 3) —
+            # a router stopped before start() just closes the socket
+            self._tcp.shutdown()
+        self._tcp.server_close()
+        if self._health_thread.is_alive():
+            self._health_thread.join(timeout=10.0)
+        for rep in self.replicas:
+            rep.drain_pool()
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()

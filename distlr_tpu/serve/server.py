@@ -62,6 +62,13 @@ _ERRORS = _reg.counter(
 class _Handler(socketserver.StreamRequestHandler):
     def handle(self):
         srv: ScoringServer = self.server.scoring_server  # type: ignore[attr-defined]
+        srv._track(self.connection)
+        try:
+            self._serve_lines(srv)
+        finally:
+            srv._untrack(self.connection)
+
+    def _serve_lines(self, srv: "ScoringServer"):
         for raw in self.rfile:
             try:
                 line = raw.decode("utf-8", errors="replace").strip()
@@ -87,9 +94,12 @@ class ScoringServer:
 
     def __init__(self, engine, *, host: str = "127.0.0.1", port: int = 0,
                  max_wait_ms: float = 2.0, reloader=None,
-                 metrics: MetricsLogger | None = None):
+                 metrics: MetricsLogger | None = None, hot_tracker=None):
         self.engine = engine
         self.reloader = reloader
+        #: HotSetTracker fed from request traffic (hot-row keyed reload);
+        #: None = full-table refresh semantics, no tracking overhead.
+        self.hot_tracker = hot_tracker
         self.batcher = MicroBatcher(
             engine.score,
             max_batch_size=engine.max_batch_size,
@@ -111,14 +121,27 @@ class ScoringServer:
         # still aggregate the listener's full process history.
         self._req_base = self._requests_c.value
         self._err_base = self._errors_c.value
+        self._conn_lock = threading.Lock()
+        self._active_conns: set = set()
+        self._started = False
         self._thread = threading.Thread(
             target=self._tcp.serve_forever, daemon=True,
             name="distlr-serve-accept",
         )
 
     # -- request handling --------------------------------------------------
+    def _track(self, conn) -> None:
+        with self._conn_lock:
+            self._active_conns.add(conn)
+
+    def _untrack(self, conn) -> None:
+        with self._conn_lock:
+            self._active_conns.discard(conn)
+
     def _score_lines(self, lines: list[str]):
         rows = self.engine.encode_lines(lines)
+        if self.hot_tracker is not None:
+            self.hot_tracker.observe(self.engine.row_keys(rows))
         labels, scores = self.batcher.submit(rows).result()
         return np.asarray(labels), np.asarray(scores)
 
@@ -162,6 +185,14 @@ class ScoringServer:
             "qps": round(n_req / elapsed, 2),
             "p50_ms": round(self._req_seconds.percentile(0.50) * 1e3, 3),
             "p99_ms": round(self._req_seconds.percentile(0.99) * 1e3, 3),
+            # Routing-tier schema parity (additive — ISSUE 4): the
+            # ScoringRouter's STATS carries the same scalar keys with
+            # live values; a single engine behind no router never sheds
+            # or retries and IS its own one-replica tier, so a scraper
+            # parses either reply with one schema.
+            "shed": 0,
+            "retries": 0,
+            "replica_count": 1,
             "batcher": self.batcher.stats(),
             "engine": self.engine.stats(),
         }
@@ -181,6 +212,7 @@ class ScoringServer:
 
     # -- lifecycle ---------------------------------------------------------
     def start(self) -> "ScoringServer":
+        self._started = True
         self._thread.start()
         log.info("serving %s on %s:%d (max_batch=%d, buckets=%s)",
                  self.engine.cfg.model, self.host, self.port,
@@ -199,12 +231,42 @@ class ScoringServer:
             self.stop()
 
     def stop(self) -> None:
-        self._tcp.shutdown()
+        if self._started:
+            # shutdown() blocks forever unless serve_forever actually
+            # ran (the MetricsServer.stop() bug class from ISSUE 3)
+            self._tcp.shutdown()
         self._tcp.server_close()
         self.batcher.close()
         if self.reloader is not None:
             self.reloader.stop()
         self.metrics.close()
+
+    def abort(self) -> None:
+        """Crash-simulation shutdown (failover drills, router tests):
+        stop accepting AND sever every active connection mid-stream, so
+        clients see a transport error exactly as if the process were
+        SIGKILLed — none of the orderly drain :meth:`stop` performs.
+        The listener port is released, so a respawned server can rebind
+        it (the eject -> reinstate lifecycle the router e2e exercises).
+        """
+        if self._started:
+            self._tcp.shutdown()
+        self._tcp.server_close()
+        with self._conn_lock:
+            conns = list(self._active_conns)
+        for c in conns:
+            try:
+                c.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                c.close()
+            except OSError:
+                pass
+        # shared teardown (a SIGKILLed process takes its reload poller
+        # and metrics sink with it too); shutdown/server_close above are
+        # idempotent, so delegating keeps the two lifecycles in lockstep
+        self.stop()
 
     def __enter__(self):
         return self.start()

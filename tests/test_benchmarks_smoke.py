@@ -212,21 +212,29 @@ def test_quality_annotation_names_validating_regime(tmp_path, monkeypatch):
 
 def test_bench_serve_quick_emits_bench_row():
     """bench_serve.py joins the bench trajectory: one JSON line, bench.py
-    field conventions, engine + end-to-end sub rows."""
-    r = _run([sys.executable, "benchmarks/bench_serve.py", "--quick"],
+    field conventions, engine + end-to-end + multi-engine (router) sub
+    rows.  --smoke (the serve-smoke make target) is an alias of --quick."""
+    r = _run([sys.executable, "benchmarks/bench_serve.py", "--smoke"],
              timeout=600)
     assert r.returncode == 0, r.stderr[-2000:]
     row = json.loads(r.stdout.strip().splitlines()[-1])
-    for field in ("metric", "value", "unit", "backend", "D", "best_e2e"):
+    for field in ("metric", "value", "unit", "backend", "D", "best_e2e",
+                  "best_route"):
         assert field in row, row
     assert row["unit"] == "rows/sec"
     assert row["value"] and row["value"] > 0
     assert row["best_e2e"]["qps"] > 0
     assert 0.0 <= row["best_e2e"]["mean_occupancy"] <= 1.0
+    # ISSUE 4: the multi-engine pass rode the router with no sheds or
+    # failovers on an idle localhost box
+    assert row["best_route"]["qps"] > 0
+    assert row["best_route"]["replicas"] == 2
+    assert row["best_route"]["shed"] == 0
+    assert row["best_route"]["retries"] == 0
     # ISSUE 2: serving bench rows carry the tracer's phase sums too
     phases = row["phase_breakdown"]["phases"]
     assert phases["engine_score"]["seconds"] > 0
-    assert "e2e_clients" in phases
+    assert "e2e_clients" in phases and "route_clients" in phases
 
 
 def test_update_roofline_rewrites_auto_section(tmp_path, monkeypatch):

@@ -539,3 +539,111 @@ class TestPsFleetEndToEnd:
                 except subprocess.TimeoutExpired:
                     p.kill()
                     p.wait()
+
+
+class TestAlertThresholdOverrides:
+    """Satellite (ISSUE 4): alert thresholds were static constructor
+    defaults — they now resolve per run from defaults < JSON thresholds
+    file < explicit CLI flags, and the distlr_alert_* threshold labels
+    must reflect the EFFECTIVE values."""
+
+    def test_resolve_precedence(self, tmp_path):
+        p = tmp_path / "thresholds.json"
+        p.write_text(json.dumps({"push_error_rate": 0.5,
+                                 "barrier_wait_ratio": 4.0}))
+        t = AlertThresholds.resolve(str(p), push_error_rate=0.25,
+                                    weight_age_ratio=None)
+        assert t.push_error_rate == 0.25      # CLI flag beats the file
+        assert t.barrier_wait_ratio == 4.0    # file beats the default
+        assert t.weight_age_ratio == 10.0     # None override = default
+        assert t.scrape_stale_s == 10.0
+
+    def test_resolve_rejects_unknown_keys(self, tmp_path):
+        p = tmp_path / "thresholds.json"
+        p.write_text(json.dumps({"push_eror_rate": 0.5}))  # typo
+        with pytest.raises(ValueError, match="push_eror_rate"):
+            AlertThresholds.resolve(str(p))
+        with pytest.raises(ValueError, match="nope"):
+            AlertThresholds.resolve(None, nope=1.0)
+        p.write_text("[1, 2]")
+        with pytest.raises(ValueError, match="JSON object"):
+            AlertThresholds.resolve(str(p))
+
+    def test_resolve_rejects_non_numeric_values(self, tmp_path):
+        """A wrongly-typed value (over-quoted JSON) must fail at startup
+        with the key named, not crash alert evaluation mid-cycle."""
+        p = tmp_path / "thresholds.json"
+        p.write_text(json.dumps({"push_error_rate": "0.25"}))
+        with pytest.raises(ValueError, match="push_error_rate.*number"):
+            AlertThresholds.resolve(str(p))
+        p.write_text(json.dumps({"scrape_stale_s": True}))
+        with pytest.raises(ValueError, match="scrape_stale_s"):
+            AlertThresholds.resolve(str(p))
+        # integral floats coerce cleanly; barrier_min_count stays an int
+        t = AlertThresholds.resolve(None, barrier_min_count=4.0,
+                                    push_error_rate=1)
+        assert t.barrier_min_count == 4
+        assert t.push_error_rate == 1.0
+        # ...but a fractional count must fail loudly, never truncate to
+        # an effective value the operator never wrote
+        with pytest.raises(ValueError, match="barrier_min_count.*integer"):
+            AlertThresholds.resolve(None, barrier_min_count=8.7)
+
+    def test_labels_reflect_effective_values(self):
+        src = MetricsRegistry()
+        ops = src.counter("distlr_ps_client_ops_total", "", ("op", "status"))
+        ops.labels(op="push", status="ok").inc(60)
+        ops.labels(op="push", status="error").inc(40)
+        reg, _ = merge_snapshots({("w", 0): src.snapshot()})
+        alerts = evaluate_alerts(
+            reg, thresholds=AlertThresholds(push_error_rate=0.25,
+                                            barrier_wait_ratio=4.0),
+            rank_ages={})
+        text = reg.prometheus_text()
+        assert 'distlr_alert_ps_push_errors{threshold="0.25"} 1' in text
+        assert ('distlr_alert_barrier_wait_stall'
+                '{threshold="4x_step_p50"}') in text
+        push = next(a for a in alerts
+                    if a["name"] == "distlr_alert_ps_push_errors")
+        assert push["firing"] and push["threshold"] == 0.25
+
+    def test_obs_agg_cli_flags_and_file(self, tmp_path):
+        """End to end through the CLI: `launch obs-agg --once` over a
+        banked snapshot, with a thresholds file AND a flag override —
+        the scrape's threshold labels carry the effective values."""
+        from distlr_tpu.obs import write_metrics_snapshot
+
+        run = tmp_path / "run"
+        src = MetricsRegistry()
+        ops = src.counter("distlr_ps_client_ops_total", "", ("op", "status"))
+        ops.labels(op="push", status="ok").inc(60)
+        ops.labels(op="push", status="error").inc(40)
+        write_metrics_snapshot(str(run / "snapshots" / "worker-0.json"), src)
+        tf = tmp_path / "thresholds.json"
+        tf.write_text(json.dumps({"barrier_wait_ratio": 4.0,
+                                  "push_error_rate": 0.9}))
+        r = subprocess.run(
+            [sys.executable, "-m", "distlr_tpu.launch", "obs-agg",
+             "--obs-run-dir", str(run), "--once",
+             "--thresholds-file", str(tf),
+             "--alert-push-error-rate", "0.25",   # flag beats the file
+             "--stale-after", "3"],
+            capture_output=True, text=True, cwd=REPO, timeout=120)
+        assert r.returncode == 0, r.stderr[-2000:]
+        text = r.stdout
+        assert 'distlr_alert_ps_push_errors{threshold="0.25"} 1' in text
+        assert ('distlr_alert_barrier_wait_stall'
+                '{threshold="4x_step_p50"}') in text
+        # scrape_stale_s rode --stale-after into the per-rank alert label
+        assert 'threshold="3s"' in text
+
+    def test_obs_agg_rejects_bad_thresholds_file(self, tmp_path):
+        tf = tmp_path / "bad.json"
+        tf.write_text(json.dumps({"not_a_threshold": 1}))
+        r = subprocess.run(
+            [sys.executable, "-m", "distlr_tpu.launch", "obs-agg",
+             "--obs-run-dir", str(tmp_path), "--once",
+             "--thresholds-file", str(tf)],
+            capture_output=True, text=True, cwd=REPO, timeout=120)
+        assert r.returncode == 2
+        assert "not_a_threshold" in r.stderr

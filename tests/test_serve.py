@@ -478,7 +478,10 @@ class TestStatsSchemaRegression:
     """Satellite (ISSUE 2): STATS now answers from the obs registry
     histogram instead of a hand-rolled percentile deque — the reply
     schema must stay byte-compatible (keys, types, rounding) so existing
-    scrapers keep parsing."""
+    scrapers keep parsing.  ISSUE 4 extends it ADDITIVELY with the
+    routing-tier fields (shed / retries / replica_count) so one parser
+    covers a single engine and a router; every pre-existing field is
+    unchanged."""
 
     def _server(self):
         cfg = Config(num_feature_dim=8, model="binary_lr", l2_c=0.0)
@@ -493,10 +496,17 @@ class TestStatsSchemaRegression:
             score_lines_over_tcp(srv.host, srv.port, ['{"rows": []}'])  # ERR
             (raw,) = score_lines_over_tcp(srv.host, srv.port, ["STATS"])
         stats = json.loads(raw)
-        # exact top-level key set of the pre-registry accumulator
+        # exact top-level key set: the pre-registry accumulator's keys
+        # plus the ISSUE-4 routing-tier additions, nothing else
         assert set(stats) == {"requests", "errors", "qps", "p50_ms",
-                              "p99_ms", "batcher", "engine"}
+                              "p99_ms", "shed", "retries", "replica_count",
+                              "batcher", "engine"}
         assert isinstance(stats["requests"], int) and stats["requests"] >= 5
+        # a single engine behind no router never sheds or retries and IS
+        # its own one-replica tier (the router reports live values here)
+        assert stats["shed"] == 0 and isinstance(stats["shed"], int)
+        assert stats["retries"] == 0 and isinstance(stats["retries"], int)
+        assert stats["replica_count"] == 1
         assert isinstance(stats["errors"], int) and stats["errors"] == 1
         assert isinstance(stats["qps"], (int, float)) and stats["qps"] > 0
         for k in ("p50_ms", "p99_ms"):

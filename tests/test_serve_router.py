@@ -1,0 +1,820 @@
+"""Serving scale-out tests (ISSUE 4): the routing front-end + hot-row
+keyed reload.
+
+Covers the tentpole acceptance surface: load balancing and protocol
+parity through the router, admission control (explicit ``ERR SHED`` +
+counter consistency), the failover e2e — two REAL engine replicas over
+TCP, one killed mid-load with zero failed accepted requests and the
+ejected -> reinstated lifecycle visible in one fleet scrape via an
+``--obs-run-dir`` — plus the hot-set tracker, the keyed hot-slice reload
+(bytes-pulled < 10% of a full refresh at D=1M with identical served
+scores), and the jittered reload polling regression.
+
+All tests are CPU-only (tier-1: they run under ``-m 'not slow'``).
+"""
+
+import json
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from distlr_tpu.config import Config
+from distlr_tpu.obs.registry import get_registry
+from distlr_tpu.serve import (
+    HotReloader,
+    HotSetTracker,
+    LivePSWatcher,
+    ScoringEngine,
+    ScoringRouter,
+    ScoringServer,
+)
+from distlr_tpu.serve.server import score_lines_over_tcp
+
+
+def _sigmoid(z):
+    return 1.0 / (1.0 + np.exp(-np.asarray(z, np.float64)))
+
+
+def _mk_replica(port: int = 0) -> ScoringServer:
+    cfg = Config(num_feature_dim=8, model="binary_lr", l2_c=0.0)
+    eng = ScoringEngine(cfg, max_batch_size=64)
+    eng.set_weights(np.linspace(-1.0, 1.0, 8).astype(np.float32))
+    return ScoringServer(eng, port=port, max_wait_ms=0.5).start()
+
+
+def _wait_for(predicate, timeout_s: float = 30.0, what: str = "condition"):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if predicate():
+            return
+        time.sleep(0.02)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+class TestHotSetTracker:
+    def test_observe_publish_sorted(self):
+        t = HotSetTracker(16)
+        t.observe(np.array([9, 3, 3, 7], np.uint64))
+        keys = t.hot_keys()
+        assert keys.dtype == np.uint64
+        assert keys.tolist() == [3, 7, 9]
+
+    def test_capacity_keeps_top_counts(self):
+        t = HotSetTracker(3)
+        t.observe(np.array([1] * 5 + [2] * 4 + [3] * 3 + [4] * 2 + [5],
+                           np.uint64))
+        assert set(t.hot_keys().tolist()) == {1, 2, 3}
+        assert t.evictions >= 2
+
+    def test_decay_evicts_cold_keys(self):
+        t = HotSetTracker(100, decay=0.5, decay_every=10, min_count=0.9)
+        t.observe(np.array([1] * 9 + [2], np.uint64))  # triggers the decay
+        assert t.decays == 1
+        # key 1: 9 * 0.5 = 4.5 survives; key 2: 1 * 0.5 = 0.5 < 0.9 evicted
+        assert t.hot_keys().tolist() == [1]
+
+    def test_coverage_window(self):
+        t = HotSetTracker(10)
+        assert t.coverage() == 1.0          # no traffic: no drift evidence
+        t.observe(np.array([1, 2, 3], np.uint64))
+        assert t.coverage() == 0.0          # published snapshot still empty
+        t.hot_keys()                        # publish {1, 2, 3}
+        t.observe(np.array([1, 2], np.uint64))
+        assert t.coverage() == 1.0
+        t.observe(np.array([9, 9], np.uint64))
+        assert t.coverage() == pytest.approx(0.5)
+        t.hot_keys()                        # window resets
+        assert t.coverage() == 1.0
+
+    def test_empty_observe_and_empty_set(self):
+        t = HotSetTracker(4)
+        t.observe(np.array([], np.uint64))
+        assert t.hot_keys().size == 0
+        assert t.stats()["keys"] == 0
+
+    def test_validation(self):
+        with pytest.raises(ValueError, match="capacity"):
+            HotSetTracker(0)
+        with pytest.raises(ValueError, match="decay"):
+            HotSetTracker(4, decay=0.0)
+        with pytest.raises(ValueError, match="decay_every"):
+            HotSetTracker(4, decay_every=0)
+
+
+class TestRouterBasics:
+    def test_balances_and_protocol_parity(self):
+        a, b = _mk_replica(), _mk_replica()
+        router = ScoringRouter(
+            [f"{a.host}:{a.port}", f"{b.host}:{b.port}"],
+            max_inflight=4, health_interval_s=5.0,
+        ).start()
+        try:
+            w = np.linspace(-1.0, 1.0, 8)
+            replies = score_lines_over_tcp(
+                router.host, router.port, ["1:1 3:1"] * 8)
+            assert all(not r.startswith("ERR") for r in replies)
+            scores = {float(r.split()[1]) for r in replies}
+            assert len(scores) == 1  # both replicas serve the same model
+            np.testing.assert_allclose(
+                scores.pop(), _sigmoid(w[0] + w[2]), atol=5e-3)
+            # JSON batch mode passes through untouched
+            (jrep,) = score_lines_over_tcp(
+                router.host, router.port, [json.dumps({"rows": ["1:1", "2:1"]})])
+            out = json.loads(jrep)
+            assert len(out["labels"]) == 2 and len(out["scores"]) == 2
+            # replica-level ERR (malformed input) is deterministic: it
+            # passes through, is NOT retried, and ejects nobody
+            (bad,) = score_lines_over_tcp(
+                router.host, router.port, ['{"rows": []}'])
+            assert bad.startswith("ERR") and "SHED" not in bad
+            st = router.stats()
+            assert st["errors"] == 0 and st["retries"] == 0
+            assert st["replicas_up"] == 2
+            # rotation spreads even strictly serial traffic
+            per_rep = [r["requests"] for r in st["replicas"]]
+            assert min(per_rep) >= 2, per_rep
+        finally:
+            router.stop()
+            a.stop()
+            b.stop()
+
+    def test_rejects_ipv6_and_malformed_addresses_at_construction(self):
+        for bad in ("[::1]:8101", "::1:8101", "127.0.0.1", "h:x"):
+            with pytest.raises(ValueError):
+                ScoringRouter([bad])
+
+    def test_stats_schema_shared_with_server(self):
+        """The router's STATS carries the front-end scalar schema (one
+        parser for both tiers) plus the per-replica list."""
+        a = _mk_replica()
+        router = ScoringRouter([f"{a.host}:{a.port}"], max_inflight=2,
+                               health_interval_s=5.0).start()
+        try:
+            score_lines_over_tcp(router.host, router.port, ["1:1"])
+            (raw,) = score_lines_over_tcp(router.host, router.port, ["STATS"])
+            st = json.loads(raw)
+            assert set(st) == {"requests", "errors", "qps", "p50_ms",
+                               "p99_ms", "shed", "retries", "replica_count",
+                               "replicas_up", "replicas"}
+            assert st["requests"] == 1 and st["replica_count"] == 1
+            assert set(st["replicas"][0]) == {
+                "addr", "healthy", "inflight", "requests", "errors",
+                "ejections", "reinstates"}
+        finally:
+            router.stop()
+            a.stop()
+
+    def test_admission_shed_explicit_and_counted(self):
+        """Saturating the per-replica in-flight budget sheds with an
+        explicit ERR SHED reply — never a silent hang — and every shed
+        reply is counted in distlr_route_shed_total."""
+        cfg = Config(num_feature_dim=8, model="binary_lr", l2_c=0.0)
+        eng = ScoringEngine(cfg, max_batch_size=64)
+        eng.set_weights(np.ones(8, np.float32))
+        orig_score = eng.score
+
+        def slow_score(rows):
+            time.sleep(0.25)
+            return orig_score(rows)
+
+        eng.score = slow_score  # bound before the server captures it
+        srv = ScoringServer(eng, max_wait_ms=0.1).start()
+        router = ScoringRouter([f"{srv.host}:{srv.port}"], max_inflight=1,
+                               retries=0, health_interval_s=30.0).start()
+        shed_family = get_registry().get("distlr_route_shed_total")
+        shed_child = shed_family.labels(
+            listener=f"{router.host}:{router.port}")
+        base = shed_child.value
+        try:
+            n = 6
+            replies: list[str] = []
+            lock = threading.Lock()
+            barrier = threading.Barrier(n)
+
+            def one_request():
+                barrier.wait()
+                (r,) = score_lines_over_tcp(router.host, router.port, ["1:1"])
+                with lock:
+                    replies.append(r)
+
+            threads = [threading.Thread(target=one_request) for _ in range(n)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=30)
+            assert len(replies) == n  # every request ANSWERED, none hung
+            shed = [r for r in replies if r.startswith("ERR SHED")]
+            ok = [r for r in replies if not r.startswith("ERR")]
+            assert len(shed) + len(ok) == n  # shed or served, nothing else
+            assert len(shed) >= 1
+            st = router.stats()
+            assert st["shed"] == len(shed)
+            assert st["requests"] == len(ok)
+            assert st["errors"] == 0
+            assert shed_child.value - base == len(shed)
+        finally:
+            router.stop()
+            srv.stop()
+
+
+class TestStalePooledConnection:
+    def test_replica_restart_between_bursts_not_ejected(self):
+        """A replica that restarted cleanly between traffic bursts
+        leaves stale sockets in the router's pool; the failure belongs
+        to the socket, not the replica — one fresh dial must recover it
+        without burning the consecutive-error budget."""
+        from distlr_tpu.serve.router import _Replica
+
+        srv = _mk_replica()
+        rep = _Replica(f"{srv.host}:{srv.port}", max_inflight=4,
+                       timeout_s=5.0)
+        assert not rep.exchange("1:1").startswith("ERR")
+        assert len(rep._idle) == 1           # connection went back to pool
+        port = srv.port
+        srv.abort()                          # crash, pool entry now stale
+        srv2 = _mk_replica(port=port)        # clean restart, same address
+        try:
+            reply = rep.exchange("1:1")      # pooled fails -> fresh dial
+            assert not reply.startswith("ERR")
+        finally:
+            rep.drain_pool()
+            srv2.stop()
+
+
+class TestNestedShed:
+    def test_child_shed_propagates_as_shed_not_outage(self):
+        """A child tier answering ERR SHED is overloaded, not dead: the
+        parent must propagate the shed (scale-up signal) without
+        ejecting the child or ticking the error counter."""
+        import socketserver as ss
+
+        class _ShedHandler(ss.StreamRequestHandler):
+            def handle(self):
+                for _ in self.rfile:
+                    self.wfile.write(
+                        b"ERR SHED: no replica with free capacity\n")
+                    self.wfile.flush()
+
+        class _Srv(ss.ThreadingTCPServer):
+            daemon_threads = True
+            allow_reuse_address = True
+
+        fake_child = _Srv(("127.0.0.1", 0), _ShedHandler)
+        threading.Thread(target=fake_child.serve_forever,
+                         daemon=True).start()
+        host, port = fake_child.server_address[:2]
+        router = ScoringRouter([f"{host}:{port}"], max_inflight=4,
+                               eject_after=1, health_interval_s=30.0,
+                               probe_backoff_s=5.0, probe_backoff_max_s=10.0,
+                               backend_timeout_s=5.0).start()
+        try:
+            (r1,) = score_lines_over_tcp(router.host, router.port, ["1:1"])
+            assert r1.startswith("ERR SHED")
+            st = router.stats()
+            assert st["shed"] == 1 and st["errors"] == 0
+            # overload is not death: no ejection from shed replies
+            assert st["replicas"][0]["healthy"]
+            assert st["replicas"][0]["ejections"] == 0
+        finally:
+            router.stop()
+            fake_child.shutdown()
+            fake_child.server_close()
+
+
+class TestRouterOutage:
+    def test_total_outage_is_error_not_shed(self):
+        """Zero healthy replicas is an OUTAGE: the reply and the counter
+        must say error (page someone), not shed (scale up)."""
+        # grab a port that nothing listens on
+        probe = socket.socket()
+        probe.bind(("127.0.0.1", 0))
+        dead_addr = "127.0.0.1:%d" % probe.getsockname()[1]
+        probe.close()
+        router = ScoringRouter([dead_addr], max_inflight=2, eject_after=1,
+                               health_interval_s=30.0, probe_backoff_s=5.0,
+                               probe_backoff_max_s=10.0,
+                               backend_timeout_s=2.0).start()
+        try:
+            # first request: accepted (replica still in rotation), fails
+            # on the dead address, ejects it -> ERR ROUTE + error count
+            (r1,) = score_lines_over_tcp(router.host, router.port, ["1:1"])
+            assert r1.startswith("ERR ROUTE")
+            # second request: nothing healthy at admission — still an
+            # outage error, NOT a shed
+            (r2,) = score_lines_over_tcp(router.host, router.port, ["1:1"])
+            assert r2.startswith("ERR ROUTE") and "no healthy replica" in r2
+            st = router.stats()
+            assert st["shed"] == 0
+            assert st["errors"] == 2
+            assert st["retries"] == 0  # nowhere to retry: not counted
+            assert st["replicas_up"] == 0
+        finally:
+            router.stop()
+
+    def test_stop_before_start_does_not_hang(self):
+        probe = socket.socket()
+        probe.bind(("127.0.0.1", 0))
+        addr = "127.0.0.1:%d" % probe.getsockname()[1]
+        probe.close()
+        router = ScoringRouter([addr])
+        t0 = time.monotonic()
+        router.stop()  # never started: must return, not deadlock
+        assert time.monotonic() - t0 < 5.0
+        srv = _mk_replica()
+        srv.stop()
+        cfg = Config(num_feature_dim=8, model="binary_lr", l2_c=0.0)
+        eng = ScoringEngine(cfg)
+        eng.set_weights(np.zeros(8, np.float32))
+        never_started = ScoringServer(eng)
+        t0 = time.monotonic()
+        never_started.stop()
+        assert time.monotonic() - t0 < 5.0
+
+
+class TestNestedRouter:
+    def test_dead_child_tier_fails_over_and_stays_ejected(self):
+        """A nested child router whose whole tier is down still answers
+        STATS and replies ERR ROUTE — the parent must treat both as
+        replica failure: retry the request on a sibling, eject the
+        subtree, and NOT reinstate it off a bare STATS round trip."""
+        probe = socket.socket()
+        probe.bind(("127.0.0.1", 0))
+        dead_addr = "127.0.0.1:%d" % probe.getsockname()[1]
+        probe.close()
+        child = ScoringRouter([dead_addr], eject_after=1,
+                              health_interval_s=30.0, probe_backoff_s=5.0,
+                              probe_backoff_max_s=10.0,
+                              backend_timeout_s=2.0).start()
+        srv = _mk_replica()
+        parent = ScoringRouter(
+            [f"{child.host}:{child.port}", f"{srv.host}:{srv.port}"],
+            max_inflight=8, eject_after=2, health_interval_s=0.2,
+            probe_backoff_s=0.1, probe_backoff_max_s=0.3,
+            backend_timeout_s=5.0,
+        ).start()
+        child_addr = f"{child.host}:{child.port}"
+        try:
+            replies = score_lines_over_tcp(parent.host, parent.port,
+                                           ["1:1 3:1"] * 10)
+            # every accepted request answered with a score — the dead
+            # subtree's ERR ROUTE replies were retried onto the engine
+            assert not [r for r in replies if r.startswith("ERR")], replies
+
+            def child_state():
+                return next(r for r in parent.stats()["replicas"]
+                            if r["addr"] == child_addr)
+            _wait_for(lambda: not child_state()["healthy"],
+                      what="child tier ejection")
+            # probes DO reach the child's STATS, but replicas_up == 0
+            # must keep it out of rotation (no reinstate flapping)
+            time.sleep(1.0)
+            assert not child_state()["healthy"]
+            assert child_state()["reinstates"] == 0
+        finally:
+            parent.stop()
+            child.stop()
+            srv.stop()
+
+
+class TestRouterFailover:
+    """The ISSUE-4 acceptance e2e: two real engine replicas behind the
+    router, one killed under live load — zero failed accepted requests,
+    shed-counter consistency, and the ejected -> reinstated lifecycle
+    visible in one fleet scrape via --obs-run-dir."""
+
+    def test_kill_one_replica_under_load(self, tmp_path):
+        from distlr_tpu.obs import FleetScraper, MetricsServer, write_endpoint
+
+        a, b = _mk_replica(), _mk_replica()
+        addr_b = f"{b.host}:{b.port}"
+        router = ScoringRouter(
+            [f"{a.host}:{a.port}", addr_b],
+            max_inflight=32, eject_after=2, health_interval_s=0.2,
+            probe_backoff_s=0.1, probe_backoff_max_s=0.5,
+            backend_timeout_s=10.0,
+        ).start()
+
+        def rep_b_state():
+            return next(r for r in router.stats()["replicas"]
+                        if r["addr"] == addr_b)
+
+        n_clients = 3
+        replies: list[list[str]] = [[] for _ in range(n_clients)]
+        client_errors: list[BaseException] = []
+        stop = threading.Event()
+
+        def client(i):
+            try:
+                with socket.create_connection(
+                        (router.host, router.port), timeout=30) as s:
+                    f = s.makefile("rwb")
+                    while not stop.is_set():
+                        f.write(b"1:1 3:1\n")
+                        f.flush()
+                        r = f.readline()
+                        if not r:
+                            raise ConnectionError("router closed mid-stream")
+                        replies[i].append(r.decode().strip())
+            except BaseException as e:  # surfaced below
+                client_errors.append(e)
+
+        threads = [threading.Thread(target=client, args=(i,), daemon=True)
+                   for i in range(n_clients)]
+        b2 = None
+        try:
+            for t in threads:
+                t.start()
+            _wait_for(lambda: sum(len(r) for r in replies) > 50,
+                      what="load ramp")
+            # KILL replica b mid-load: sever the listener and every
+            # active connection, exactly like a SIGKILL
+            b.abort()
+            _wait_for(lambda: not rep_b_state()["healthy"],
+                      what="replica b ejection")
+            # load continues against the survivor while b is down
+            n_at_eject = sum(len(r) for r in replies)
+            _wait_for(lambda: sum(len(r) for r in replies) > n_at_eject + 30,
+                      what="post-ejection load")
+            # respawn a replica on the SAME address; backoff probes
+            # reinstate it without a router restart
+            b2 = _mk_replica(port=b.port)
+            _wait_for(lambda: rep_b_state()["healthy"],
+                      what="replica b reinstatement")
+            n_at_reinstate = sum(len(r) for r in replies)
+            _wait_for(
+                lambda: sum(len(r) for r in replies) > n_at_reinstate + 30,
+                what="post-reinstatement load")
+        finally:
+            stop.set()
+            for t in threads:
+                t.join(timeout=30)
+        try:
+            assert not client_errors, client_errors
+            flat = [r for per in replies for r in per]
+            assert flat
+            # 100% of ACCEPTED requests answered with a score: the kill
+            # surfaced as transparent retries, never as a failed reply
+            failed = [r for r in flat if r.startswith("ERR")]
+            assert failed == [], failed[:5]
+            st = router.stats()
+            assert st["shed"] == 0 and st["errors"] == 0
+            assert st["retries"] >= 1  # in-flight victims were retried
+            rb = rep_b_state()
+            assert rb["ejections"] >= 1 and rb["reinstates"] >= 1
+            assert st["replicas_up"] == 2
+
+            # ...and the whole lifecycle is visible in ONE fleet scrape:
+            # publish this process's registry as the route rank of a run
+            # dir and federate it, the way `launch route --obs-run-dir`
+            # + `launch obs-agg` do across processes.
+            run = str(tmp_path)
+            msrv = MetricsServer(registry=get_registry(), port=0).start()
+            try:
+                write_endpoint(run, "route", 0, msrv.host, msrv.port)
+                fs = FleetScraper(run, interval_s=0.2)
+                fs.scrape_once()
+                text = fs.prometheus_text()
+            finally:
+                msrv.stop()
+            assert f'distlr_route_ejections_total{{replica="{addr_b}"}}' \
+                in text
+            assert f'distlr_route_reinstates_total{{replica="{addr_b}"}}' \
+                in text
+            assert ('distlr_route_replica_up{role="route",rank="0",'
+                    f'replica="{addr_b}"}} 1') in text
+            assert "distlr_route_shed_total" in text
+            assert "distlr_route_request_seconds_bucket" in text
+            fleet = fs.fleet_json()
+            route_rows = [r for r in fleet["ranks"] if r["role"] == "route"]
+            # the registry is process-wide, so other tests' routers also
+            # contribute children — assert presence and a sane floor,
+            # not exact equality
+            assert route_rows
+            assert route_rows[0]["replicas_up"] >= 2
+            assert route_rows[0]["route_requests"] >= len(flat)
+            assert "route_shed" in route_rows[0]
+        finally:
+            router.stop()
+            a.stop()
+            if b2 is not None:
+                b2.stop()
+
+
+@pytest.fixture()
+def ps_group_1m():
+    from distlr_tpu.ps import KVWorker, ServerGroup
+
+    dim = 1_000_000
+    with ServerGroup(2, 1, dim=dim, sync=False) as sg, \
+            KVWorker(sg.hosts, dim, client_id=7) as kv:
+        yield sg, kv, dim
+
+
+def _pull_bytes() -> float:
+    fam = get_registry().get("distlr_ps_client_bytes_total")
+    if fam is None:
+        return 0.0
+    return sum(child.value for values, child in fam.children()
+               if values[0] == "pull")
+
+
+class TestHotRowReload:
+    def test_bytes_and_identical_scores_at_1m(self, ps_group_1m):
+        """ISSUE-4 acceptance: D=1M, concentrated key distribution —
+        a hot-set refresh moves < 10% of a full refresh's bytes-pulled
+        counter, and the served scores are identical to a full-table
+        engine's."""
+        sg, kv, dim = ps_group_1m
+        rng = np.random.default_rng(21)
+        w0 = (rng.standard_normal(dim) * 0.5).astype(np.float32)
+        kv.wait(kv.push_init(w0))
+
+        cfg = Config(num_feature_dim=dim, model="sparse_lr", l2_c=0.0)
+        eng = ScoringEngine(cfg, max_batch_size=128)
+        tracker = HotSetTracker(1024)
+        watcher = LivePSWatcher(sg.hosts, dim, hot_tracker=tracker,
+                                min_coverage=0.9, full_refresh_every=0)
+        try:
+            # the concentrated working set: every request draws from
+            # these 200 keys out of 1M
+            pool = np.sort(rng.choice(dim, size=200, replace=False))
+            lines = []
+            for _ in range(40):
+                cols = np.sort(rng.choice(pool, size=5, replace=False))
+                lines.append(" ".join(f"{c + 1}:1" for c in cols))
+
+            v, w = watcher.poll()          # first poll: full (no table)
+            eng.set_weights(w)
+            with ScoringServer(eng, max_wait_ms=0.5,
+                               hot_tracker=tracker) as srv:
+                replies0 = score_lines_over_tcp(srv.host, srv.port, lines)
+                # traffic arrived after the first publish: coverage is
+                # low, so the next poll falls back to a FULL refresh and
+                # publishes the now-populated hot set
+                t0 = _pull_bytes()
+                _, w = watcher.poll()
+                bytes_full = _pull_bytes() - t0
+                assert watcher.last_kind == "full"
+                eng.set_weights(w)
+                replies1 = score_lines_over_tcp(srv.host, srv.port, lines)
+                assert replies1 == replies0  # weights unchanged so far
+
+                # the trainer moves the table; the hot slice tracks it
+                w1 = (rng.standard_normal(dim) * 0.5).astype(np.float32)
+                kv.wait(kv.push_init(w1, force=True))
+                t0 = _pull_bytes()
+                _, w = watcher.poll()
+                bytes_hot = _pull_bytes() - t0
+                assert watcher.last_kind == "hot"
+                assert watcher.last_rows <= 1024
+                assert bytes_full > 0 and bytes_hot > 0
+                # the headline acceptance number
+                assert bytes_hot < 0.10 * bytes_full, (bytes_hot, bytes_full)
+                eng.set_weights(w)
+                replies2 = score_lines_over_tcp(srv.host, srv.port, lines)
+
+            # identical scores: a second engine loaded with the FULL new
+            # table scores the same requests; the hot-reloaded engine
+            # must agree bit-for-bit (requests only touch hot rows)
+            eng_full = ScoringEngine(cfg, max_batch_size=128)
+            eng_full.set_weights(kv.pull_chunked())
+            labels, scores = eng_full.score(eng_full.encode_lines(lines))
+            expect = [f"{int(l)} {float(s):.6g}"
+                      for l, s in zip(labels, scores)]
+            assert replies2 == expect
+            assert watcher.stats()["full_reloads"] == 2
+            assert watcher.stats()["hot_reloads"] == 1
+            assert watcher.stats()["hot_set"]["keys"] <= 1024
+        finally:
+            watcher.close()
+
+    def test_coverage_fallback_forces_full(self):
+        from distlr_tpu.ps import KVWorker, ServerGroup
+
+        with ServerGroup(1, 1, dim=64, sync=False) as sg, \
+                KVWorker(sg.hosts, 64, client_id=8) as kv:
+            kv.wait(kv.push_init(np.arange(64, dtype=np.float32)))
+            tracker = HotSetTracker(32)
+            watcher = LivePSWatcher(sg.hosts, 64, hot_tracker=tracker,
+                                    min_coverage=0.95, full_refresh_every=0)
+            try:
+                kinds = []
+                watcher.poll()                       # table bootstrap
+                kinds.append(watcher.last_kind)
+                tracker.observe(np.array([5, 6, 7], np.uint64))
+                watcher.poll()                       # coverage 0 -> full
+                kinds.append(watcher.last_kind)
+                tracker.observe(np.array([5, 6], np.uint64))
+                watcher.poll()                       # covered -> hot
+                kinds.append(watcher.last_kind)
+                # the distribution shifts: mostly-new keys, coverage dives
+                tracker.observe(np.array([50] * 10 + [5], np.uint64))
+                watcher.poll()
+                kinds.append(watcher.last_kind)
+                assert kinds == ["full", "full", "hot", "full"]
+            finally:
+                watcher.close()
+
+    def test_poll_result_never_aliases_cached_table(self):
+        """The engine device_puts what poll() returns, and device_put of
+        an aligned float32 array can be zero-copy — later in-place hot
+        patches must not reach weights already handed out."""
+        from distlr_tpu.ps import KVWorker, ServerGroup
+
+        with ServerGroup(1, 1, dim=16, sync=False) as sg, \
+                KVWorker(sg.hosts, 16, client_id=13) as kv:
+            kv.wait(kv.push_init(np.zeros(16, np.float32)))
+            tracker = HotSetTracker(8)
+            watcher = LivePSWatcher(sg.hosts, 16, hot_tracker=tracker,
+                                    min_coverage=0.5, full_refresh_every=0)
+            try:
+                watcher.poll()
+                tracker.observe(np.array([3], np.uint64))
+                _, w1 = watcher.poll()
+                assert not np.shares_memory(w1, watcher._table)
+                before = w1.copy()
+                kv.wait(kv.push_init(np.full(16, 9.0, np.float32),
+                                     force=True))
+                tracker.observe(np.array([3], np.uint64))
+                watcher.poll()  # patches the cached table in place
+                np.testing.assert_array_equal(w1, before)
+            finally:
+                watcher.close()
+
+    def test_idle_hot_poll_is_noop(self):
+        """An idle replica (empty hot set, table already published) must
+        not report a new version every poll — that would re-upload an
+        identical D-dim table to the device once per interval."""
+        from distlr_tpu.ps import KVWorker, ServerGroup
+
+        with ServerGroup(1, 1, dim=16, sync=False) as sg, \
+                KVWorker(sg.hosts, 16, client_id=12) as kv:
+            kv.wait(kv.push_init(np.ones(16, np.float32)))
+            watcher = LivePSWatcher(sg.hosts, 16,
+                                    hot_tracker=HotSetTracker(4))
+            try:
+                assert watcher.poll() is not None   # bootstrap full pull
+                assert watcher.poll() is None       # no traffic: no-op
+                assert watcher.poll() is None
+                assert watcher.hot_reloads == 0
+            finally:
+                watcher.close()
+
+    def test_periodic_full_refresh_bounds_staleness(self):
+        from distlr_tpu.ps import KVWorker, ServerGroup
+
+        with ServerGroup(1, 1, dim=32, sync=False) as sg, \
+                KVWorker(sg.hosts, 32, client_id=9) as kv:
+            kv.wait(kv.push_init(np.zeros(32, np.float32)))
+            tracker = HotSetTracker(8)
+            watcher = LivePSWatcher(sg.hosts, 32, hot_tracker=tracker,
+                                    min_coverage=0.5, full_refresh_every=2)
+            try:
+                watcher.poll()                              # full (bootstrap)
+                tracker.observe(np.array([1, 2], np.uint64))
+                watcher.poll()                              # full (coverage)
+                kinds = []
+                for _ in range(5):
+                    tracker.observe(np.array([1, 2], np.uint64))
+                    watcher.poll()
+                    kinds.append(watcher.last_kind)
+                # every 3rd poll goes full even though coverage stays 1.0
+                assert kinds == ["hot", "hot", "full", "hot", "hot"]
+            finally:
+                watcher.close()
+
+    def test_pull_rows_into_scatters_in_place(self):
+        from distlr_tpu.ps import KVWorker, ServerGroup
+
+        with ServerGroup(2, 1, dim=48, sync=False) as sg, \
+                KVWorker(sg.hosts, 48, client_id=10) as kv:
+            init = np.linspace(-2, 2, 48).astype(np.float32)
+            kv.wait(kv.push_init(init))
+            assert kv.supports_vals_per_key(4)
+            table = np.zeros(48, np.float32)
+            rows = np.array([1, 5, 9], np.uint64)
+            n = kv.pull_rows_into(table, rows, vals_per_key=4, chunk_rows=2)
+            assert n == 3
+            t = table.reshape(12, 4)
+            for r in (1, 5, 9):
+                np.testing.assert_allclose(
+                    t[r], init.reshape(12, 4)[r])
+            untouched = [r for r in range(12) if r not in (1, 5, 9)]
+            assert np.all(t[untouched] == 0.0)
+            # empty key set is a no-op, not a crash
+            assert kv.pull_rows_into(table, np.array([], np.uint64)) == 0
+            with pytest.raises(ValueError, match="C-contiguous float32"):
+                kv.pull_rows_into(np.zeros(5, np.float32), rows)
+
+    def test_serve_row_width_matches_row_keys_space(self):
+        """The launcher's PS row width must match the key space
+        ScoringEngine.row_keys feeds the tracker — DENSE softmax also
+        owns num_classes flat slots per feature key (ps_param_dim
+        flattens the (D, K) matrix row-major)."""
+        from distlr_tpu.launch import _serve_row_width
+
+        assert _serve_row_width(Config(model="binary_lr")) == 1
+        assert _serve_row_width(Config(model="sparse_lr")) == 1
+        assert _serve_row_width(
+            Config(model="softmax", num_classes=4)) == 4
+        assert _serve_row_width(
+            Config(model="sparse_softmax", num_classes=3)) == 3
+        assert _serve_row_width(
+            Config(model="blocked_lr", block_size=8)) == 8
+
+    def test_dense_softmax_hot_reload_patches_class_rows(self):
+        """Dense softmax over the PS: feature key j owns flat slots
+        [j*K, (j+1)*K) — a hot refresh of feature rows must patch whole
+        K-wide rows, not K unrelated flat slots."""
+        from distlr_tpu.ps import KVWorker, ServerGroup
+
+        with ServerGroup(1, 1, dim=36, sync=False) as sg, \
+                KVWorker(sg.hosts, 36, client_id=14) as kv:
+            init = np.arange(36, dtype=np.float32)
+            kv.wait(kv.push_init(init))
+            tracker = HotSetTracker(8)
+            watcher = LivePSWatcher(sg.hosts, 36, vals_per_key=3,
+                                    hot_tracker=tracker, min_coverage=0.5,
+                                    full_refresh_every=0)
+            try:
+                assert watcher.row_width == 3
+                watcher.poll()                              # bootstrap
+                tracker.observe(np.array([2, 7], np.uint64))
+                watcher.poll()                              # coverage full
+                kv.wait(kv.push_init(init + 100.0, force=True))
+                tracker.observe(np.array([2, 7], np.uint64))
+                _, w = watcher.poll()
+                assert watcher.last_kind == "hot"
+                t = np.asarray(w).reshape(12, 3)
+                np.testing.assert_allclose(t[2], init.reshape(12, 3)[2] + 100)
+                np.testing.assert_allclose(t[7], init.reshape(12, 3)[7] + 100)
+                np.testing.assert_allclose(t[3], init.reshape(12, 3)[3])
+            finally:
+                watcher.close()
+
+    def test_vpk_fallback_expands_row_keys(self):
+        """A server group whose range boundaries straddle R-lane rows
+        falls back to flat keys; hot row ids must expand to their R flat
+        slots so the patched table stays row-aligned."""
+        from distlr_tpu.ps import KVWorker, ServerGroup
+
+        with ServerGroup(3, 1, dim=50, sync=False) as sg, \
+                KVWorker(sg.hosts, 50, client_id=11) as kv:
+            assert not kv.supports_vals_per_key(5)
+            init = np.arange(50, dtype=np.float32)
+            kv.wait(kv.push_init(init))
+            tracker = HotSetTracker(8)
+            watcher = LivePSWatcher(sg.hosts, 50, vals_per_key=5,
+                                    hot_tracker=tracker, min_coverage=0.5,
+                                    full_refresh_every=0)
+            try:
+                assert watcher.vals_per_key == 1 and watcher.row_width == 5
+                watcher.poll()                              # bootstrap
+                tracker.observe(np.array([2, 7], np.uint64))
+                watcher.poll()                              # coverage full
+                # move the whole table; only rows 2 and 7 may refresh
+                kv.wait(kv.push_init(init + 100.0, force=True))
+                tracker.observe(np.array([2, 7], np.uint64))
+                _, w = watcher.poll()
+                assert watcher.last_kind == "hot"
+                t = np.asarray(w).reshape(10, 5)
+                np.testing.assert_allclose(t[2], init.reshape(10, 5)[2] + 100)
+                np.testing.assert_allclose(t[7], init.reshape(10, 5)[7] + 100)
+                np.testing.assert_allclose(t[3], init.reshape(10, 5)[3])
+            finally:
+                watcher.close()
+
+
+class TestReloadJitter:
+    """Satellite (ISSUE 4 bugfix): fixed-interval polling puts N
+    replicas started together in lockstep against the PS forever —
+    waits are now jittered per reloader."""
+
+    def test_jitter_bounds_and_variation(self):
+        r = HotReloader(None, None, interval_s=0.1)
+        waits = [r._next_wait() for _ in range(200)]
+        assert all(0.1 * 0.8 <= w <= 0.1 * 1.2 for w in waits)
+        assert len(set(waits)) > 10  # actually random, not a fixed offset
+
+    def test_two_reloaders_desynchronize(self):
+        r1 = HotReloader(None, None, interval_s=0.1)
+        r2 = HotReloader(None, None, interval_s=0.1)
+        s1 = [r1._next_wait() for _ in range(20)]
+        s2 = [r2._next_wait() for _ in range(20)]
+        # independently-seeded RNGs: two replicas launched in the same
+        # millisecond draw different wait sequences and drift apart
+        assert s1 != s2
+        assert abs(sum(s1) - sum(s2)) > 0.0
+
+    def test_jitter_zero_restores_fixed_cadence(self):
+        r = HotReloader(None, None, interval_s=0.5, jitter=0.0)
+        assert {r._next_wait() for _ in range(5)} == {0.5}
+
+    def test_jitter_validation(self):
+        with pytest.raises(ValueError, match="jitter"):
+            HotReloader(None, None, interval_s=1.0, jitter=1.0)
+        with pytest.raises(ValueError, match="jitter"):
+            HotReloader(None, None, interval_s=1.0, jitter=-0.1)
