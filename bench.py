@@ -37,6 +37,31 @@ from distlr_tpu.obs.tracing import get_tracer, trace_phase
 from distlr_tpu.utils.backend import force_cpu, probe_default_backend_ex
 
 
+def resilience_snapshot() -> dict:
+    """Fault-cost counters of THIS process's registry at read time:
+    in-place KV retries/reconnects, unknown-outcome pushes, and injected
+    chaos faults.  Every bench row carries one (ISSUE 5), so any capture
+    that ran under network faults — or silently fought a flaky link —
+    banks what the faults cost next to what the run scored; all-zero is
+    the healthy-network signature."""
+    from distlr_tpu.obs.registry import get_registry  # noqa: PLC0415
+
+    reg = get_registry()
+
+    def total(name: str) -> int:
+        fam = reg.get(name)
+        if fam is None:
+            return 0
+        return int(sum(child.value for _v, child in fam.children()))
+
+    return {
+        "retries": total("distlr_ps_retries_total"),
+        "reconnects": total("distlr_ps_reconnects_total"),
+        "push_outcome_unknown": total("distlr_ps_push_outcome_unknown_total"),
+        "chaos_faults": total("distlr_chaos_faults_total"),
+    }
+
+
 def _median_rate(state0, advance, samples_per_window: float,
                  windows: int = 3) -> float:
     """Median rate of ``windows`` timed applications of
@@ -590,6 +615,10 @@ def main():
         # where the headline measurement's time went (tracer span sums
         # vs the headline wall clock — see obs/tracing.py)
         "phase_breakdown": phase_breakdown,
+        # fault-cost counters (retries/reconnects/unknown pushes/chaos
+        # faults): all-zero = healthy network; non-zero explains a slow
+        # row without re-running it
+        "resilience": resilience_snapshot(),
         **subs,
     }
     if smoke:

@@ -38,6 +38,14 @@ from distlr_tpu.obs.tracing import get_tracer, trace_phase  # noqa: E402
 from distlr_tpu.utils.backend import force_cpu, probe_default_backend_ex  # noqa: E402
 
 
+def _resilience() -> dict:
+    """Fault-cost counter snapshot (see bench.resilience_snapshot): a
+    serve bench that fought a flaky PS link records what it cost."""
+    from bench import resilience_snapshot  # noqa: PLC0415
+
+    return resilience_snapshot()
+
+
 def _make_lines(n: int, d: int, nnz: int, seed: int = 0) -> list[str]:
     import numpy as np
 
@@ -303,6 +311,7 @@ def main() -> int:
         # e2e_clients window), so the sums explain structure, not a
         # disjoint partition of wall clock.
         "phase_breakdown": {"phases": phases},
+        "resilience": _resilience(),
         **subs,
     }
     print(json.dumps(row))

@@ -1,0 +1,20 @@
+"""Network chaos layer: deterministic, seedable TCP fault injection for
+the PS stack (delay/jitter, bandwidth throttling, connection resets at
+op/byte offsets, timed full and partial partitions) — the proof harness
+for the client's in-place retry/reconnect resilience.
+
+See :mod:`distlr_tpu.chaos.plan` for the JSON plan format and
+:mod:`distlr_tpu.chaos.proxy` for the proxy semantics; ``launch chaos``
+wraps an existing server group, ``ServerGroup(via_chaos=...)`` wraps a
+locally-spawned one.
+"""
+
+from distlr_tpu.chaos.plan import (  # noqa: F401
+    FAULT_KINDS,
+    FaultPlan,
+    FaultPlanError,
+    FaultSpec,
+    load_plan,
+    parse_plan,
+)
+from distlr_tpu.chaos.proxy import ChaosFabric, ChaosLink  # noqa: F401

@@ -1,0 +1,553 @@
+"""Deterministic TCP fault-injection proxy for the native KV protocol.
+
+One :class:`ChaosLink` is a listening socket in front of ONE upstream
+server rank; a :class:`ChaosFabric` is the set of links fronting a
+whole server group, exposing a drop-in ``hosts`` string — point any
+:class:`~distlr_tpu.ps.KVWorker` / ``LivePSWatcher`` at it and every
+byte of KV traffic flows through the fault plan
+(:mod:`distlr_tpu.chaos.plan`).  The faults this injects are exactly
+the ones a SIGKILL-based harness cannot: packet delay and jitter, slow
+links, connection resets mid-op, and full/partial partitions.
+
+Mechanics per link:
+
+* the client->server stream is FRAMED — the proxy parses each
+  ``MsgHeader`` (kv_protocol.h: 24 bytes, then ``num_keys`` u64 keys,
+  then vals for push-class ops) so fault offsets are stated in OPS, the
+  unit retry semantics care about; the server->client stream is relayed
+  raw (responses are only ever delayed/stalled/severed, never reframed);
+* ``delay`` sleeps each request frame ``delay_ms ± jitter_ms``, the
+  jitter drawn as a pure hash of ``(seed, link, fault, op_index)`` —
+  thread interleaving cannot perturb the timeline;
+* ``throttle`` paces both directions to ``bytes_per_sec``;
+* ``reset`` with ``after_ops=N`` delivers frame N upstream, then severs
+  the connection BEFORE its response can relay (the
+  push-outcome-unknown case the client's RetryPolicy must not retry);
+  with ``after_bytes=M`` it hard-kills (RST, queued data discarded)
+  once M cumulative client bytes have been forwarded — a mid-frame cut
+  the server drops without applying;
+* ``partition`` stalls established connections (bytes neither lost nor
+  forwarded — TCP semantics of a real partition) and refuses new ones
+  for the window's duration.
+
+Every injected fault is counted in ``distlr_chaos_*`` metrics (so a
+fleet scrape shows what was inflicted next to what it cost) and
+recorded in a wall-clock-free event log: offsets, plan windows, and
+hash-derived delays only, so two runs of the same seed + plan + client
+op sequence produce byte-identical logs (:meth:`ChaosFabric.events`).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import socket
+import struct
+import threading
+import time
+
+from distlr_tpu.chaos.plan import FaultPlan, FaultSpec
+from distlr_tpu.obs.registry import get_registry
+from distlr_tpu.utils.logging import get_logger
+
+log = get_logger(__name__)
+
+_reg = get_registry()
+_FAULTS = _reg.counter(
+    "distlr_chaos_faults_total",
+    "network faults injected by the chaos proxy, by kind "
+    "(delay per delayed frame, reset per severed connection, partition "
+    "per window activation, partition_refused per refused connect, "
+    "throttle per paced window activation)",
+    labelnames=("kind", "link"),
+)
+_OPS = _reg.counter(
+    "distlr_chaos_ops_forwarded_total",
+    "client->server KV frames forwarded through the chaos proxy",
+    labelnames=("link",),
+)
+_BYTES = _reg.counter(
+    "distlr_chaos_bytes_total",
+    "bytes relayed through the chaos proxy",
+    labelnames=("link", "direction"),
+)
+_DELAY_MS = _reg.counter(
+    "distlr_chaos_delay_ms_total",
+    "injected request-frame delay, milliseconds",
+    labelnames=("link",),
+)
+
+#: MsgHeader wire layout (kv_protocol.h): magic u32, op u8, flags u8,
+#: aux u16, client_id u32, timestamp u32, num_keys u64 — little-endian,
+#: packed.
+_HEADER = struct.Struct("<IBBHIIQ")
+_MAGIC = 0xD157C0DE
+_OP_PUSH, _OP_PUSHPULL = 1, 7
+#: pump socket timeout: bounds stop() latency without busy-waiting
+_TICK_S = 0.1
+#: event-log cap — a runaway plan must not grow memory unboundedly
+_MAX_EVENTS = 100_000
+
+
+def _unit(seed: int, *parts) -> float:
+    """Deterministic uniform draw in [0, 1) from a hash of the
+    coordinates — NOT a shared RNG stream, so concurrent links/ops
+    cannot perturb each other's draws."""
+    digest = hashlib.blake2b(repr((seed, parts)).encode(),
+                             digest_size=8).digest()
+    return int.from_bytes(digest, "little") / 2.0 ** 64
+
+
+class _Severed(Exception):
+    """Internal: this connection was reset by a fault."""
+
+
+class ChaosLink:
+    """Fault-injecting proxy for one client->server link."""
+
+    def __init__(self, link: int, upstream: tuple[str, int],
+                 plan: FaultPlan, fabric: "ChaosFabric"):
+        self.link = link
+        self.upstream = upstream
+        self._plan = plan
+        self._fabric = fabric
+        self._delay_faults = plan.for_link(link, "delay")
+        self._throttle_faults = plan.for_link(link, "throttle")
+        self._reset_faults = plan.for_link(link, "reset")
+        self._partition_faults = plan.for_link(link, "partition")
+        self._lock = threading.Lock()
+        # cumulative per-LINK traffic state (across reconnects), so
+        # after_ops/after_bytes offsets mean "the Nth op/byte on this
+        # link", not "on this connection"
+        self._ops = 0
+        self._bytes_c2s = 0
+        self._fired: set[int] = set()      # one-shot reset fault indices
+        self._announced: set[tuple] = set()  # (fault, window) activations
+        self._conns: list[tuple[socket.socket, socket.socket]] = []
+        self._threads: list[threading.Thread] = []
+        self._stop = threading.Event()
+        self._lsock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._lsock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._lsock.bind(("127.0.0.1", 0))
+        self._lsock.listen(64)
+        self._lsock.settimeout(_TICK_S)
+        self.port = self._lsock.getsockname()[1]
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, daemon=True,
+            name=f"chaos-accept-{link}")
+        self._accept_thread.start()
+
+    # -- fault predicates -------------------------------------------------
+    def _now(self) -> float:
+        return self._fabric.now()
+
+    def _partition_active(self) -> FaultSpec | None:
+        t = self._now()
+        for f in self._partition_faults:
+            if f.active_at(t):
+                return f
+        return None
+
+    def _announce(self, f: FaultSpec, kind: str) -> None:
+        """Record a windowed fault's activation ONCE per (fault, window)
+        — the event log carries the PLAN's window, never wall time."""
+        key = (f.index, f.window)
+        with self._lock:
+            if key in self._announced:
+                return
+            self._announced.add(key)
+        self._fabric.record(self.link, kind, fault=f.index, window=f.window)
+        _FAULTS.labels(kind=kind, link=str(self.link)).inc()
+
+    # -- accept / pump loops ----------------------------------------------
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                down, _ = self._lsock.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return  # listener closed by stop()
+            part = self._partition_active()
+            if part is not None:
+                # a partitioned host REFUSES new connects fast
+                # (RST-style — the accepted socket closes immediately),
+                # so a client's reconnect loop burns backoff, not a full
+                # connect timeout; size retry budgets on backoff-sum >=
+                # window.  Count it, but keep it out of the
+                # deterministic event log — reconnect-attempt counts are
+                # timing-dependent
+                self._announce(part, "partition")
+                _FAULTS.labels(kind="partition_refused",
+                               link=str(self.link)).inc()
+                down.close()
+                continue
+            try:
+                up = socket.create_connection(self.upstream, timeout=5.0)
+            except OSError:
+                down.close()
+                continue
+            for s in (down, up):
+                s.settimeout(_TICK_S)
+                s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            severed = threading.Event()
+            t1 = threading.Thread(target=self._pump_c2s,
+                                  args=(down, up, severed), daemon=True,
+                                  name=f"chaos-c2s-{self.link}")
+            t2 = threading.Thread(target=self._pump_s2c,
+                                  args=(down, up, severed), daemon=True,
+                                  name=f"chaos-s2c-{self.link}")
+            with self._lock:
+                # prune finished churn: a reset-heavy plan forces a
+                # reconnect (fresh conn + 2 pump threads) per reset, and
+                # a soak must not hoard every dead thread/socket pair
+                self._conns = [c for c in self._conns
+                               if c[0].fileno() != -1] + [(down, up)]
+                self._threads = [t for t in self._threads
+                                 if t.is_alive()] + [t1, t2]
+            t1.start()
+            t2.start()
+
+    def _read_exact(self, sock: socket.socket, n: int,
+                    severed: threading.Event) -> bytes | None:
+        buf = b""
+        while len(buf) < n:
+            if self._stop.is_set() or severed.is_set():
+                return None
+            try:
+                chunk = sock.recv(n - len(buf))
+            except socket.timeout:
+                continue
+            except OSError:
+                return None
+            if not chunk:
+                return None
+            buf += chunk
+        return buf
+
+    def _stall_while_partitioned(self, severed: threading.Event) -> None:
+        while not (self._stop.is_set() or severed.is_set()):
+            part = self._partition_active()
+            if part is None:
+                return
+            self._announce(part, "partition")
+            time.sleep(min(_TICK_S, 0.02))
+
+    def _throttle(self, nbytes: int, severed: threading.Event) -> None:
+        t = self._now()
+        for f in self._throttle_faults:
+            if f.active_at(t):
+                self._announce(f, "throttle")
+                pause = nbytes / f.bytes_per_sec
+                end = time.monotonic() + pause
+                while (time.monotonic() < end
+                       and not (self._stop.is_set() or severed.is_set())):
+                    time.sleep(min(_TICK_S, end - time.monotonic()))
+                return
+
+    def _sever(self, down: socket.socket, up: socket.socket,
+               severed: threading.Event, *, hard: bool) -> None:
+        severed.set()
+        if hard:
+            # RST both ways: queued bytes are DISCARDED (the mid-frame
+            # cut; the server drops the incomplete frame on close)
+            for s in (down, up):
+                try:
+                    s.setsockopt(socket.SOL_SOCKET, socket.SO_LINGER,
+                                 struct.pack("ii", 1, 0))
+                except OSError:
+                    pass
+        for s in (down, up):
+            try:
+                s.close()
+            except OSError:
+                pass
+
+    def _pump_c2s(self, down: socket.socket, up: socket.socket,
+                  severed: threading.Event) -> None:
+        """Framed client->server pump — all op-offset faults live here."""
+        link = str(self.link)
+        try:
+            while not (self._stop.is_set() or severed.is_set()):
+                header = self._read_exact(down, _HEADER.size, severed)
+                if header is None:
+                    break
+                magic, op, _flags, aux, _cid, _ts, num_keys = \
+                    _HEADER.unpack(header)
+                if magic != _MAGIC:
+                    # not KV framing (or stream corrupted upstream of
+                    # us): degrade to a raw relay for this connection
+                    log.warning("chaos link %s: non-KV frame; relaying raw",
+                                link)
+                    up.sendall(header)
+                    self._relay_raw(down, up, severed)
+                    break
+                vpk = max(aux, 1) if op in (_OP_PUSH, _OP_PUSHPULL) else 1
+                payload_len = num_keys * 8
+                if op in (_OP_PUSH, _OP_PUSHPULL):
+                    payload_len += num_keys * vpk * 4
+                payload = b""
+                if payload_len:
+                    payload = self._read_exact(down, payload_len, severed)
+                    if payload is None:
+                        break
+                frame = header + payload
+
+                self._stall_while_partitioned(severed)
+                if self._stop.is_set() or severed.is_set():
+                    break
+                # Atomically CLAIM this frame's op index + byte span and
+                # decide any one-shot reset, all under the link lock —
+                # several connections pump one link concurrently (every
+                # worker plus its push-clock probe), and a check-then-act
+                # here would double-fire one-shot resets, hand two frames
+                # the same jitter draw, and overrun after_bytes.
+                cut_reset = None      # (fault, bytes of frame to deliver)
+                after_reset = None    # fault: deliver frame, sever reply
+                with self._lock:
+                    op_index = self._ops  # 0-based index of THIS frame
+                    self._ops += 1
+                    byte_start = self._bytes_c2s
+                    self._bytes_c2s += len(frame)
+                    for f in self._reset_faults:
+                        if f.index in self._fired:
+                            continue
+                        if (f.after_bytes is not None
+                                and byte_start + len(frame) > f.after_bytes):
+                            self._fired.add(f.index)
+                            cut_reset = (f, max(0, f.after_bytes - byte_start))
+                            break
+                        if (f.after_ops is not None
+                                and op_index + 1 >= f.after_ops):
+                            self._fired.add(f.index)
+                            after_reset = f
+                            break
+
+                # delay: deterministic per (seed, link, fault, op)
+                t = self._now()
+                for f in self._delay_faults:
+                    if not f.active_at(t):
+                        continue
+                    ms = f.delay_ms
+                    if f.jitter_ms:
+                        u = _unit(self._plan.seed, self.link, f.index,
+                                  op_index)
+                        ms += f.jitter_ms * (2.0 * u - 1.0)
+                    self._fabric.record(self.link, "delay", fault=f.index,
+                                        op=op_index, ms=round(ms, 3))
+                    _FAULTS.labels(kind="delay", link=link).inc()
+                    _DELAY_MS.labels(link=link).inc(ms)
+                    # sliced like the stall/throttle waits: a multi-second
+                    # delay must not outlive stop()'s thread joins
+                    end = time.monotonic() + ms / 1000.0
+                    while (time.monotonic() < end
+                           and not (self._stop.is_set()
+                                    or severed.is_set())):
+                        time.sleep(min(_TICK_S, end - time.monotonic()))
+
+                # reset at byte offset: forward only up to the offset,
+                # then hard-kill mid-frame (frame NOT delivered)
+                if cut_reset is not None:
+                    f, cut = cut_reset
+                    if cut > 0:
+                        try:
+                            up.sendall(frame[:cut])
+                        except OSError:
+                            pass
+                    self._fabric.record(self.link, "reset", fault=f.index,
+                                        byte=f.after_bytes)
+                    _FAULTS.labels(kind="reset", link=link).inc()
+                    self._sever(down, up, severed, hard=True)
+                    return
+
+                # pace BEFORE forwarding: a throttled link slows the op
+                # itself, not just its successors
+                self._throttle(len(frame), severed)
+                if after_reset is not None:
+                    # sever the REPLY path before the request can even
+                    # reach the server: the s2c pump checks this flag
+                    # before forwarding, so the response of a delivered
+                    # frame can never win a race back to the client —
+                    # the push-outcome-unknown contract is airtight
+                    severed.set()
+                try:
+                    up.sendall(frame)
+                except OSError:
+                    break
+                _OPS.labels(link=link).inc()
+                _BYTES.labels(link=link, direction="c2s").inc(len(frame))
+
+                # reset at op offset: frame N was DELIVERED (sendall
+                # above, graceful upstream close below flushes it), but
+                # its response is already unreachable
+                if after_reset is not None:
+                    self._fabric.record(self.link, "reset",
+                                        fault=after_reset.index,
+                                        op=after_reset.after_ops)
+                    _FAULTS.labels(kind="reset", link=link).inc()
+                    self._sever(down, up, severed, hard=False)
+                    return
+        finally:
+            severed.set()
+            for s in (down, up):
+                try:
+                    s.close()
+                except OSError:
+                    pass
+
+    def _relay_raw(self, down: socket.socket, up: socket.socket,
+                   severed: threading.Event) -> None:
+        while not (self._stop.is_set() or severed.is_set()):
+            try:
+                chunk = down.recv(1 << 16)
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            if not chunk:
+                return
+            try:
+                up.sendall(chunk)
+            except OSError:
+                return
+
+    def _pump_s2c(self, down: socket.socket, up: socket.socket,
+                  severed: threading.Event) -> None:
+        """Raw server->client relay: responses are delayed only by
+        stalls/throttle, never reframed.
+
+        This pump NEVER closes the sockets — the c2s pump owns closure
+        (its ``finally``, or :meth:`_sever`).  Closing here on seeing
+        ``severed`` could race the after_ops reset's
+        set-severed-then-deliver-frame-N sequence and cut the upstream
+        send out from under it (losing both the delivery and the
+        recorded reset event); instead this pump only SETS ``severed``
+        on upstream EOF/error, and the c2s pump notices within one
+        ``_TICK_S`` and tears both sockets down."""
+        link = str(self.link)
+        try:
+            while not (self._stop.is_set() or severed.is_set()):
+                try:
+                    chunk = up.recv(1 << 16)
+                except socket.timeout:
+                    continue
+                except OSError:
+                    break
+                if not chunk:
+                    break
+                self._stall_while_partitioned(severed)
+                self._throttle(len(chunk), severed)
+                if severed.is_set() or self._stop.is_set():
+                    break
+                try:
+                    down.sendall(chunk)
+                except OSError:
+                    break
+                _BYTES.labels(link=link, direction="s2c").inc(len(chunk))
+        finally:
+            severed.set()
+
+    # -- lifecycle --------------------------------------------------------
+    def stop(self) -> None:
+        self._stop.set()
+        try:
+            self._lsock.close()
+        except OSError:
+            pass
+        with self._lock:
+            conns = list(self._conns)
+        for down, up in conns:
+            for s in (down, up):
+                try:
+                    s.close()
+                except OSError:
+                    pass
+        self._accept_thread.join(timeout=2.0)
+        for t in self._threads:
+            t.join(timeout=2.0)
+
+
+class ChaosFabric:
+    """The chaos proxies for a whole server group: one
+    :class:`ChaosLink` per upstream ``host:port``, exposing a drop-in
+    proxied ``hosts`` string and ONE merged deterministic event log.
+
+    ``upstreams`` is a ``host:port,host:port`` spec (server-rank order,
+    the same format ``KVWorker`` takes) or a list of ``(host, port)``
+    pairs.  Windows in the plan are relative to fabric construction.
+    """
+
+    def __init__(self, upstreams, plan: FaultPlan, *, seed: int | None = None):
+        if seed is not None:
+            plan = FaultPlan(faults=plan.faults, seed=int(seed))
+        self.plan = plan
+        if isinstance(upstreams, str):
+            pairs = []
+            for part in upstreams.split(","):
+                host, _, port = part.rpartition(":")
+                if not host or not port.isdigit():
+                    raise ValueError(
+                        f"bad upstream {part!r} (want host:port)")
+                pairs.append((host, int(port)))
+        else:
+            pairs = [(h, int(p)) for h, p in upstreams]
+        if not pairs:
+            raise ValueError("need at least one upstream server")
+        bad = [f.index for f in plan.faults
+               if f.links is not None and max(f.links) >= len(pairs)]
+        if bad:
+            raise ValueError(
+                f"fault[{bad[0]}].links names a link >= the fabric's "
+                f"{len(pairs)} upstream(s)")
+        self._events: list[tuple] = []
+        self._events_lock = threading.Lock()
+        #: the log hit _MAX_EVENTS and dropped events: past the cap the
+        #: surviving set depends on thread arrival order, so the
+        #: determinism contract no longer holds — comparisons must check
+        #: this flag instead of silently diffing a truncated log
+        self.events_truncated = False
+        self.started_at = time.monotonic()
+        self.links = [ChaosLink(i, up, plan, self)
+                      for i, up in enumerate(pairs)]
+
+    @property
+    def hosts(self) -> str:
+        """Proxied connection spec — hand this to clients in place of
+        the real server group's ``hosts``."""
+        return ",".join(f"127.0.0.1:{lk.port}" for lk in self.links)
+
+    def now(self) -> float:
+        return time.monotonic() - self.started_at
+
+    def record(self, link: int, kind: str, **detail) -> None:
+        with self._events_lock:
+            if len(self._events) < _MAX_EVENTS:
+                self._events.append(
+                    (link, kind) + tuple(sorted(detail.items())))
+            elif not self.events_truncated:
+                self.events_truncated = True
+                log.warning(
+                    "chaos event log hit its %d-event cap; further "
+                    "events are DROPPED and the log is no longer "
+                    "byte-comparable across runs (events_truncated=True)",
+                    _MAX_EVENTS)
+
+    def events(self) -> list[tuple]:
+        """The fault-event log in CANONICAL order (sorted, not arrival
+        order): wall-clock-free by construction — op/byte offsets, plan
+        windows, and hash-derived delay values only — so two runs of the
+        same seed + plan + client op sequence compare equal.  Valid for
+        cross-run comparison only while :attr:`events_truncated` is
+        False (past the cap, which events survived depends on thread
+        arrival order)."""
+        with self._events_lock:
+            return sorted(self._events)
+
+    def stop(self) -> None:
+        for lk in self.links:
+            lk.stop()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()

@@ -166,6 +166,29 @@ class Config:
     # eval. Set 0 for the reference's block-forever semantics; lower it
     # for fast failure detection on small steps.
     ps_timeout_ms: int = 600_000
+    # In-place retry of transient KV transport faults (async mode +
+    # serving pulls; distlr_tpu.ps.client.RetryPolicy): a reset, delay,
+    # or short partition costs a reconnect+retry instead of escalating
+    # to the restart/resume ladder.  attempts counts total tries per op
+    # (0 = off, today's fail-fast); backoff is jittered-exponential
+    # between tries, bounded by the per-op deadline.  Sync (BSP)
+    # gradient pushes are NEVER retried regardless — the deferred reply
+    # is the barrier and the timeout is the named straggler error.
+    ps_retry_attempts: int = 0
+    ps_retry_backoff_ms: float = 50.0
+    ps_retry_backoff_max_ms: float = 2000.0
+    ps_retry_deadline_s: float = 60.0
+
+    # ---- chaos (distlr_tpu.chaos fault injection) ----
+    # Path to a JSON fault plan: local `launch ps` runs interpose the
+    # deterministic fault-injection proxy between every worker and the
+    # spawned server group (ServerGroup via_chaos).  None = no chaos.
+    chaos_plan: str | None = None
+    # Seed of the plan's jitter draws: same seed + same plan + same op
+    # sequence => byte-identical fault timeline.  None = honor the plan
+    # file's own "seed" field (default 0) — matching `launch chaos`;
+    # setting it here overrides the plan.
+    chaos_seed: int | None = None
 
     # ---- input pipeline ----
     # Host->device streaming depth in Trainer.fit: with prefetch=N, up
@@ -313,6 +336,26 @@ class Config:
             # caught here as a config error, not an OverflowError deep in
             # splitmix64's uint64 arithmetic after data already parsed
             raise ValueError(f"hash_seed must be in [0, 2^64), got {self.hash_seed}")
+        if self.ps_retry_attempts < 0:
+            raise ValueError(
+                f"ps_retry_attempts must be >= 0 (0 = off), "
+                f"got {self.ps_retry_attempts}"
+            )
+        if (self.ps_retry_backoff_ms < 0
+                or self.ps_retry_backoff_max_ms < self.ps_retry_backoff_ms):
+            raise ValueError(
+                "need 0 <= ps_retry_backoff_ms <= ps_retry_backoff_max_ms, "
+                f"got {self.ps_retry_backoff_ms}/{self.ps_retry_backoff_max_ms}"
+            )
+        if self.ps_retry_deadline_s <= 0:
+            raise ValueError(
+                f"ps_retry_deadline_s must be positive, "
+                f"got {self.ps_retry_deadline_s}"
+            )
+        if self.chaos_seed is not None and not 0 <= self.chaos_seed < 1 << 64:
+            raise ValueError(
+                "chaos_seed must be None (use the plan's seed) or in "
+                f"[0, 2^64), got {self.chaos_seed}")
         if self.ps_compute_backend not in ("auto", "numpy", "cpu", "default"):
             raise ValueError(
                 "ps_compute_backend must be auto|numpy|cpu|default, "

@@ -168,6 +168,26 @@ def _add_config_flags(p: argparse.ArgumentParser) -> None:
     p.add_argument("--num-processes", dest="num_processes", type=int)
     p.add_argument("--process-id", dest="process_id", type=int)
     p.add_argument(
+        "--ps-retry-attempts", dest="ps_retry_attempts", type=int,
+        help="in-place retry of transient KV transport faults: total "
+        "tries per op (default 0 = fail fast).  Async workers and "
+        "serving pulls reconnect + re-issue with jittered exponential "
+        "backoff; sync BSP pushes always stay fail-fast (the timeout is "
+        "the named straggler signal)",
+    )
+    p.add_argument(
+        "--ps-retry-backoff", dest="ps_retry_backoff_ms", type=float,
+        help="base backoff between retries, ms (default 50)",
+    )
+    p.add_argument(
+        "--ps-retry-backoff-max", dest="ps_retry_backoff_max_ms", type=float,
+        help="backoff cap, ms (default 2000)",
+    )
+    p.add_argument(
+        "--ps-retry-deadline", dest="ps_retry_deadline_s", type=float,
+        help="per-op wall deadline across retries, seconds (default 60)",
+    )
+    p.add_argument(
         "--ps-compute-backend", dest="ps_compute_backend",
         choices=["auto", "numpy", "cpu", "default"],
         help="where PS workers run their dense steps: auto (plain numpy "
@@ -197,6 +217,9 @@ def _config_from_args(args: argparse.Namespace) -> Config:
             "feature_dtype", "block_size", "block_groups", "ctr_fields",
             "hash_seed", "ps_pipeline", "obs_metrics_port",
             "obs_metrics_host", "obs_trace_path", "obs_run_dir",
+            "ps_retry_attempts", "ps_retry_backoff_ms",
+            "ps_retry_backoff_max_ms", "ps_retry_deadline_s",
+            "chaos_plan", "chaos_seed",
         }
     }
     cfg = Config.from_env(**overrides)
@@ -393,6 +416,12 @@ def cmd_ps(args: argparse.Namespace) -> int:
                   "server host owns its processes; supervise there)",
                   file=sys.stderr)
             return 2
+        if cfg.chaos_plan:
+            print("error: --chaos-plan applies to local mode (it wraps "
+                  "the spawned server group); to fault-inject a remote "
+                  "group, run `launch chaos --upstreams ...` and point "
+                  "--hosts at the proxied ports", file=sys.stderr)
+            return 2
         ranks = (
             [int(s) for s in args.worker_ranks.split(",")]
             if args.worker_ranks
@@ -495,12 +524,27 @@ def cmd_serve(args: argparse.Namespace) -> int:
             from distlr_tpu.serve import HotSetTracker  # noqa: PLC0415
 
             hot_tracker = HotSetTracker(cfg.serve_hot_rows)
+        retry = None
+        if cfg.ps_retry_attempts > 0:
+            from distlr_tpu.ps import RetryPolicy  # noqa: PLC0415
+
+            # serving pulls are idempotent, so the full policy applies: a
+            # PS blip mid-poll is retried inside the poll; an exhausted
+            # policy degrades to last-good weights (HotReloader), never
+            # kills the server
+            retry = RetryPolicy(
+                attempts=cfg.ps_retry_attempts,
+                backoff_ms=cfg.ps_retry_backoff_ms,
+                backoff_max_ms=cfg.ps_retry_backoff_max_ms,
+                deadline_s=cfg.ps_retry_deadline_s,
+            )
         source = LivePSWatcher(
             args.ps_hosts, ps_param_dim(cfg),
             vals_per_key=max(row_width, 1),
             hot_tracker=hot_tracker,
             min_coverage=cfg.serve_hot_min_coverage,
             full_refresh_every=cfg.serve_hot_full_every,
+            retry=retry,
         )
     elif cfg.checkpoint_dir:
         source = CheckpointWatcher(cfg.checkpoint_dir)
@@ -570,6 +614,50 @@ def cmd_route(args: argparse.Namespace) -> int:
         # Scriptable readiness line, like serve's "SERVING host:port".
         print(f"ROUTING {router.host}:{router.port}", flush=True)
         router.serve_forever()
+    return 0
+
+
+def cmd_chaos(args: argparse.Namespace) -> int:
+    """Stand a fault-injection proxy fabric in front of an EXISTING KV
+    server group (:mod:`distlr_tpu.chaos`): one proxied port per
+    upstream, announced as ``HOSTS <proxied>`` — point any worker /
+    server / watcher command at those instead of the real ports and the
+    whole run rides the JSON fault plan.  Deliberately jax-free; the
+    event log (deterministic: same seed + same plan + same traffic =
+    identical log) is dumped at exit when ``--events-path`` is set."""
+    import json  # noqa: PLC0415
+    import signal  # noqa: PLC0415
+
+    from distlr_tpu.chaos import ChaosFabric, FaultPlanError, load_plan  # noqa: PLC0415
+
+    cfg = _config_from_args(args)
+    try:
+        plan = load_plan(args.plan, seed=args.seed)
+        fabric = ChaosFabric(args.upstreams, plan)
+    except (OSError, FaultPlanError, ValueError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    signal.signal(signal.SIGTERM, lambda *_: sys.exit(143))
+    try:
+        with _obs_scope(cfg, "chaos", _obs_rank(args)), fabric:
+            # Scriptable contract, like ps-server: substitute these for
+            # the real group's hosts in every downstream command.
+            print(f"HOSTS {fabric.hosts}", flush=True)
+            for lk in fabric.links:
+                log.info("chaos link %d: 127.0.0.1:%d -> %s:%d",
+                         lk.link, lk.port, *lk.upstream)
+            while True:
+                signal.pause()
+    except KeyboardInterrupt:
+        return 130
+    finally:
+        events = fabric.events()
+        log.info("chaos: %d fault events injected", len(events))
+        if args.events_path:
+            with open(args.events_path, "w") as f:
+                json.dump([list(e[:2]) + [dict(e[2:])] for e in events], f,
+                          indent=1)
+            log.info("chaos event log -> %s", args.events_path)
     return 0
 
 
@@ -648,6 +736,7 @@ def cmd_obs_agg(args: argparse.Namespace) -> int:
             barrier_min_count=args.alert_barrier_min_count,
             push_error_rate=args.alert_push_error_rate,
             weight_age_ratio=args.alert_weight_age_ratio,
+            retry_rate=args.alert_retry_rate,
             scrape_stale_s=args.stale_after,
         )
     except (OSError, ValueError) as e:
@@ -781,6 +870,18 @@ def main(argv=None) -> int:
                    help="async local mode: respawn dead server ranks and "
                    "re-seed them from a rolling snapshot (pair with "
                    "--max-worker-restarts)")
+    p.add_argument("--chaos-plan", dest="chaos_plan",
+                   help="local mode: JSON fault plan (distlr_tpu.chaos) "
+                   "injected between every worker and the spawned server "
+                   "group — delay/jitter, throttling, resets at op/byte "
+                   "offsets, timed partitions; pair with "
+                   "--ps-retry-attempts so faults cost a retry, not a "
+                   "restart")
+    p.add_argument("--chaos-seed", dest="chaos_seed", type=int,
+                   help="seed of the plan's jitter draws (same seed + "
+                   "same plan = identical fault timeline; default: the "
+                   "plan file's own \"seed\", else 0 — same rule as "
+                   "`launch chaos`)")
     p.add_argument("--no-ps-pipeline", dest="ps_pipeline",
                    action="store_false", default=None,
                    help="disable the fused/pipelined dense PS protocol "
@@ -869,6 +970,29 @@ def main(argv=None) -> int:
     v.add_argument("--ports", help="fixed ports, comma-separated (default: ephemeral)")
     v.set_defaults(fn=cmd_ps_server)
 
+    c = sub.add_parser(
+        "chaos",
+        help="fault-injection proxy in front of an existing KV server "
+             "group: deterministic delay/throttle/reset/partition from a "
+             "JSON plan; workers connect to the proxied HOSTS",
+    )
+    _add_config_flags(c)
+    c.add_argument("--upstreams", required=True,
+                   help="the REAL server group, comma-separated host:port "
+                   "in rank order (what `launch ps-server` printed)")
+    c.add_argument("--plan", required=True,
+                   help="JSON fault plan (see distlr_tpu/chaos/plan.py "
+                   "for the schema; malformed plans are rejected loudly "
+                   "at startup)")
+    c.add_argument("--seed", type=int, default=None,
+                   help="jitter seed (default: the plan's own, else 0); "
+                   "same seed + same plan + same traffic = identical "
+                   "fault-event log")
+    c.add_argument("--events-path", dest="events_path",
+                   help="write the deterministic fault-event log here as "
+                   "JSON at exit")
+    c.set_defaults(fn=cmd_chaos)
+
     a = sub.add_parser(
         "obs-agg",
         help="fleet metrics aggregator: merge every rank's /metrics into "
@@ -904,6 +1028,11 @@ def main(argv=None) -> int:
                    type=float,
                    help="async weight age alert fires above this multiple "
                    "of the median step time (default 10)")
+    a.add_argument("--alert-retry-rate", dest="alert_retry_rate", type=float,
+                   help="distlr_alert_ps_retry_rate fires above this "
+                   "fleet share of KV op attempts that are in-place "
+                   "retry re-issues (default 0.05) — degradation the "
+                   "resilience layer is absorbing, visible before errors")
     a.add_argument("--once", action="store_true",
                    help="scrape+merge once and exit: print the fleet "
                    "Prometheus text (or write --snapshot-path) instead of "

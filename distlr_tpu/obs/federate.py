@@ -360,6 +360,14 @@ class AlertThresholds:
     #: (Hogwild self-staleness is ~1 in-flight step; 10x means a worker
     #: is computing on ancient weights).
     weight_age_ratio: float = 10.0
+    #: fleet retry fraction — the share of KV op ATTEMPTS that are
+    #: retry re-issues (retries / total attempts; failed attempts count
+    #: in the denominator, so the ratio is bounded [0, 1) and rises
+    #: toward 1 as every op needs more tries).  Above this,
+    #: distlr_alert_ps_retry_rate fires — the "network is degraded but
+    #: the retry layer is absorbing it" signal; it alerts BEFORE the
+    #: error-rate alert (retries precede failures).
+    retry_rate: float = 0.05
 
     @classmethod
     def resolve(cls, path: str | None = None, **overrides) -> "AlertThresholds":
@@ -439,7 +447,7 @@ def evaluate_alerts(reg: MetricsRegistry, *, thresholds: AlertThresholds,
     """Compute the ``distlr_alert_*`` 0/1 gauges (+ their
     ``distlr_fleet_*`` input-value gauges) inside the merged registry.
 
-    Returns the structured alert list ``/fleet.json`` carries.  All four
+    Returns the structured alert list ``/fleet.json`` carries.  All six
     alert families are always declared — a scrape can tell "not firing"
     from "aggregator doesn't compute this".
     """
@@ -533,7 +541,56 @@ def evaluate_alerts(reg: MetricsRegistry, *, thresholds: AlertThresholds,
             emit(g, {"role": role, "rank": rank,
                      "threshold": f"{t.weight_age_ratio:g}x_step_p50"},
                  firing, age, t.weight_age_ratio)
+
+    # 5. PS retry rate — the resilience layer's "absorbing faults"
+    # signal: in-place retries per client op.  Fires while the network
+    # is degraded even when every op ultimately SUCCEEDS, i.e. before
+    # (and independently of) the push error-rate alert.
+    retries = _fam_sum(reg, "distlr_ps_retries_total")
+    # denominator = op ATTEMPTS (every issue, including failed tries,
+    # lands in distlr_ps_client_ops_total): the ratio is the share of
+    # attempts that were re-issues, bounded [0, 1)
+    ops_total = _fam_sum(reg, "distlr_ps_client_ops_total")
+    retry_rate = (retries / ops_total) if ops_total else 0.0
+    reg.gauge("distlr_fleet_ps_retry_rate",
+              "fleet in-place KV retry fraction (retry re-issues / "
+              "total op attempts)").set(retry_rate)
+    g = reg.gauge("distlr_alert_ps_retry_rate",
+                  "1 while the fleet's in-place KV retry fraction exceeds "
+                  "the threshold label (transient faults being absorbed "
+                  "at volume)", ("threshold",))
+    emit(g, {"threshold": f"{t.retry_rate:g}"},
+         ops_total > 0 and retry_rate > t.retry_rate,
+         retry_rate, t.retry_rate)
+
+    # 6. supervisor gave up on a server rank — a dead-and-abandoned
+    # range: every key it owned is frozen until a human intervenes.
+    # Threshold is structurally 0 (any give-up is an outage), labeled
+    # like the other alerts so the scrape stays self-describing.
+    gave_up = _fam_sum(reg, "distlr_ps_supervisor_events_total",
+                       {"event": "gave-up"})
+    g = reg.gauge("distlr_alert_ps_gave_up",
+                  "1 while the server supervisor has abandoned a rank "
+                  "(respawn budget exhausted — that key range is frozen)",
+                  ("threshold",))
+    emit(g, {"threshold": "0"}, gave_up > 0, gave_up, 0.0)
     return alerts
+
+
+def _fam_sum(reg: MetricsRegistry, name: str,
+             where: dict | None = None) -> float:
+    """Sum of a live merged family's child values, optionally filtered
+    by a label subset — the in-registry twin of :func:`_snap_sum`."""
+    fam = reg.get(name)
+    if fam is None:
+        return 0.0
+    total = 0.0
+    for values, child in fam.children():
+        labels = dict(zip(fam.labelnames, values))
+        if where and any(labels.get(k) != v for k, v in where.items()):
+            continue
+        total += child.value
+    return total
 
 
 # ---------------------------------------------------------------------------

@@ -1,3 +1,8 @@
 from distlr_tpu.ps.build import build_native, native_dir  # noqa: F401
-from distlr_tpu.ps.client import KVWorker, PSTimeoutError, STATS_FIELDS  # noqa: F401
+from distlr_tpu.ps.client import (  # noqa: F401
+    KVWorker,
+    PSTimeoutError,
+    RetryPolicy,
+    STATS_FIELDS,
+)
 from distlr_tpu.ps.server import ServerGroup, ServerSupervisor  # noqa: F401

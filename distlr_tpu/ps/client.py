@@ -10,6 +10,8 @@ from __future__ import annotations
 
 import contextlib
 import ctypes
+import dataclasses
+import random
 import time
 
 import numpy as np
@@ -43,6 +45,23 @@ _CHUNKED_PULLS = _reg.counter(
 _CHUNKS = _reg.counter(
     "distlr_ps_client_chunks_total",
     "individual bounded pull ops issued by pull_chunked",
+)
+_RETRIES = _reg.counter(
+    "distlr_ps_retries_total",
+    "KV ops re-issued in place after a transient transport failure "
+    "(RetryPolicy path: reconnect + re-issue, no process restart)",
+    labelnames=("op",),
+)
+_RECONNECTS = _reg.counter(
+    "distlr_ps_reconnects_total",
+    "native KV connections rebuilt in place (KVWorker.reconnect)",
+)
+_PUSH_UNKNOWN = _reg.counter(
+    "distlr_ps_push_outcome_unknown_total",
+    "gradient pushes whose delivery could not be determined after a "
+    "transport failure — counted and absorbed (the Hogwild staleness "
+    "class), NEVER re-issued (a maybe-applied push re-issued is a "
+    "silent double-apply)",
 )
 
 
@@ -82,6 +101,66 @@ class PSTimeoutError(TimeoutError):
     """A KV op hit the receive timeout — in sync mode, the named
     straggler failure: a dead/slow worker holding the BSP barrier
     (SURVEY.md §5.3; the reference deadlocks forever here)."""
+
+
+@dataclasses.dataclass(frozen=True)
+class RetryPolicy:
+    """In-place recovery policy for transient KV transport faults.
+
+    With a policy attached, a :class:`KVWorker` answers a reset, delay,
+    or short partition by reconnecting the poisoned native handle and
+    re-issuing the op — bounded attempts, jittered exponential backoff,
+    and a per-op wall deadline — instead of surfacing the failure to the
+    restart/resume ladder.  Only IDEMPOTENT ops are ever re-issued
+    (pull, chunked/keyed pulls, stats, barrier votes — the server rolls
+    a dead connection's vote out of the count, so a reconnect re-vote is
+    exactly one live vote).  A gradient push is re-issued ONLY when the
+    native client proves no byte of it reached any server's kernel
+    (:func:`kv_op_delivery_began`); otherwise its outcome is unknown and
+    it is counted in ``distlr_ps_push_outcome_unknown_total`` and
+    absorbed — a retried pull / lost push is the same bounded-staleness
+    class Hogwild training already tolerates (arXiv:1508.05711), while a
+    double-applied gradient would silently bias the trajectory.
+
+    Sync (BSP) pushes are NEVER retried regardless of policy: the
+    deferred reply IS the barrier, and the timeout is the named
+    straggler signal — retrying it would mix gradients across rounds.
+    """
+
+    #: total tries per op, including the first issue (>= 1)
+    attempts: int = 4
+    #: base of the exponential backoff between tries
+    backoff_ms: float = 50.0
+    #: backoff cap (jitter applies after the cap)
+    backoff_max_ms: float = 2000.0
+    #: +/- fraction of each backoff drawn uniformly (0 = fixed ladder)
+    jitter: float = 0.2
+    #: wall deadline per op across all tries; crossing it surfaces the
+    #: last failure even when attempts remain
+    deadline_s: float = 60.0
+    #: RNG seed for the jitter draw (None = nondeterministic)
+    seed: int | None = None
+
+    def __post_init__(self):
+        if self.attempts < 1:
+            raise ValueError(f"attempts must be >= 1, got {self.attempts}")
+        if self.backoff_ms < 0 or self.backoff_max_ms < self.backoff_ms:
+            raise ValueError(
+                "need 0 <= backoff_ms <= backoff_max_ms, got "
+                f"{self.backoff_ms}/{self.backoff_max_ms}")
+        if not 0.0 <= self.jitter < 1.0:
+            raise ValueError(f"jitter must be in [0, 1), got {self.jitter}")
+        if self.deadline_s <= 0:
+            raise ValueError(
+                f"deadline_s must be positive, got {self.deadline_s}")
+
+    def backoff_s(self, retry_index: int, rng: random.Random) -> float:
+        """Sleep before re-issue number ``retry_index`` (0-based)."""
+        base = min(self.backoff_ms * (2.0 ** retry_index),
+                   self.backoff_max_ms)
+        if self.jitter:
+            base *= 1.0 + self.jitter * (2.0 * rng.random() - 1.0)
+        return max(base, 0.0) / 1000.0
 
 
 def _load():
@@ -127,6 +206,8 @@ def _load():
         lib.kv_set_push_visit_all.argtypes = [ctypes.c_void_p, ctypes.c_int]
         lib.kv_timed_out.restype = ctypes.c_int
         lib.kv_timed_out.argtypes = [ctypes.c_void_p]
+        lib.kv_op_delivery_began.restype = ctypes.c_int
+        lib.kv_op_delivery_began.argtypes = [ctypes.c_void_p]
         lib.kv_stats.restype = ctypes.c_int
         lib.kv_stats.argtypes = [  # out buffer is float64 (see kv_protocol.h)
             ctypes.c_void_p, ctypes.c_uint32, ctypes.c_void_p, ctypes.c_uint64,
@@ -142,11 +223,20 @@ class KVWorker:
     """Blocking Push/Pull/Wait client over a range-sharded server group."""
 
     def __init__(self, hosts: str, dim: int, client_id: int = 0, *,
-                 timeout_ms: int = 0, sync_group: bool = True):
+                 timeout_ms: int = 0, sync_group: bool = True,
+                 retry: RetryPolicy | None = None):
         lib = _load()
         self._lib = lib
         self.dim = dim
         self.num_servers = hosts.count(",") + 1
+        # connection state kept for reconnect(): a poisoned handle is
+        # rebuilt in place with exactly these parameters
+        self._hosts = hosts
+        self._client_id = client_id
+        self._timeout_ms = int(timeout_ms)
+        self._sync_group = bool(sync_group)
+        self.retry = retry
+        self._retry_rng = random.Random(retry.seed if retry else None)
         self._h = lib.kv_connect(hosts.encode(), dim, client_id)
         if not self._h:
             raise ConnectionError(f"could not connect to KV servers at {hosts}")
@@ -160,12 +250,131 @@ class KVWorker:
             # trips per sparse push).  MUST stay True for sync groups.
             lib.kv_set_push_visit_all(self._h, 0)
 
+    def reconnect(self) -> None:
+        """Rebuild the native handle in place — same hosts, dim,
+        client_id, timeout, and group-mode flags — the escape from a
+        poisoned connection (one receive failure fails every later op
+        on that stream until reconnect; kv_client.cc).  Callers running
+        their own recovery loop use this instead of recreating the
+        whole object; a :class:`RetryPolicy` calls it automatically.
+
+        The new connections are established BEFORE the old ones close,
+        so a failed reconnect (servers still down) leaves the worker on
+        its previous — poisoned but intact — handle and raises
+        ``ConnectionError``; closing the old stream is also what makes
+        the servers roll back any of its pending barrier votes or
+        deferred pushes (DropConnection), which is exactly why a
+        post-reconnect re-vote counts once."""
+        h = self._lib.kv_connect(self._hosts.encode(), self.dim,
+                                 self._client_id)
+        if not h:
+            raise ConnectionError(
+                f"could not reconnect to KV servers at {self._hosts}")
+        old, self._h = self._h, h
+        if old:
+            self._lib.kv_close(old)
+        _RECONNECTS.inc()
+        if self._timeout_ms:
+            self.set_timeout(self._timeout_ms)
+        if not self._sync_group:
+            self._lib.kv_set_push_visit_all(self._h, 0)
+
+    # -- in-place retry (RetryPolicy) -------------------------------------
+    def _with_retry(self, op: str, fn):
+        """Run an IDEMPOTENT op under the retry policy: on a transient
+        transport failure, reconnect the poisoned handle, back off, and
+        re-issue — bounded by attempts and the per-op deadline.  With no
+        policy this is a plain call (today's fail-fast semantics)."""
+        pol = self.retry
+        if pol is None:
+            return fn()
+        deadline = time.monotonic() + pol.deadline_s
+        last: Exception | None = None
+        for attempt in range(pol.attempts):
+            if attempt:
+                nap = pol.backoff_s(attempt - 1, self._retry_rng)
+                time.sleep(min(nap, max(0.0, deadline - time.monotonic())))
+                try:
+                    self.reconnect()
+                except OSError as e:
+                    # servers unreachable (e.g. mid-partition): burn the
+                    # attempt on the reconnect and keep backing off
+                    last = e
+                    if time.monotonic() >= deadline:
+                        break
+                    continue
+                if time.monotonic() >= deadline:
+                    # deadline crossed during backoff/reconnect: surface
+                    # the last failure rather than re-issuing an op that
+                    # could block a further full receive timeout
+                    break
+                _RETRIES.labels(op=op).inc()
+            try:
+                return fn()
+            except OSError as e:
+                last = e
+                if time.monotonic() >= deadline:
+                    break
+        assert last is not None
+        raise last
+
+    def _push_with_retry(self, op: str, fn, *, on_unknown=None):
+        """Run a NON-idempotent (gradient-carrying) op under the retry
+        policy.  Re-issue is allowed only while the native client proves
+        no byte of the failed op reached any server's kernel
+        (kv_op_delivery_began == 0) — then a retry cannot double-apply.
+        Once delivery began, the outcome is unknown: count it, reconnect
+        so the worker keeps running, and resolve via ``on_unknown`` (the
+        fused op re-pulls its weights idempotently) or absorb the
+        possibly-lost push (plain push returns -1) — the same bounded
+        staleness async training already tolerates.  Sync (BSP) groups
+        never retry pushes: the deferred reply is the barrier and the
+        timeout is the named straggler error."""
+        pol = self.retry
+        if pol is None or self._sync_group:
+            return fn()
+        deadline = time.monotonic() + pol.deadline_s
+        last: Exception | None = None
+        for attempt in range(pol.attempts):
+            if attempt:
+                nap = pol.backoff_s(attempt - 1, self._retry_rng)
+                time.sleep(min(nap, max(0.0, deadline - time.monotonic())))
+                try:
+                    self.reconnect()
+                except OSError as e:
+                    last = e
+                    if time.monotonic() >= deadline:
+                        break
+                    continue
+                if time.monotonic() >= deadline:
+                    break  # see _with_retry: never re-issue past deadline
+                _RETRIES.labels(op=op).inc()
+            try:
+                return fn()
+            except OSError as e:
+                if self._lib.kv_op_delivery_began(self._h):
+                    _PUSH_UNKNOWN.inc()
+                    with contextlib.suppress(OSError):
+                        # best-effort: later ops retry their own reconnect
+                        self.reconnect()
+                    if on_unknown is not None:
+                        return on_unknown()
+                    return -1
+                last = e
+                if time.monotonic() >= deadline:
+                    break
+        assert last is not None
+        raise last
+
     def set_timeout(self, timeout_ms: int) -> None:
         """Receive timeout for every op; 0 = block forever (reference
         semantics — a sync-mode straggler then deadlocks the job exactly
-        like ps-lite, SURVEY.md §5.3)."""
+        like ps-lite, SURVEY.md §5.3).  The value is remembered so a
+        later :meth:`reconnect` re-applies what is in force NOW, not the
+        constructor-time value."""
         if self._lib.kv_set_timeout_ms(self._h, int(timeout_ms)) != 0:
             raise OSError("failed to set KV socket timeout")
+        self._timeout_ms = int(timeout_ms)
 
     def _check(self, ts: int, what: str) -> int:
         if ts < 0:
@@ -236,14 +445,18 @@ class KVWorker:
             raise ValueError(
                 f"{vals.shape[0]} vals vs {keys.shape[0]} keys "
                 f"x vals_per_key {vpk}")
-        with _observe_op("push", sent=keys.nbytes + vals.nbytes):
-            ts = self._lib.kv_push_vpk(
-                self._h,
-                keys.ctypes.data_as(ctypes.c_void_p),
-                vals.ctypes.data_as(ctypes.c_void_p),
-                keys.shape[0], vpk,
-            )
-            return self._check(ts, "push")
+
+        def _issue():
+            with _observe_op("push", sent=keys.nbytes + vals.nbytes):
+                ts = self._lib.kv_push_vpk(
+                    self._h,
+                    keys.ctypes.data_as(ctypes.c_void_p),
+                    vals.ctypes.data_as(ctypes.c_void_p),
+                    keys.shape[0], vpk,
+                )
+                return self._check(ts, "push")
+
+        return self._push_with_retry("push", _issue)
 
     def push_init(self, vals: np.ndarray, keys: np.ndarray | None = None,
                   *, force: bool = False) -> int:
@@ -256,15 +469,21 @@ class KVWorker:
         keys = self._all_keys if keys is None else self._validate_keys(keys)
         if vals.shape[0] != keys.shape[0]:
             raise ValueError(f"{vals.shape[0]} vals vs {keys.shape[0]} keys")
-        with _observe_op("push_init", sent=keys.nbytes + vals.nbytes):
-            ts = self._lib.kv_push_init(
-                self._h,
-                keys.ctypes.data_as(ctypes.c_void_p),
-                vals.ctypes.data_as(ctypes.c_void_p),
-                keys.shape[0],
-                1 if force else 0,
-            )
-            return self._check(ts, "push_init")
+
+        def _issue():
+            with _observe_op("push_init", sent=keys.nbytes + vals.nbytes):
+                ts = self._lib.kv_push_init(
+                    self._h,
+                    keys.ctypes.data_as(ctypes.c_void_p),
+                    vals.ctypes.data_as(ctypes.c_void_p),
+                    keys.shape[0],
+                    1 if force else 0,
+                )
+                return self._check(ts, "push_init")
+
+        # idempotent by protocol design (kInitPush no-ops once seeded;
+        # kForceInit re-sends the same vals) -> plain retry is safe
+        return self._with_retry("push_init", _issue)
 
     def push_pull(self, vals: np.ndarray,
                   keys: np.ndarray | None = None,
@@ -284,17 +503,26 @@ class KVWorker:
                 f"{vals.shape[0]} vals vs {keys.shape[0]} keys "
                 f"x vals_per_key {vpk}")
         out = np.empty(keys.shape[0] * vpk, dtype=np.float32)
-        with _observe_op("push_pull", sent=keys.nbytes + vals.nbytes,
-                         received=out.nbytes):
-            ts = self._lib.kv_push_pull_vpk(
-                self._h,
-                keys.ctypes.data_as(ctypes.c_void_p),
-                vals.ctypes.data_as(ctypes.c_void_p),
-                out.ctypes.data_as(ctypes.c_void_p),
-                keys.shape[0], vpk,
-            )
-            self._check(ts, "push_pull")
-        return out
+
+        def _issue():
+            with _observe_op("push_pull", sent=keys.nbytes + vals.nbytes,
+                             received=out.nbytes):
+                ts = self._lib.kv_push_pull_vpk(
+                    self._h,
+                    keys.ctypes.data_as(ctypes.c_void_p),
+                    vals.ctypes.data_as(ctypes.c_void_p),
+                    out.ctypes.data_as(ctypes.c_void_p),
+                    keys.shape[0], vpk,
+                )
+                self._check(ts, "push_pull")
+            return out
+
+        # Unknown push outcome: the gradient is lost-or-applied-once
+        # (counted), and the PULL half is re-issued idempotently so the
+        # caller still gets current weights for the same keys.
+        return self._push_with_retry(
+            "push_pull", _issue,
+            on_unknown=lambda: self.pull(keys=keys, vals_per_key=vpk))
 
     def pull(self, keys: np.ndarray | None = None,
              *, vals_per_key: int = 1) -> np.ndarray:
@@ -303,15 +531,19 @@ class KVWorker:
         vpk = int(vals_per_key)
         keys = self._default_or_validated(keys, vpk)
         out = np.empty(keys.shape[0] * vpk, dtype=np.float32)
-        with _observe_op("pull", sent=keys.nbytes, received=out.nbytes):
-            ts = self._lib.kv_pull_vpk(
-                self._h,
-                keys.ctypes.data_as(ctypes.c_void_p),
-                out.ctypes.data_as(ctypes.c_void_p),
-                keys.shape[0], vpk,
-            )
-            self._check(ts, "pull")
-        return out
+
+        def _issue():
+            with _observe_op("pull", sent=keys.nbytes, received=out.nbytes):
+                ts = self._lib.kv_pull_vpk(
+                    self._h,
+                    keys.ctypes.data_as(ctypes.c_void_p),
+                    out.ctypes.data_as(ctypes.c_void_p),
+                    keys.shape[0], vpk,
+                )
+                self._check(ts, "pull")
+            return out
+
+        return self._with_retry("pull", _issue)
 
     def pull_chunked(self, keys: np.ndarray | None = None, *,
                      vals_per_key: int = 1,
@@ -401,8 +633,17 @@ class KVWorker:
             # the wire field is u16; silent truncation could alias a
             # released generation and turn a real barrier into a no-op
             raise ValueError(f"barrier_id must fit in uint16, got {barrier_id}")
-        with _observe_op("barrier"):
-            self._check(self._lib.kv_barrier(self._h, barrier_id), "barrier")
+
+        def _issue():
+            with _observe_op("barrier"):
+                self._check(self._lib.kv_barrier(self._h, barrier_id),
+                            "barrier")
+
+        # Retry-safe: closing the failed connection makes server 0 roll
+        # its pending vote out of the count (DropConnection), and a
+        # released generation answers re-votes immediately — so a
+        # reconnect re-vote counts exactly once.
+        self._with_retry("barrier", _issue)
 
     def stats(self, server: int = 0) -> dict:
         """Health/progress counters of one server (never deferred, so it
@@ -410,11 +651,16 @@ class KVWorker:
         dedicated KVWorker for probing: ops on this connection must not
         be in flight concurrently."""
         out = np.zeros(len(STATS_FIELDS), dtype=np.float64)
-        n = self._lib.kv_stats(
-            self._h, server, out.ctypes.data_as(ctypes.c_void_p), out.shape[0]
-        )
-        self._check(n, "stats")
-        return dict(zip(STATS_FIELDS, (int(v) for v in out[:n])))
+
+        def _issue():
+            n = self._lib.kv_stats(
+                self._h, server, out.ctypes.data_as(ctypes.c_void_p),
+                out.shape[0],
+            )
+            self._check(n, "stats")
+            return dict(zip(STATS_FIELDS, (int(v) for v in out[:n])))
+
+        return self._with_retry("stats", _issue)
 
     def global_pushes(self, *, per_worker_scale: bool = True) -> float:
         """The group's monotonic global push clock: the sum of every

@@ -62,6 +62,15 @@ struct Client {
   // reply, so every subsequent frame would be misparsed.  The handle is
   // poisoned: ops fail fast until the caller reconnects.
   bool poisoned = false;
+  // Delivery state of the most recent FAILED op: false = not one byte of
+  // the op's request reached any server's kernel (the kernel accepted
+  // nothing — a retry after reconnect cannot double-apply anything);
+  // true = delivery began, so for a non-idempotent push the outcome is
+  // genuinely unknown (the server may have applied the frame before the
+  // stream died).  The conservative direction: a partially-accepted
+  // write counts as "began" even though the server drops incomplete
+  // frames, so "false" is a hard safety guarantee, never a guess.
+  bool op_delivery_began = false;
   char err[256] = {0};
 };
 
@@ -76,13 +85,14 @@ bool ReadFull(int fd, void* buf, size_t n) {
   return true;
 }
 
-bool WriteFull(int fd, const void* buf, size_t n) {
+bool WriteFull(int fd, const void* buf, size_t n, bool* any_sent = nullptr) {
   const auto* p = static_cast<const char*>(buf);
   while (n > 0) {
     // MSG_NOSIGNAL: a dead peer yields EPIPE instead of SIGPIPE, so
     // non-Python consumers of this library survive server loss too.
     ssize_t r = send(fd, p, n, MSG_NOSIGNAL);
     if (r <= 0) return false;
+    if (any_sent != nullptr) *any_sent = true;  // kernel accepted bytes
     p += r;
     n -= static_cast<size_t>(r);
   }
@@ -165,6 +175,7 @@ int RoundTrip(Client* c, Op op, const Key* keys, const float* vals,
               float* out_vals, uint64_t n, uint8_t flags = kNone,
               uint16_t barrier_id = 0, uint64_t vpk = 1) {
   c->timed_out = false;
+  c->op_delivery_began = false;
   if (c->poisoned) {
     snprintf(c->err, sizeof(c->err),
              "connection poisoned by an earlier receive failure; "
@@ -228,15 +239,20 @@ int RoundTrip(Client* c, Op op, const Key* keys, const float* vals,
     const Key rebase = c->servers[s].range_begin / vpk;
     for (uint64_t i = b; i < e; ++i) lk[i - b] = keys[i] - rebase;
     const int fd = c->servers[s].fd;
-    if (!WriteFull(fd, &h, sizeof(h)) ||
-        (h.num_keys && !WriteFull(fd, lk.data(), lk.size() * sizeof(Key))) ||
+    if (!WriteFull(fd, &h, sizeof(h), &c->op_delivery_began) ||
+        (h.num_keys && !WriteFull(fd, lk.data(), lk.size() * sizeof(Key),
+                                  &c->op_delivery_began)) ||
         (is_push && h.num_keys &&
-         !WriteFull(fd, vals + b * vpk, (e - b) * vpk * sizeof(Val)))) {
+         !WriteFull(fd, vals + b * vpk, (e - b) * vpk * sizeof(Val),
+                    &c->op_delivery_began))) {
       c->poisoned = true;  // peers already received slices of this ts
       snprintf(c->err, sizeof(c->err), "send to server %zu failed", s);
       return -1;
     }
   }
+  // Every request frame left intact; any failure from here on is on the
+  // receive side, where delivery is a fact (only the REPLY is in doubt).
+  c->op_delivery_began = true;
 
   // Phase 2: collect every response (blocks through deferred replies —
   // in sync mode this wait IS the BSP barrier).
@@ -447,6 +463,15 @@ int kv_set_push_visit_all(void* handle, int on) {
 // connection / protocol error).
 int kv_timed_out(void* handle) {
   return static_cast<distlr::Client*>(handle)->timed_out ? 1 : 0;
+}
+
+// Delivery state of the most recent FAILED op: 0 = no byte of its
+// request was accepted by any server's kernel (re-issuing after a
+// reconnect cannot double-apply anything — the hard guarantee a push
+// retry needs); 1 = delivery began, so a non-idempotent op's outcome is
+// unknown.  Conservative: partial writes count as 1.
+int kv_op_delivery_began(void* handle) {
+  return static_cast<distlr::Client*>(handle)->op_delivery_began ? 1 : 0;
 }
 
 // Health probe of one server: fills out[0..n) with the kStats counters

@@ -542,6 +542,23 @@ class KVServer {
       return;
     }
     auto& waiters = barrier_[id];
+    // One vote per CLIENT per generation, keyed by client_id — not one
+    // per connection.  A worker that times out and reconnects re-votes
+    // on a NEW connection, and nothing orders that re-vote after the
+    // old connection's DropConnection rollback (separate reader
+    // threads): appending blindly would let one worker hold two live
+    // votes, release the barrier early with peers absent, and — for
+    // the exit generation — trigger rank 0's shutdown_servers while a
+    // peer is still training.  Replacing the stale entry's fd keeps
+    // exactly one vote and routes the eventual release reply to the
+    // connection that is still alive.
+    for (auto& p : waiters) {
+      if (p.header.client_id == h.client_id) {
+        p.fd = fd;
+        p.header = h;
+        return;
+      }
+    }
     waiters.push_back({fd, h, {}, {}});
     if (static_cast<int>(waiters.size()) < num_workers_) return;
     std::vector<PendingPush> release;

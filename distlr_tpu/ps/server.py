@@ -77,6 +77,7 @@ class ServerGroup:
         bind_any: bool = False,
         binary: str | None = None,
         max_dim: int | None = None,
+        via_chaos=None,
     ):
         build_native()
         self._binary = binary or server_binary()
@@ -85,6 +86,15 @@ class ServerGroup:
         self.dim = dim
         self.ports: list[int] = ports or []
         self.procs: list[subprocess.Popen] = []
+        # Fault-injection hook: a FaultPlan (distlr_tpu.chaos) interposes
+        # one ChaosFabric link per server rank between clients and the
+        # native processes — `hosts` then names the PROXIED ports, so
+        # every KVWorker riding this group sees the plan's faults.  The
+        # supervisor's per-rank probes (`_probe_rank`) keep addressing
+        # the real server ports: supervision is control-plane and must
+        # diagnose the chaos, not drown in it.
+        self._chaos_plan = via_chaos
+        self.chaos = None  # the live ChaosFabric once start() ran
         self._args = dict(
             lr=learning_rate,
             sync=int(sync),
@@ -101,7 +111,17 @@ class ServerGroup:
 
     @property
     def hosts(self) -> str:
-        """Client connection spec, server-rank order."""
+        """Client connection spec, server-rank order.  With a
+        ``via_chaos`` plan attached this names the fault-injecting
+        proxy ports — the drop-in property that puts every client
+        behind the plan; :attr:`direct_hosts` bypasses it."""
+        if self.chaos is not None:
+            return self.chaos.hosts
+        return self.direct_hosts
+
+    @property
+    def direct_hosts(self) -> str:
+        """The native server processes' own ports (chaos-free path)."""
         return ",".join(f"127.0.0.1:{p}" for p in self.ports)
 
     def key_range(self, rank: int) -> tuple[int, int]:
@@ -149,6 +169,13 @@ class ServerGroup:
                 raise
             self.procs.append(proc)
             self.ports.append(port)
+        if self._chaos_plan is not None and self.chaos is None:
+            from distlr_tpu.chaos.proxy import ChaosFabric  # noqa: PLC0415
+
+            # one proxy link per rank, targeting the REAL ports — a
+            # supervisor respawn reuses the original port, so the link
+            # stays valid across server deaths
+            self.chaos = ChaosFabric(self.direct_hosts, self._chaos_plan)
         return self
 
     def respawn(self, rank: int) -> bool:
@@ -194,7 +221,11 @@ class ServerGroup:
         only outcome for a dead worker is an eternal deadlock)."""
         from distlr_tpu.ps.client import KVWorker  # noqa: PLC0415  (cycle)
 
-        with KVWorker(self.hosts, self.dim, client_id=0xFFFF, timeout_ms=timeout_ms) as probe:
+        # direct_hosts: a health probe is control-plane — it must
+        # diagnose an injected partition (via the workers' counters),
+        # not time out inside it
+        with KVWorker(self.direct_hosts, self.dim, client_id=0xFFFF,
+                      timeout_ms=timeout_ms) as probe:
             stats = [probe.stats(rank) for rank in range(self.num_servers)]
         # Mirror the native counters into the registry: the server process
         # itself has no scrape surface, so a health probe doubles as its
@@ -225,6 +256,9 @@ class ServerGroup:
     def stop(self) -> None:
         with self._lock:
             self._stopped = True
+        if self.chaos is not None:
+            self.chaos.stop()
+            self.chaos = None
         for p in self.procs:
             if p.poll() is None:
                 p.terminate()

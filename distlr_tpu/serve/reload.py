@@ -36,6 +36,7 @@ from __future__ import annotations
 
 import random
 import threading
+import time
 
 import numpy as np
 
@@ -102,9 +103,12 @@ class LivePSWatcher:
     def __init__(self, hosts: str, dim: int, *, vals_per_key: int = 1,
                  chunk_rows: int = 1 << 16, timeout_ms: int = 10_000,
                  client_id: int | None = None, hot_tracker=None,
-                 min_coverage: float = 0.95, full_refresh_every: int = 10):
+                 min_coverage: float = 0.95, full_refresh_every: int = 10,
+                 retry=None):
         from distlr_tpu.ps import KVWorker  # noqa: PLC0415
 
+        self.hosts = hosts
+        self.dim = dim
         self.kv = KVWorker(
             hosts, dim,
             client_id=self.SERVE_CLIENT_ID if client_id is None else client_id,
@@ -112,7 +116,22 @@ class LivePSWatcher:
             # pull-only client: never votes in a BSP barrier, so the
             # async-group push shortcut flag is irrelevant either way
             sync_group=True,
+            # pulls are idempotent, so a RetryPolicy rides every op: a
+            # PS blip mid-poll costs a reconnect+retry INSIDE the poll
+            # instead of failing the cycle
+            retry=retry,
         )
+        # A failed poll leaves the native handle poisoned (every later
+        # op on that stream fails fast).  Without this flag the watcher
+        # would be dead FOREVER after one blip — the server would serve
+        # its last-good weights for the rest of its life while the PS
+        # recovered minutes ago.  Set on poll failure; the next poll
+        # reconnects first.
+        self._needs_reconnect = False
+        # re-verify initialization after every reconnect, not just at
+        # bootstrap: the outage we just rode out may have been a full PS
+        # replacement, and a freshly-spawned unseeded group serves zeros
+        self._check_init = True
         #: requested row width — the unit the engine's row keys and the
         #: hot tracker are stated in, even when the wire falls back to
         #: flat keys below
@@ -158,6 +177,37 @@ class LivePSWatcher:
                 + np.arange(r, dtype=np.uint64)[None, :]).reshape(-1)
 
     def poll(self):
+        if self._needs_reconnect:
+            # rebuild the poisoned handle before touching the wire; a
+            # still-down PS raises here and the reloader counts one more
+            # degraded cycle (last-good weights keep serving)
+            self.kv.reconnect()
+            self._needs_reconnect = False
+            self._check_init = True
+        try:
+            return self._poll_inner()
+        except OSError:
+            self._needs_reconnect = True
+            raise
+
+    def _poll_inner(self):
+        if self._check_init:
+            # Initialization gate — at bootstrap AND after every
+            # reconnect: an UNINITIALIZED rank answers pulls with zeros
+            # (HandlePull), and publishing those would swap garbage into
+            # the engine (at startup it would also make wait_for_weights
+            # "succeed" on a group no trainer has seeded; after an
+            # outage, the group we reconnected to may be a freshly
+            # respawned unseeded replacement).  EVERY rank must be
+            # seeded — one respawned-but-unseeded rank would zero its
+            # whole key slice in an otherwise-valid pull.  Report
+            # nothing instead — last-good weights keep serving, and the
+            # startup timeout diagnoses "reachable but uninitialized"
+            # via describe_unready.
+            if not all(self.kv.stats(r).get("initialized")
+                       for r in range(self.kv.num_servers)):
+                return None
+            self._check_init = False
         if self.hot_tracker is None:
             w = self._pull_full()
             self._version += 1
@@ -207,6 +257,35 @@ class LivePSWatcher:
         # contract says they finish on the weights they started with)
         return self._version, self._table.copy()
 
+    def describe_unready(self) -> str:
+        """One probe's diagnosis of WHY no weights came: "PS unreachable"
+        (nothing listening / partitioned) reads very differently from
+        "PS reachable but uninitialized" (servers up, no trainer init
+        push yet) — a 30 s silent timeout used to collapse both."""
+        from distlr_tpu.ps import KVWorker  # noqa: PLC0415
+
+        try:
+            # a FRESH short-lived probe: this watcher's own handle may be
+            # poisoned by the very failure being diagnosed
+            with KVWorker(self.hosts, self.dim,
+                          client_id=self.SERVE_CLIENT_ID,
+                          timeout_ms=2000) as probe:
+                # every rank, like the init gate: one unseeded rank is
+                # enough to withhold weights, so one must be enough to
+                # flip this diagnosis
+                unseeded = [r for r in range(probe.num_servers)
+                            if not probe.stats(r).get("initialized")]
+        except OSError as e:
+            return (f"PS unreachable at {self.hosts}: "
+                    f"{type(e).__name__}: {e}")
+        if unseeded:
+            return (f"PS reachable at {self.hosts} but UNINITIALIZED "
+                    f"(server rank(s) {unseeded} unseeded) — no trainer "
+                    "has pushed initial weights there yet (training job "
+                    "down, or a respawned rank awaiting re-seed?)")
+        return (f"PS reachable and initialized at {self.hosts}; polls "
+                "are failing for another reason (see reload warnings)")
+
     def stats(self) -> dict:
         rec = {
             "mode": "hot" if self.hot_tracker is not None else "full",
@@ -229,13 +308,19 @@ class HotReloader:
     Poll errors are counted and logged, never fatal — a serving tier must
     keep answering on its last good weights when the trainer's PS group
     restarts or the checkpoint dir is mid-write (both sources' errors are
-    transient by design).
+    transient by design).  While degraded, each failing poll cycle logs
+    ONE rate-limited warning (at most one per ``warn_every_s``), and
+    recovery logs once — silence used to be indistinguishable from
+    health.
 
     Each wait is drawn from ``interval_s * (1 ± jitter)`` so replicas
     launched together DESYNCHRONIZE instead of pulling the PS in
     lockstep forever (each reloader seeds its own RNG); ``jitter=0``
     restores the fixed cadence.
     """
+
+    #: floor between degraded-cycle warnings (seconds)
+    warn_every_s = 10.0
 
     def __init__(self, engine, source, *, interval_s: float = 1.0,
                  jitter: float = 0.2, _seed: int | None = None):
@@ -251,6 +336,8 @@ class HotReloader:
         self.reloads = 0
         self.errors = 0
         self.last_version = None
+        self._degraded_since: float | None = None
+        self._last_warn = float("-inf")
         self._stop = threading.Event()
         # serializes source.poll(): wait_for_weights (caller thread) can
         # overlap the background loop, and sources keep per-poll state
@@ -271,12 +358,48 @@ class HotReloader:
                 got = self.source.poll()
             except Exception as e:
                 self.errors += 1
-                if self.errors in (1, 10, 100):  # log decimated, not per poll
-                    log.warning("weight source poll failed (%d so far): %s",
-                                self.errors, e)
+                now = time.monotonic()
+                if self._degraded_since is None:
+                    self._degraded_since = now
+                # one warning per degraded poll cycle, rate-limited: a
+                # 100-cycle outage logs ~outage/warn_every_s lines, not
+                # 100 and not (the old behavior past error #100) zero
+                if now - self._last_warn >= self.warn_every_s:
+                    self._last_warn = now
+                    log.warning(
+                        "weight source poll DEGRADED for %.0fs (%d errors; "
+                        "serving last-good weights%s): %s",
+                        now - self._degraded_since, self.errors,
+                        f", version {self.last_version}"
+                        if self.last_version is not None else " — none yet",
+                        e)
                 return False
             if got is None:
+                if self._degraded_since is not None:
+                    # transport is back but the source still has nothing
+                    # to publish (e.g. the replacement PS group is up but
+                    # unseeded): that is NOT recovery — keep the degraded
+                    # clock running and keep warning, rate-limited, or
+                    # the log would read "recovered" while the engine
+                    # serves stale last-good weights indefinitely
+                    now = time.monotonic()
+                    if now - self._last_warn >= self.warn_every_s:
+                        self._last_warn = now
+                        log.warning(
+                            "weight source DEGRADED for %.0fs (%d errors; "
+                            "transport answered but published no weights "
+                            "— serving last-good%s)",
+                            now - self._degraded_since, self.errors,
+                            f", version {self.last_version}"
+                            if self.last_version is not None
+                            else ", none yet")
                 return False
+            if self._degraded_since is not None:
+                log.info("weight source recovered after %.0fs degraded "
+                         "(%d errors total)",
+                         time.monotonic() - self._degraded_since, self.errors)
+                self._degraded_since = None
+                self._last_warn = float("-inf")
             version, weights = got
             self.engine.set_weights(weights)
             self.reloads += 1
@@ -295,16 +418,25 @@ class HotReloader:
         """Block until the engine has weights (first successful poll) —
         the serve front-end's startup gate when no initial weights were
         given."""
-        import time  # noqa: PLC0415
-
         deadline = time.monotonic() + timeout_s
         while not self.engine.has_weights:
             if self._poll_once():
                 return
             if time.monotonic() >= deadline:
+                # Name WHY (satellite of ISSUE 5): "PS unreachable" and
+                # "PS reachable but uninitialized" both used to read as
+                # the same 30 s silence — the operator's next move is
+                # completely different for the two.
+                detail = ""
+                describe = getattr(self.source, "describe_unready", None)
+                if callable(describe):
+                    try:
+                        detail = f": {describe()}"
+                    except Exception as e:  # the diagnosis must not mask
+                        detail = f" (diagnosis failed: {e})"
                 raise TimeoutError(
                     f"no weights from {type(self.source).__name__} within "
-                    f"{timeout_s:.0f}s"
+                    f"{timeout_s:.0f}s{detail}"
                 )
             time.sleep(min(self.interval_s, 0.2))
 

@@ -42,7 +42,7 @@ from distlr_tpu.data.sharding import part_name
 from distlr_tpu.models import get_model
 from distlr_tpu.obs.registry import COUNT_BUCKETS, get_registry
 from distlr_tpu.obs.tracing import trace_phase
-from distlr_tpu.ps import KVWorker, ServerGroup
+from distlr_tpu.ps import KVWorker, RetryPolicy, ServerGroup
 from distlr_tpu.train.export import save_model_text
 from distlr_tpu.train.metrics import MetricsLogger, StepTimer
 from distlr_tpu.utils.logging import get_logger, log_eval_line
@@ -80,6 +80,14 @@ _STALENESS_PUSHES = get_registry().histogram(
 #: overhead is noise even for the ~1 ms localhost dense steps, while a
 #: multi-epoch run still banks thousands of histogram observations.
 _PUSHES_SAMPLE_INTERVAL_S = 0.05
+#: Cooldown before rebuilding a failed push-clock probe connection.  A
+#: probe failure used to disable the staleness histogram for the
+#: worker's lifetime — defensible when the only failures were dying
+#: groups, wrong once a chaos plan makes transient probe faults routine
+#: (the reset can land on the probe's frame instead of a training op's).
+#: One reconnect attempt per cooldown keeps observability self-healing
+#: without reconnect spam against a genuinely gone group.
+_PROBE_RETRY_COOLDOWN_S = 5.0
 _RESTARTS = get_registry().counter(
     "distlr_ps_worker_restarts_total",
     "PS workers rebuilt in place after a failure (max_restarts path)",
@@ -99,6 +107,28 @@ _PS_AUTO_CPU_THRESHOLD = 1 << 25
 # it): "auto" drops to plain numpy/BLAS.  f32 numpy is also CLOSER to
 # the f32 reference trajectory than the bf16-matmul jax step.
 _PS_AUTO_NUMPY_THRESHOLD = 1 << 20
+
+
+def ps_retry_policy(cfg: Config) -> RetryPolicy | None:
+    """The worker-side retry policy a config asks for, or None.
+
+    Retry sits BEFORE the restart/resume ladder: a transient transport
+    fault (reset, delay spike, short partition) costs an in-place
+    reconnect + re-issue inside :class:`KVWorker`; only when the policy
+    exhausts does the failure surface to ``run_ps_workers``'s
+    ``max_restarts`` / job-level checkpoint-resume machinery.  Async
+    only — a sync (BSP) round's failed push is the named straggler
+    signal and must stay fail-fast (the barrier cannot be retried
+    without mixing gradients across rounds).
+    """
+    if cfg.sync_mode or cfg.ps_retry_attempts <= 0:
+        return None
+    return RetryPolicy(
+        attempts=cfg.ps_retry_attempts,
+        backoff_ms=cfg.ps_retry_backoff_ms,
+        backoff_max_ms=cfg.ps_retry_backoff_max_ms,
+        deadline_s=cfg.ps_retry_deadline_s,
+    )
 
 
 def ps_compute_device(cfg: Config, rows: int | None = None):
@@ -435,6 +465,7 @@ class PSWorker:
         self.kv = KVWorker(
             hosts, self._param_dim(), client_id=rank,
             timeout_ms=cfg.ps_timeout_ms, sync_group=cfg.sync_mode,
+            retry=ps_retry_policy(cfg),
         )
         self._hosts = hosts
         # Push-clock probe for the pushes-behind staleness histogram
@@ -443,6 +474,7 @@ class PSWorker:
         # never overlap on one stream.  Lazy: first sample connects.
         self._push_probe: KVWorker | None = None
         self._push_probe_dead = cfg.sync_mode  # sync BSP: staleness is 0
+        self._probe_retry_at = 0.0  # monotonic; rebuild cooldown gate
         self._last_pushes_sample = float("-inf")
         self._staleness_pushes = _STALENESS_PUSHES.labels(rank=str(rank))
         self._train_iter = train_iter
@@ -494,7 +526,7 @@ class PSWorker:
         if now - self._last_pushes_sample < _PUSHES_SAMPLE_INTERVAL_S:
             return None
         if self._push_probe is None:
-            if self._push_probe_dead:
+            if self._push_probe_dead or time.monotonic() < self._probe_retry_at:
                 return None
             try:
                 self._push_probe = KVWorker(
@@ -503,9 +535,12 @@ class PSWorker:
                     sync_group=False,
                 )
             except Exception:
-                # No probe, no histogram — observability must never take
-                # the training loop down (or spin on reconnects).
-                self._push_probe_dead = True
+                # No probe, no histogram for now — observability must
+                # never take the training loop down or spin on
+                # reconnects; try again after the cooldown (a transient
+                # fault must not silence the staleness series forever)
+                self._probe_retry_at = (time.monotonic()
+                                        + _PROBE_RETRY_COOLDOWN_S)
                 return None
         try:
             clock = self._push_probe.global_pushes()
@@ -529,11 +564,14 @@ class PSWorker:
         self._staleness_pushes.observe(max(0.0, clock - pulled_clock))
 
     def _drop_push_probe(self) -> None:
-        # A failed probe usually means the server group is dying or
-        # gone; the worker's own ops will surface that.  Don't re-probe
-        # every batch — a restarted worker builds a fresh PSWorker.
+        # A failed probe may mean the group is dying — or, under a
+        # chaos plan, a routine transient fault that happened to land
+        # on the probe's connection.  Close it and rebuild after the
+        # cooldown rather than going dark for the worker's lifetime; a
+        # genuinely gone group just fails the rebuild once per cooldown
+        # while the worker's own ops surface the real outage.
         probe, self._push_probe = self._push_probe, None
-        self._push_probe_dead = True
+        self._probe_retry_at = time.monotonic() + _PROBE_RETRY_COOLDOWN_S
         if probe is not None:
             try:
                 probe.close()
@@ -1127,6 +1165,13 @@ def run_ps_local(cfg: Config, *, eval_fn=None, save=False, resume=False,
     two-sided §5.3 recovery story (pair it with ``max_restarts > 0`` so
     workers whose stream broke rejoin).
     """
+    via_chaos = None
+    if cfg.chaos_plan:
+        from distlr_tpu.chaos import load_plan  # noqa: PLC0415
+
+        # parsed HERE, before any server spawns: a malformed plan must
+        # fail the launch, not leak a fault-free run that looks chaotic
+        via_chaos = load_plan(cfg.chaos_plan, seed=cfg.chaos_seed)
     group = ServerGroup(
         cfg.num_servers,
         cfg.num_workers,
@@ -1134,6 +1179,7 @@ def run_ps_local(cfg: Config, *, eval_fn=None, save=False, resume=False,
         learning_rate=cfg.learning_rate,
         sync=cfg.sync_mode,
         last_gradient=bool(cfg.sync_last_gradient),
+        via_chaos=via_chaos,
     )
     with contextlib.ExitStack() as stack:
         stack.enter_context(group)

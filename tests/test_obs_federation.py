@@ -213,6 +213,63 @@ class TestMergeMath:
         assert "distlr_alert_ps_push_errors" in fired
         assert reg.get("distlr_fleet_push_error_rate").value == 0.5
 
+    def test_retry_rate_alert_fires_before_errors(self):
+        """A degraded-but-absorbed network: every op SUCCEEDS (no error
+        alert) yet the retry fraction crosses threshold — the chaos
+        layer's 'faults are costing retries' signal (ISSUE 5)."""
+        src = MetricsRegistry()
+        ops = src.counter("distlr_ps_client_ops_total", "", ("op", "status"))
+        ops.labels(op="pull", status="ok").inc(100)
+        retries = src.counter("distlr_ps_retries_total", "", ("op",))
+        retries.labels(op="pull").inc(20)  # 20% retried, all recovered
+        reg, _ = merge_snapshots({("w", 0): src.snapshot()})
+        alerts = evaluate_alerts(reg, thresholds=AlertThresholds(),
+                                 rank_ages={})
+        fired = {a["name"]: a for a in alerts if a["firing"]}
+        assert "distlr_alert_ps_retry_rate" in fired
+        assert "distlr_alert_ps_push_errors" not in fired
+        assert reg.get("distlr_fleet_ps_retry_rate").value == \
+            pytest.approx(0.2)
+        assert fired["distlr_alert_ps_retry_rate"]["labels"][
+            "threshold"] == "0.05"
+
+    def test_retry_rate_alert_silent_without_ops(self):
+        reg, _ = merge_snapshots({})
+        alerts = evaluate_alerts(reg, thresholds=AlertThresholds(),
+                                 rank_ages={})
+        retry = [a for a in alerts
+                 if a["name"] == "distlr_alert_ps_retry_rate"]
+        assert retry and not retry[0]["firing"]
+
+    def test_gave_up_alert_surfaces_abandoned_rank(self):
+        """distlr_ps_supervisor_events_total{event="gave-up"} > 0 must
+        derive distlr_alert_ps_gave_up=1 — a dead-and-abandoned server
+        rank becomes a firing alert in `launch top`, not just a counter
+        nobody watches (ISSUE 5 satellite)."""
+        src = MetricsRegistry()
+        ev = src.counter("distlr_ps_supervisor_events_total", "", ("event",))
+        ev.labels(event="respawned").inc(3)
+        ev.labels(event="gave-up").inc()
+        reg, _ = merge_snapshots({("ps-server", 0): src.snapshot()})
+        alerts = evaluate_alerts(reg, thresholds=AlertThresholds(),
+                                 rank_ages={})
+        fired = {a["name"]: a for a in alerts if a["firing"]}
+        assert "distlr_alert_ps_gave_up" in fired
+        assert fired["distlr_alert_ps_gave_up"]["labels"]["threshold"] == "0"
+        assert 'distlr_alert_ps_gave_up{threshold="0"} 1' \
+            in reg.prometheus_text()
+
+    def test_gave_up_alert_ignores_recovered_respawns(self):
+        src = MetricsRegistry()
+        ev = src.counter("distlr_ps_supervisor_events_total", "", ("event",))
+        ev.labels(event="respawned").inc(2)
+        ev.labels(event="reseeded").inc(2)
+        reg, _ = merge_snapshots({("ps-server", 0): src.snapshot()})
+        alerts = evaluate_alerts(reg, thresholds=AlertThresholds(),
+                                 rank_ages={})
+        gave = [a for a in alerts if a["name"] == "distlr_alert_ps_gave_up"]
+        assert gave and not gave[0]["firing"]
+
 
 class TestFleetScraper:
     def _fleet(self, tmp_path, n=2, **kw):

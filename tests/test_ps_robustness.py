@@ -127,6 +127,27 @@ class TestWorkerRestartRecovery:
             with pytest.raises(IOError, match="poisoned"):
                 kv.pull()
 
+    def test_reconnect_recovers_poisoned_connection_in_place(self, sync_group_of_two):
+        """The poisoned-connection dead end, fixed: reconnect() rebuilds
+        the native handle on the SAME object (dim/timeout/group-mode
+        preserved) and the next op completes — callers running their own
+        retry loop no longer have to recreate the KVWorker."""
+        with KVWorker(sync_group_of_two.hosts, 8, client_id=0, timeout_ms=300) as kv:
+            kv.push(np.zeros(8, np.float32))
+            with pytest.raises(PSTimeoutError):
+                kv.push(np.ones(8, np.float32))  # wedged barrier -> poisoned
+            with pytest.raises(IOError, match="poisoned"):
+                kv.pull()
+            kv.reconnect()
+            # a pull (never deferred) completes on the rebuilt handle
+            np.testing.assert_allclose(kv.pull(), np.zeros(8), rtol=1e-6)
+            # the receive timeout survived the rebuild: a second wedged
+            # push still times out fast instead of blocking forever
+            t0 = time.monotonic()
+            with pytest.raises(PSTimeoutError):
+                kv.push(np.ones(8, np.float32))
+            assert time.monotonic() - t0 < 5.0
+
 
 class TestAsyncUnaffected:
     def test_async_single_worker_never_needs_peers(self):
@@ -291,6 +312,35 @@ class TestInitIdempotence:
                 kv.wait(kv.push_init(np.full(4, 99.0, np.float32)))
                 np.testing.assert_allclose(kv.pull(), np.arange(4))
                 kv.shutdown_servers()
+
+    def test_barrier_revote_same_client_never_double_counts(self):
+        """One vote per CLIENT per generation, not per connection: a
+        worker that times out and re-votes (reconnect path) must not
+        hold two live votes.  Nothing orders the re-vote after the old
+        connection's DropConnection rollback (separate server reader
+        threads), so without client_id dedup the exit barrier could
+        release with a peer absent — and rank 0 would shut the servers
+        down under a still-training worker (found by the chaos soak)."""
+        import threading
+
+        with ServerGroup(1, 2, dim=8, sync=False) as g:
+            kv1 = KVWorker(g.hosts, 8, client_id=0, timeout_ms=400)
+            with pytest.raises(PSTimeoutError):
+                kv1.barrier(3)  # 1 of 2 votes: wedged
+            # same client re-votes on a SECOND live connection (the
+            # reconnect race shape: old vote not yet rolled back)
+            kv2 = KVWorker(g.hosts, 8, client_id=0, timeout_ms=400)
+            with pytest.raises(PSTimeoutError):
+                kv2.barrier(3)  # must still be 1 effective vote
+            # the real second worker arrives: NOW it releases, and the
+            # rank-0 reply routes to the replacement (live) connection
+            kv3 = KVWorker(g.hosts, 8, client_id=1, timeout_ms=5000)
+            t = threading.Thread(target=kv3.barrier, args=(3,))
+            t.start()
+            t.join(timeout=5)
+            assert not t.is_alive(), "barrier never released"
+            for kv in (kv1, kv2, kv3):
+                kv.close()
 
     def test_released_barrier_generation_passes_late_votes(self):
         from distlr_tpu.ps import KVWorker, ServerGroup
