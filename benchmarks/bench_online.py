@@ -1,0 +1,169 @@
+"""Online-learning loop benchmark: feedback join + continuous trainer.
+
+Measures the closed loop's two hot legs (``distlr_tpu/feedback``):
+
+* **join events/s** — scored requests + delayed labels through the
+  spool + :class:`LabelJoiner` (the serve-side cost of closing the
+  loop; pure host path, no PS);
+* **online examples/s** — the :class:`OnlineTrainer` consuming joined
+  shards against a REAL async FTRL server group (pull + numpy grad +
+  AdaBatch-accumulated push per batch — the loop's training leg).
+
+Prints ONE JSON line in ``bench.py``'s format (``metric`` / ``value`` /
+``unit`` + sub rows) so the loop's throughput joins the bench
+trajectory.  Runs on whatever backend is up — the legs are host-side,
+so there is no TPU/CPU scale cliff to mislabel; the backend is recorded
+anyway.
+
+Run: ``python benchmarks/bench_online.py [--quick|--smoke]``
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+sys.path.insert(0, REPO)
+
+
+def _resilience() -> dict:
+    from bench import resilience_snapshot  # noqa: PLC0415
+
+    return resilience_snapshot()
+
+
+def bench_join(n_events: int, d: int, nnz: int, tmp: str) -> dict:
+    """Scored+labeled event pairs through spool + joiner, events/s."""
+    import numpy as np  # noqa: PLC0415
+
+    from distlr_tpu.feedback import FeedbackSpool, LabelJoiner, SpoolRecord  # noqa: PLC0415
+
+    rng = np.random.default_rng(0)
+    lines = []
+    keyset = []
+    for _ in range(256):
+        cols = np.sort(rng.choice(d, size=nnz, replace=False))
+        lines.append(" ".join(f"{c + 1}:1" for c in cols))
+        keyset.append(cols.astype(np.uint64))
+    spool = FeedbackSpool(os.path.join(tmp, "spool"),
+                          capacity=max(1024, n_events // 4))
+    joiner = LabelJoiner(spool, os.path.join(tmp, "shards"),
+                         window_s=60.0, negative_rate=0.1,
+                         shard_records=1024)
+    t0 = time.perf_counter()
+    for i in range(n_events):
+        j = i % 256
+        joiner.scored(SpoolRecord(rid=f"r{i}", ts=float(i), line=lines[j],
+                                  score=0.5, version=1, keys=keyset[j]))
+        joiner.label(f"r{i}", i & 1, ts=float(i))
+    joiner.flush()
+    dt = time.perf_counter() - t0
+    spool.close()
+    return {
+        "events_per_sec": round(n_events / dt, 1),
+        "joined": joiner.joined,
+        "shards": joiner.shards_written,
+    }
+
+
+def bench_online_trainer(n_examples: int, d: int, batch: int,
+                         tmp: str) -> dict:
+    """Joined shards through the online trainer against a live async
+    FTRL group: examples/s including pull + grad + push."""
+    import numpy as np  # noqa: PLC0415
+
+    from distlr_tpu.config import Config  # noqa: PLC0415
+    from distlr_tpu.feedback import OnlineTrainer  # noqa: PLC0415
+    from distlr_tpu.ps import ServerGroup  # noqa: PLC0415
+
+    rng = np.random.default_rng(1)
+    shard_dir = os.path.join(tmp, "train-shards")
+    os.makedirs(shard_dir, exist_ok=True)
+    w_true = rng.normal(size=d).astype(np.float32)
+    per_shard = 1024
+    n_shards = max(1, n_examples // per_shard)
+    for s in range(n_shards):
+        with open(os.path.join(shard_dir, f"shard-{s:06d}.libsvm"), "w") as f:
+            for _ in range(per_shard):
+                cols = np.sort(rng.choice(d, size=8, replace=False))
+                x = np.zeros(d, np.float32)
+                x[cols] = 1.0
+                y = int(x @ w_true > 0)
+                f.write(f"{y} " + " ".join(f"{c + 1}:1" for c in cols) + "\n")
+    cfg = Config(model="sparse_lr", num_feature_dim=d, batch_size=batch,
+                 l2_c=0.0, sync_mode=False)
+    with ServerGroup(1, 1, d, sync=False, optimizer="ftrl",
+                     ftrl_alpha=0.5) as sg:
+        tr = OnlineTrainer(cfg, sg.hosts, shard_dir, accum_start=1,
+                           accum_growth=2.0, accum_growth_every=16,
+                           accum_max=16, poll_interval_s=0.05)
+        t0 = time.perf_counter()
+        stats = tr.run(max_shards=n_shards)
+        dt = time.perf_counter() - t0
+        tr.close()
+    return {
+        "examples_per_sec": round(stats["examples"] / dt, 1),
+        "examples": stats["examples"],
+        "pushes": stats["pushes"],
+        "accum_k_final": stats["accum_k"],
+        "shards": stats["shards_consumed"],
+    }
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true",
+                    help="tiny shapes (smoke/test mode)")
+    ap.add_argument("--smoke", action="store_true",
+                    help="alias of --quick (the `make -C benchmarks "
+                    "online-smoke` entry point)")
+    args = ap.parse_args()
+    quick = args.quick or args.smoke
+
+    if quick:
+        join_events, d_join = 2000, 4096
+        train_examples, d_train, batch = 2048, 4096, 256
+    else:
+        join_events, d_join = 200_000, 1_000_000
+        train_examples, d_train, batch = 65_536, 1_000_000, 512
+
+    import tempfile  # noqa: PLC0415
+
+    subs: dict[str, object] = {}
+    with tempfile.TemporaryDirectory(prefix="distlr-bench-online-") as tmp:
+        try:
+            subs["join"] = bench_join(join_events, d_join, 8, tmp)
+        except Exception as e:  # one leg must not cost the artifact
+            print(f"[bench_online] join leg failed: {e!r}", file=sys.stderr)
+            subs["join"] = None
+        try:
+            subs["online"] = bench_online_trainer(train_examples, d_train,
+                                                  batch, tmp)
+        except Exception as e:
+            print(f"[bench_online] trainer leg failed: {e!r}",
+                  file=sys.stderr)
+            subs["online"] = None
+
+    online = subs.get("online") or {}
+    row = {
+        "metric": (f"online-learning loop, sparse CTR D={d_train}: "
+                   "joined-shard examples/sec through the Hogwild online "
+                   "trainer (FTRL servers)"),
+        "value": online.get("examples_per_sec"),
+        "unit": "examples/sec",
+        "D": d_train,
+        "optimizer": "ftrl",
+        "resilience": _resilience(),
+        **subs,
+    }
+    print(json.dumps(row))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

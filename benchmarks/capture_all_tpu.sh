@@ -56,6 +56,17 @@ echo "== exp_blocked_batch.py (B sweep + G variants; best-effort) =="
 timeout 1800 python -u benchmarks/exp_blocked_batch.py \
   || echo "exp_blocked_batch failed (non-fatal; artifact not refreshed)"
 
+echo "== bench_online.py (closed-loop legs: join + online trainer; best-effort) =="
+# Feedback-loop throughput row (ISSUE 6): join events/s + online-trainer
+# examples/s against live FTRL servers.  Banks its distlr_feedback_*
+# counters into the window's fleet snapshots/ so the merged scrape below
+# carries the loop's series next to everything else.
+DISTLR_METRICS_SNAPSHOT="benchmarks/capture_logs/online_metrics.prom:benchmarks/capture_logs/fleet/snapshots/online-0.json" \
+  timeout 900 python -u benchmarks/bench_online.py \
+  > benchmarks/capture_logs/bench_online.json \
+  && echo "bench_online ok" \
+  || echo "bench_online failed (non-fatal; artifact not refreshed)"
+
 echo "== bank the fleet metrics snapshot (merged view; best-effort) =="
 # Federates every snapshot banked into the window's fleet dir (today:
 # bench.py; any --obs-run-dir'd process that joins a future window rides

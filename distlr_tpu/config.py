@@ -178,6 +178,18 @@ class Config:
     ps_retry_backoff_ms: float = 50.0
     ps_retry_backoff_max_ms: float = 2000.0
     ps_retry_deadline_s: float = 60.0
+    # Server-side optimizer applied to incoming gradient pushes.  "sgd"
+    # is the reference update (w -= lr * g).  "ftrl" is per-coordinate
+    # FTRL-Proximal (McMahan et al., KDD'13 — z/n accumulators, L1
+    # sparsification via ftrl_l1): the production sparse-CTR optimizer
+    # the online-learning loop (distlr_tpu.feedback) trains through.
+    # Incompatible with the Q1 sync_last_gradient quirk (an SGD parity
+    # artifact).
+    ps_optimizer: str = "sgd"         # sgd | ftrl
+    ftrl_alpha: float = 0.1           # per-coordinate learning-rate scale
+    ftrl_beta: float = 1.0            # learning-rate smoothing
+    ftrl_l1: float = 0.0              # L1 strength (sparsifies weights)
+    ftrl_l2: float = 0.0              # L2 strength
 
     # ---- chaos (distlr_tpu.chaos fault injection) ----
     # Path to a JSON fault plan: local `launch ps` runs interpose the
@@ -245,6 +257,32 @@ class Config:
     # Also force a full refresh every N polls (bounds cold-row staleness
     # to N poll intervals); 0 = only coverage-driven refreshes.
     serve_hot_full_every: int = 10
+
+    # ---- feedback loop (launch serve --feedback-* / launch online;
+    # distlr_tpu.feedback) ----
+    # Directory for the scored-request spool journal; setting it is what
+    # turns the feedback loop ON for `launch serve` (LABEL lines join,
+    # shards emit, the drift detector runs).  None = loop open.
+    feedback_spool_dir: str | None = None
+    # Where joined training shards are written (the online trainer's
+    # input).  None = "<feedback_spool_dir>/shards".
+    feedback_shard_dir: str | None = None
+    # Delayed-label join window: a request unlabeled for this long is
+    # resolved by the negative-sampling policy below.
+    feedback_window_s: float = 60.0
+    # Probability a never-labeled request is emitted as a label-0
+    # example at window expiry (the CTR no-click assumption); the rest
+    # are dropped.  0 = drop all never-labeled requests.
+    feedback_negative_rate: float = 0.1
+    # Joined examples per emitted training shard.
+    feedback_shard_records: int = 1024
+    # In-memory spool bound (requests awaiting a label); past it the
+    # least-important (hot-set statistics) oldest records are shed.
+    feedback_capacity: int = 100_000
+    # Drift detector: served scores per PSI comparison block, and the
+    # block-to-block PSI above which distlr_alert_score_drift fires.
+    feedback_drift_block: int = 512
+    feedback_drift_threshold: float = 0.25
 
     # ---- serving router (launch route / distlr_tpu.serve.router) ----
     # Port 0 = OS-assigned ephemeral (announced as "ROUTING host:port").
@@ -352,6 +390,22 @@ class Config:
                 f"ps_retry_deadline_s must be positive, "
                 f"got {self.ps_retry_deadline_s}"
             )
+        if self.ps_optimizer not in ("sgd", "ftrl"):
+            raise ValueError(
+                f"ps_optimizer must be sgd|ftrl, got {self.ps_optimizer!r}")
+        if self.ps_optimizer == "ftrl" and self.sync_last_gradient:
+            raise ValueError(
+                "ps_optimizer='ftrl' is incompatible with "
+                "sync_last_gradient (Q1 compat is an SGD parity quirk)"
+            )
+        if self.ftrl_alpha <= 0:
+            raise ValueError(
+                f"ftrl_alpha must be positive, got {self.ftrl_alpha}")
+        if self.ftrl_beta < 0 or self.ftrl_l1 < 0 or self.ftrl_l2 < 0:
+            raise ValueError(
+                "ftrl_beta/ftrl_l1/ftrl_l2 must be >= 0, got "
+                f"{self.ftrl_beta}/{self.ftrl_l1}/{self.ftrl_l2}"
+            )
         if self.chaos_seed is not None and not 0 <= self.chaos_seed < 1 << 64:
             raise ValueError(
                 "chaos_seed must be None (use the plan's seed) or in "
@@ -397,6 +451,24 @@ class Config:
                 "serve_hot_full_every must be >= 0 (0 = coverage-driven "
                 f"only), got {self.serve_hot_full_every}"
             )
+        if self.feedback_window_s <= 0:
+            raise ValueError(
+                f"feedback_window_s must be positive, got "
+                f"{self.feedback_window_s}")
+        if not 0.0 <= self.feedback_negative_rate <= 1.0:
+            raise ValueError(
+                "feedback_negative_rate must be in [0, 1], got "
+                f"{self.feedback_negative_rate}")
+        if self.feedback_shard_records <= 0 or self.feedback_capacity <= 0:
+            raise ValueError(
+                "feedback_shard_records and feedback_capacity must be "
+                f"positive, got {self.feedback_shard_records}/"
+                f"{self.feedback_capacity}")
+        if self.feedback_drift_block <= 0 or self.feedback_drift_threshold <= 0:
+            raise ValueError(
+                "feedback_drift_block and feedback_drift_threshold must "
+                f"be positive, got {self.feedback_drift_block}/"
+                f"{self.feedback_drift_threshold}")
         if not 0 <= self.route_port < 1 << 16:
             raise ValueError(
                 f"route_port must be in [0, 65536), got {self.route_port}")

@@ -1,0 +1,58 @@
+"""Online learning from served traffic — the closed loop.
+
+The subsystem that turns train-then-serve into one organism under load
+(ROADMAP "close the loop"): a scored request's journey back into
+training takes four steps, each its own module —
+
+* :mod:`~distlr_tpu.feedback.spool` — the serving front-end journals
+  every scored request (features, score, weights version, timestamp)
+  into a bounded on-disk spool with importance-aware retention (reusing
+  the hot-set tracker's key statistics);
+* :mod:`~distlr_tpu.feedback.join` — delayed labels (``LABEL <id> <y>``
+  protocol lines) join their spooled request within a configurable
+  window; never-labeled requests resolve through a negative-sampling
+  policy; joined examples emit as libsvm training shards;
+* :mod:`~distlr_tpu.feedback.online` — ``launch online``: a long-running
+  Hogwild worker consumes shards as they appear and pushes into the
+  same live PS the engines hot-reload from, with AdaBatch-style growing
+  local accumulation;
+* :mod:`~distlr_tpu.feedback.drift` — block-wise PSI over served scores
+  exported as ``distlr_alert_score_drift``: fires while the
+  distribution shifts (labels flipped, trainer adapting), clears once
+  it restabilizes.
+
+The server-side half is the FTRL-Proximal optimizer
+(``--ps-optimizer ftrl``, :mod:`distlr_tpu.ps`): per-coordinate z/n
+accumulators with L1 sparsification — the production sparse-CTR update
+the loop trains through.
+
+Lazy exports (PEP 562): the spool/join/drift pieces import jax-free;
+only :class:`OnlineTrainer` touches the training stack.
+"""
+
+import importlib
+
+_LAZY = {
+    "FeedbackSink": "distlr_tpu.feedback.sink",
+    "FeedbackSpool": "distlr_tpu.feedback.spool",
+    "SpoolRecord": "distlr_tpu.feedback.spool",
+    "per_row_keys": "distlr_tpu.feedback.spool",
+    "strip_label": "distlr_tpu.feedback.spool",
+    "LabelJoiner": "distlr_tpu.feedback.join",
+    "OnlineTrainer": "distlr_tpu.feedback.online",
+    "ScoreDriftDetector": "distlr_tpu.feedback.drift",
+    "psi": "distlr_tpu.feedback.drift",
+}
+
+__all__ = sorted(_LAZY)
+
+
+def __getattr__(name: str):
+    mod = _LAZY.get(name)
+    if mod is None:
+        raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+    return getattr(importlib.import_module(mod), name)
+
+
+def __dir__():
+    return sorted(set(globals()) | set(_LAZY))

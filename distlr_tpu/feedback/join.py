@@ -1,0 +1,233 @@
+"""Delayed-label join — turn scored requests + late labels into
+training shards.
+
+The second quarter of the online-learning loop: CTR-style labels
+(click / no-click) arrive seconds to minutes after the impression was
+scored, over the SAME serve line protocol (an additive ``LABEL <id>
+<y>`` line, extended exactly like STATS was).  The joiner matches each
+label against the spooled request within a configurable delay window
+and emits joined examples — ``<label> <features>`` lines in the
+repo's existing libsvm/ingest grammar — as rotating shard files the
+continuous trainer (:mod:`distlr_tpu.feedback.online`) consumes.
+
+Edge cases, all regression-tested (tests/test_feedback.py):
+
+* **label-before-request** — labels can outrun their impression across
+  a routed fleet; unknown ids are held in a bounded pending buffer and
+  joined the moment the request shows up.
+* **duplicate labels** — the first label wins; repeats for an
+  already-joined id are counted (``duplicate_label``), never re-emitted
+  (a double-counted click would bias the positive rate).
+* **expired window** — a request never labeled within ``window_s`` is
+  resolved by the NEGATIVE-SAMPLING policy: with probability
+  ``negative_rate`` it is emitted as a label-0 example (the standard
+  CTR assumption — no click within the window ≈ no click), otherwise
+  dropped.  ``negative_rate`` both caps the induced class skew and
+  keeps shard volume proportional to traffic, not to silence.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import re
+import threading
+import time
+
+from distlr_tpu.obs.registry import get_registry
+from distlr_tpu.feedback.spool import FeedbackSpool, SpoolRecord, drop
+
+_reg = get_registry()
+_JOINED = _reg.counter(
+    "distlr_feedback_joined_total",
+    "label events joined to their spooled request within the window",
+)
+_NEGATIVE = _reg.counter(
+    "distlr_feedback_negative_sampled_total",
+    "never-labeled requests emitted as negative (label-0) examples by "
+    "the negative-sampling policy at window expiry",
+)
+_JOIN_DELAY = _reg.histogram(
+    "distlr_feedback_join_delay_seconds",
+    "seconds between a request being scored and its label joining",
+)
+_SHARDS = _reg.counter(
+    "distlr_feedback_shards_written_total",
+    "joined training shards emitted for the online trainer",
+)
+_PENDING_LABELS = _reg.gauge(
+    "distlr_feedback_pending_labels",
+    "label events holding for a request that has not arrived yet",
+)
+
+
+class LabelJoiner:
+    """Join labels to spooled requests; emit libsvm training shards.
+
+    Thread-safe: request-handler threads call :meth:`scored` /
+    :meth:`label` while a ticker thread calls :meth:`tick`.  All spool
+    membership operations happen under the joiner lock — a request
+    check-then-spool and a label pop-then-hold that interleaved would
+    otherwise strand the label in the pending buffer while its request
+    ages out through negative sampling (the spool keeps its own lock
+    for direct callers, and never calls back into the joiner, so the
+    joiner→spool ordering cannot deadlock).
+    """
+
+    def __init__(self, spool: FeedbackSpool, out_dir: str, *,
+                 window_s: float = 60.0, negative_rate: float = 0.0,
+                 shard_records: int = 1024, max_pending_labels: int = 10_000,
+                 recent_joined: int = 8192, seed: int = 0):
+        if window_s <= 0:
+            raise ValueError(f"window_s must be positive, got {window_s}")
+        if not 0.0 <= negative_rate <= 1.0:
+            raise ValueError(
+                f"negative_rate must be in [0, 1], got {negative_rate}")
+        if shard_records <= 0:
+            raise ValueError(
+                f"shard_records must be positive, got {shard_records}")
+        self.spool = spool
+        self.out_dir = out_dir
+        os.makedirs(out_dir, exist_ok=True)
+        self.window_s = float(window_s)
+        self.negative_rate = float(negative_rate)
+        self.shard_records = int(shard_records)
+        self.max_pending_labels = int(max_pending_labels)
+        self._recent_cap = int(recent_joined)
+        self._rng = random.Random(seed)
+        self._lock = threading.Lock()
+        #: labels that arrived before their request: rid -> (label, ts)
+        self._pending: dict[str, tuple[int, float]] = {}
+        #: recently joined rids (bounded, insertion-ordered) — the
+        #: duplicate-label detector
+        self._recent: dict[str, None] = {}
+        self._buffer: list[str] = []
+        # resume AFTER any shard a previous run left behind (consumed or
+        # not) — restarting at 0 would os.replace-clobber unconsumed work
+        self._shard_seq = self._next_shard_seq(out_dir)
+        self.joined = 0
+        self.negatives = 0
+        self.shards_written = 0
+
+    @staticmethod
+    def _next_shard_seq(out_dir: str) -> int:
+        seq = 0
+        try:
+            names = os.listdir(out_dir)
+        except OSError:
+            return 0
+        for name in names:
+            m = re.match(r"shard-(\d+)\.libsvm(\.done)?$", name)
+            if m:
+                seq = max(seq, int(m.group(1)) + 1)
+        return seq
+
+    # -- ingest ------------------------------------------------------------
+    def scored(self, rec: SpoolRecord) -> None:
+        """A request was scored: spool it — or join it on the spot when
+        its label already arrived (label-before-request)."""
+        with self._lock:
+            pend = self._pending.pop(rid := rec.rid, None)
+            if pend is not None:
+                y, label_ts = pend
+                self._join_locked(rid, y, rec, now=label_ts)
+                _PENDING_LABELS.set(len(self._pending))
+                return
+            self.spool.add(rec)
+
+    def label(self, rid: str, y: int, *, ts: float | None = None) -> str:
+        """A label event arrived.  Returns the outcome: ``"joined"``,
+        ``"pending"`` (request not seen yet), or ``"duplicate"``."""
+        now = time.time() if ts is None else ts
+        y = int(y)
+        with self._lock:
+            rec = self.spool.pop(rid)
+            if rec is not None:
+                self._join_locked(rid, y, rec, now=now)
+                return "joined"
+            if rid in self._recent or rid in self._pending:
+                drop("duplicate_label")
+                return "duplicate"
+            if len(self._pending) >= self.max_pending_labels:
+                # bounded: shed the OLDEST held label (insertion order)
+                oldest = next(iter(self._pending))
+                del self._pending[oldest]
+                drop("unmatched_label")
+            self._pending[rid] = (y, now)
+            _PENDING_LABELS.set(len(self._pending))
+            return "pending"
+
+    # -- the join ----------------------------------------------------------
+    def _join_locked(self, rid: str, y: int, rec: SpoolRecord, *,
+                     now: float) -> None:
+        _JOIN_DELAY.observe(max(0.0, now - rec.ts))
+        self._remember_locked(rid)
+        self.joined += 1
+        _JOINED.inc()
+        self._emit_locked(y, rec.line)
+
+    def _remember_locked(self, rid: str) -> None:
+        self._recent[rid] = None
+        while len(self._recent) > self._recent_cap:
+            del self._recent[next(iter(self._recent))]
+
+    def _emit_locked(self, y: int, line: str) -> None:
+        self._buffer.append(f"{int(y)} {line}")
+        if len(self._buffer) >= self.shard_records:
+            self._write_shard_locked()
+
+    def _write_shard_locked(self) -> None:
+        if not self._buffer:
+            return
+        path = os.path.join(self.out_dir,
+                            f"shard-{self._shard_seq:06d}.libsvm")
+        tmp = f"{path}.tmp"
+        with open(tmp, "w") as f:
+            f.write("\n".join(self._buffer) + "\n")
+        os.replace(tmp, path)  # atomic: the trainer never sees a torn shard
+        self._shard_seq += 1
+        self._buffer.clear()
+        self.shards_written += 1
+        _SHARDS.inc()
+
+    # -- window expiry -----------------------------------------------------
+    def tick(self, now: float | None = None) -> None:
+        """Resolve everything older than the window: never-labeled
+        requests go through the negative-sampling policy; held labels
+        whose request never arrived are dropped as unmatched."""
+        now = time.time() if now is None else now
+        cutoff = now - self.window_s
+        with self._lock:
+            expired = self.spool.expire_before(cutoff)
+            for rec in expired:
+                self._remember_locked(rec.rid)
+                if self.negative_rate and self._rng.random() < self.negative_rate:
+                    self.negatives += 1
+                    _NEGATIVE.inc()
+                    self._emit_locked(0, rec.line)
+                else:
+                    drop("expired")
+            stale = [rid for rid, (_, ts) in self._pending.items()
+                     if ts < cutoff]
+            for rid in stale:
+                del self._pending[rid]
+                drop("unmatched_label")
+            if stale:
+                _PENDING_LABELS.set(len(self._pending))
+
+    def flush(self) -> None:
+        """Force out a partial shard (shutdown, tests, idle flushes)."""
+        with self._lock:
+            self._write_shard_locked()
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "joined": self.joined,
+                "negatives": self.negatives,
+                "pending_labels": len(self._pending),
+                "buffered": len(self._buffer),
+                "shards_written": self.shards_written,
+                "window_s": self.window_s,
+                "negative_rate": self.negative_rate,
+            }

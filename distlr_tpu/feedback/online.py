@@ -1,0 +1,308 @@
+"""Continuous (online) trainer — train on what you serve.
+
+The third quarter of the loop: a long-running Hogwild worker that
+consumes joined training shards (:mod:`distlr_tpu.feedback.join`
+output, the repo's libsvm grammar) AS THEY APPEAR and pushes gradients
+into the same live PS group the serving engines hot-reload from
+(``launch serve --ps-hosts``).  There are no epochs and no exit
+barrier: the trainer never votes in barriers, never retires the group,
+and tolerates the servers' other clients (the serving tier's pulls, a
+batch trainer's pushes) by construction — it is just one more async
+client of the Hogwild PS (the lock-free continuous-update regime of
+arXiv:1508.05711).
+
+AdaBatch-style local accumulation (arXiv:1712.02029): gradients are
+accumulated locally and pushed as a mean every ``k`` batches, with
+``k`` GROWING on a schedule (multiply by ``accum_growth`` every
+``accum_growth_every`` pushes, capped at ``accum_max``).  Early in the
+loop's life small ``k`` keeps served weights fresh; as the model
+stabilizes, growing ``k`` cuts push traffic — the same
+communication/freshness dial the ROADMAP's gradient-compression item
+turns, applied on the cadence axis.
+
+Requires an ASYNC server group: against a sync (BSP) group a lone
+online push would block forever in the deferred-reply barrier.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+import numpy as np
+
+from distlr_tpu.config import Config
+from distlr_tpu.obs.registry import get_registry
+from distlr_tpu.utils.logging import get_logger
+
+log = get_logger(__name__)
+
+_reg = get_registry()
+_SHARDS_CONSUMED = _reg.counter(
+    "distlr_feedback_shards_consumed_total",
+    "joined training shards consumed by the online trainer",
+)
+_EXAMPLES = _reg.counter(
+    "distlr_feedback_examples_trained_total",
+    "joined examples the online trainer computed gradients over",
+)
+_PUSHES = _reg.counter(
+    "distlr_feedback_online_pushes_total",
+    "gradient pushes issued by the online trainer (after AdaBatch "
+    "local accumulation)",
+)
+_LAG = _reg.gauge(
+    "distlr_feedback_shard_lag",
+    "joined shards written but not yet consumed by the online trainer "
+    "(the loop's freshness debt)",
+)
+_ACCUM_K = _reg.gauge(
+    "distlr_feedback_accum_batches",
+    "current AdaBatch accumulation span: batches per push",
+)
+
+#: models the online loop supports (dense full-vector pushes, or keyed
+#: sparse pushes); blocked/sparse-softmax land with their trainer loops
+_SUPPORTED = ("binary_lr", "softmax", "sparse_lr")
+
+
+class OnlineTrainer:
+    """Shard-watching Hogwild worker over a live async PS group."""
+
+    #: client id: out of the way of batch-trainer ranks (0..) and the
+    #: serving pull client (4095)
+    ONLINE_CLIENT_ID = 0x0E00
+
+    def __init__(self, cfg: Config, hosts: str, shard_dir: str, *,
+                 accum_start: int = 1, accum_growth: float = 2.0,
+                 accum_growth_every: int = 32, accum_max: int = 64,
+                 poll_interval_s: float = 0.5, idle_flush_s: float = 2.0,
+                 client_id: int | None = None, seed_init: bool = True):
+        if cfg.model not in _SUPPORTED:
+            raise ValueError(
+                f"online training supports {_SUPPORTED}, got {cfg.model!r}")
+        if accum_start < 1 or accum_max < accum_start:
+            raise ValueError(
+                "need 1 <= accum_start <= accum_max, got "
+                f"{accum_start}/{accum_max}")
+        if accum_growth < 1.0:
+            raise ValueError(
+                f"accum_growth must be >= 1, got {accum_growth}")
+        if accum_growth_every <= 0:
+            raise ValueError(
+                f"accum_growth_every must be positive, got "
+                f"{accum_growth_every}")
+        # imported here, not at module top: these helpers live with the
+        # batch PS trainer (the asked-for reuse), which imports jax —
+        # acceptable for a trainer process, deferred for everyone else
+        from distlr_tpu.ps import KVWorker, RetryPolicy  # noqa: PLC0415
+        from distlr_tpu.train.ps_trainer import ps_param_dim  # noqa: PLC0415
+
+        self.cfg = cfg
+        self.shard_dir = shard_dir
+        self.dim = ps_param_dim(cfg)
+        self.poll_interval_s = float(poll_interval_s)
+        self.idle_flush_s = float(idle_flush_s)
+        retry = None
+        if cfg.ps_retry_attempts > 0:
+            retry = RetryPolicy(
+                attempts=cfg.ps_retry_attempts,
+                backoff_ms=cfg.ps_retry_backoff_ms,
+                backoff_max_ms=cfg.ps_retry_backoff_max_ms,
+                deadline_s=cfg.ps_retry_deadline_s,
+            )
+        self.kv = KVWorker(
+            hosts, self.dim,
+            client_id=self.ONLINE_CLIENT_ID if client_id is None
+            else client_id,
+            timeout_ms=cfg.ps_timeout_ms,
+            sync_group=False,  # Hogwild client: no barriers, keyed shortcut
+            retry=retry,
+        )
+        if seed_init:
+            # idempotent: seeds an unseeded group with zeros (FTRL's
+            # natural origin), no-ops against live weights — so the
+            # online trainer can be the loop's FIRST trainer or join an
+            # already-trained group without a flag
+            self.kv.push_init(np.zeros(self.dim, np.float32))
+        self.accum_k = int(accum_start)
+        self.accum_growth = float(accum_growth)
+        self.accum_growth_every = int(accum_growth_every)
+        self.accum_max = int(accum_max)
+        _ACCUM_K.set(self.accum_k)
+        self._g_acc = np.zeros(self.dim, np.float32)
+        self._acc_batches = 0
+        self._w_cache: np.ndarray | None = None
+        self.shards_consumed = 0
+        self.examples = 0
+        self.pushes = 0
+        self._num_classes = (cfg.num_classes if cfg.model == "softmax"
+                             else None)
+
+    # -- gradient plumbing -------------------------------------------------
+    def _dense_batch(self, X, y) -> None:
+        from distlr_tpu.train.ps_trainer import _np_dense_grad  # noqa: PLC0415
+
+        cfg = self.cfg
+        if self._acc_batches == 0:
+            # pull once per accumulation span: batches within a span ride
+            # the same weights (AdaBatch local accumulation; the span is
+            # the self-staleness bound)
+            self._w_cache = self.kv.pull()
+        K = self._num_classes
+        w = (self._w_cache.reshape(cfg.num_feature_dim, K) if K
+             else self._w_cache)
+        mask = np.ones(len(y), np.float32)
+        g = _np_dense_grad(w, X, y, mask, cfg.l2_c,
+                           bool(cfg.l2_scale_by_batch), K)
+        self._g_acc += np.asarray(g, np.float32).reshape(-1)
+        self._acc_batches += 1
+        self.examples += len(y)
+        _EXAMPLES.inc(len(y))
+
+    def _sparse_batch(self, pc, pv, y) -> None:
+        from distlr_tpu.train.ps_trainer import _sparse_batch_grad  # noqa: PLC0415
+
+        cfg = self.cfg
+        ub, pos = np.unique(pc, return_inverse=True)
+        keys = ub.astype(np.uint64)
+        w_u = self.kv.pull(keys=keys)
+        mask = np.ones(len(y), np.float32)
+        g_u = _sparse_batch_grad(w_u, pos.reshape(pc.shape), pv, y, mask,
+                                 cfg.l2_c, bool(cfg.l2_scale_by_batch))
+        self._g_acc[ub] += g_u
+        self._acc_batches += 1
+        self.examples += len(y)
+        _EXAMPLES.inc(len(y))
+
+    def _flush_push(self) -> None:
+        """Push the accumulated MEAN gradient (one Hogwild update of
+        batch size span*B) and advance the AdaBatch schedule."""
+        if self._acc_batches == 0:
+            return
+        g = self._g_acc / np.float32(self._acc_batches)
+        if self.cfg.model == "sparse_lr":
+            keys = np.flatnonzero(g).astype(np.uint64)
+            if keys.size:
+                self.kv.wait(self.kv.push(g[keys.astype(np.int64)],
+                                          keys=keys))
+        else:
+            self.kv.wait(self.kv.push(g))
+        self._g_acc[:] = 0.0
+        self._acc_batches = 0
+        self._w_cache = None
+        self.pushes += 1
+        _PUSHES.inc()
+        if self.pushes % self.accum_growth_every == 0:
+            grown = max(self.accum_k + 1,
+                        int(round(self.accum_k * self.accum_growth)))
+            self.accum_k = min(self.accum_max, grown)
+            _ACCUM_K.set(self.accum_k)
+
+    # -- shard consumption -------------------------------------------------
+    def _scan(self) -> list[str]:
+        try:
+            names = sorted(os.listdir(self.shard_dir))
+        except OSError:
+            return []
+        return [os.path.join(self.shard_dir, n) for n in names
+                if n.startswith("shard-") and n.endswith(".libsvm")]
+
+    def consume_shard(self, path: str) -> int:
+        """Train over one joined shard; returns examples consumed."""
+        from distlr_tpu.data.hashing import csr_to_padded_coo  # noqa: PLC0415
+        from distlr_tpu.data.libsvm import parse_libsvm_lines  # noqa: PLC0415
+
+        with open(path) as f:
+            lines = [ln for ln in f.read().splitlines() if ln.strip()]
+        if not lines:
+            return 0
+        cfg = self.cfg
+        B = cfg.batch_size if cfg.batch_size > 0 else 256
+        n = 0
+        if cfg.model == "sparse_lr":
+            (row_ptr, cols, vals), y = parse_libsvm_lines(
+                lines, cfg.num_feature_dim, dense=False)
+            pc, pv = csr_to_padded_coo(row_ptr, cols, vals,
+                                       nnz_max=cfg.nnz_max)
+            for lo in range(0, len(y), B):
+                self._sparse_batch(pc[lo:lo + B], pv[lo:lo + B],
+                                   y[lo:lo + B])
+                if self._acc_batches >= self.accum_k:
+                    self._flush_push()
+                n += len(y[lo:lo + B])
+        else:
+            X, y = parse_libsvm_lines(
+                lines, cfg.num_feature_dim, dense=True,
+                multiclass=self._num_classes is not None)
+            for lo in range(0, len(y), B):
+                self._dense_batch(X[lo:lo + B], y[lo:lo + B])
+                if self._acc_batches >= self.accum_k:
+                    self._flush_push()
+                n += len(y[lo:lo + B])
+        self.shards_consumed += 1
+        _SHARDS_CONSUMED.inc()
+        return n
+
+    # -- the loop ----------------------------------------------------------
+    def run(self, *, stop: threading.Event | None = None,
+            max_shards: int = 0, idle_exit_s: float | None = None) -> dict:
+        """Consume shards until ``stop`` is set, ``max_shards`` shards
+        were trained (0 = unbounded), or nothing new appeared for
+        ``idle_exit_s`` seconds (None = wait forever) — the latter two
+        are the scriptable exits benches and tests use; production runs
+        pass neither and live as long as the serving tier."""
+        stop = stop or threading.Event()
+        idle_since = time.monotonic()
+        consumed_this_run = 0
+        while not stop.is_set():
+            pending = self._scan()
+            _LAG.set(len(pending))
+            if not pending:
+                now = time.monotonic()
+                if (self._acc_batches
+                        and now - idle_since >= self.idle_flush_s):
+                    # traffic lull: a partial accumulation span must not
+                    # strand its gradients locally forever
+                    self._flush_push()
+                if idle_exit_s is not None and now - idle_since >= idle_exit_s:
+                    break
+                stop.wait(self.poll_interval_s)
+                continue
+            for path in pending:
+                if stop.is_set():
+                    break
+                n = self.consume_shard(path)
+                # consumed shards step aside (audit trail kept), so the
+                # scan and the lag gauge only ever see fresh work
+                os.replace(path, path + ".done")
+                idle_since = time.monotonic()
+                consumed_this_run += 1
+                log.info("online: consumed %s (%d examples, k=%d, "
+                         "%d pushes)", os.path.basename(path), n,
+                         self.accum_k, self.pushes)
+                if max_shards and consumed_this_run >= max_shards:
+                    self._flush_push()
+                    _LAG.set(len(self._scan()))
+                    return self.stats()
+        self._flush_push()
+        return self.stats()
+
+    def stats(self) -> dict:
+        return {
+            "shards_consumed": self.shards_consumed,
+            "examples": self.examples,
+            "pushes": self.pushes,
+            "accum_k": self.accum_k,
+            "pending": len(self._scan()),
+        }
+
+    def close(self) -> None:
+        self.kv.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

@@ -1,0 +1,133 @@
+"""FeedbackSink — the serving front-end's one-object handle on the
+feedback loop.
+
+Bundles the spool (:mod:`~distlr_tpu.feedback.spool`), the label joiner
+(:mod:`~distlr_tpu.feedback.join`) and the drift detector
+(:mod:`~distlr_tpu.feedback.drift`) behind the two calls the
+:class:`~distlr_tpu.serve.server.ScoringServer` makes per request:
+
+* :meth:`scored` — after a batch is scored: journal each row (id,
+  feature line, score, weights version, touched keys) and feed the
+  drift detector.
+* :meth:`label` — on a ``LABEL <id> <y>`` protocol line.
+
+A daemon ticker drives window expiry (negative sampling) and flushes
+partial shards after ``idle_flush_s`` without new joins, so a
+low-traffic tail still reaches the online trainer instead of sitting
+in a forever-partial buffer.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+
+from distlr_tpu.feedback.drift import ScoreDriftDetector
+from distlr_tpu.feedback.join import LabelJoiner
+from distlr_tpu.feedback.spool import (
+    FeedbackSpool,
+    SpoolRecord,
+    per_row_keys,
+    strip_label,
+)
+
+
+class FeedbackSink:
+    """Spool + joiner + drift detector behind the serve front-end."""
+
+    def __init__(self, spool_dir: str, shard_dir: str, *,
+                 model: str = "binary_lr", capacity: int = 100_000,
+                 window_s: float = 60.0, negative_rate: float = 0.0,
+                 shard_records: int = 1024, tracker=None,
+                 drift_block: int = 512, drift_threshold: float = 0.25,
+                 tick_interval_s: float = 0.5, idle_flush_s: float = 5.0,
+                 seed: int = 0):
+        self.model = model
+        self.spool = FeedbackSpool(spool_dir, capacity=capacity,
+                                   tracker=tracker)
+        self.joiner = LabelJoiner(self.spool, shard_dir, window_s=window_s,
+                                  negative_rate=negative_rate,
+                                  shard_records=shard_records, seed=seed)
+        self.drift = ScoreDriftDetector(block=drift_block,
+                                        threshold=drift_threshold)
+        self.tick_interval_s = float(tick_interval_s)
+        self.idle_flush_s = float(idle_flush_s)
+        self._auto_ids = itertools.count()
+        self._last_emit_seen = 0
+        self._last_emit_at = time.monotonic()
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    # -- serve-side entry points ------------------------------------------
+    def scored(self, lines: list[str], rows: tuple, scores, *,
+               version: int, ids: list[str | None] | None = None) -> None:
+        """Journal one scored batch.  ``lines`` are the raw request
+        lines (label token optional — stripped here), ``rows`` the
+        engine's encoded feature leaves for the SAME batch, ``scores``
+        the served scores.  ``ids[i] = None`` auto-assigns an id; such
+        rows can never be positively labeled but still feed the drift
+        detector and the negative-sampling pool."""
+        now = time.time()
+        keys = per_row_keys(self.model, rows)
+        for i, line in enumerate(lines):
+            rid = ids[i] if ids is not None and ids[i] is not None \
+                else f"auto-{next(self._auto_ids)}"
+            self.joiner.scored(SpoolRecord(
+                rid=str(rid), ts=now, line=strip_label(line),
+                score=float(scores[i]), version=int(version),
+                keys=keys[i] if i < len(keys) else None,
+            ))
+        self.drift.observe(scores)
+
+    def label(self, rid: str, y: int) -> str:
+        """Outcome string (``joined`` / ``pending`` / ``duplicate``)."""
+        return self.joiner.label(str(rid), int(y))
+
+    # -- ticker ------------------------------------------------------------
+    def _run(self) -> None:
+        while not self._stop.wait(self.tick_interval_s):
+            self.tick()
+
+    def tick(self, now: float | None = None) -> None:
+        self.joiner.tick(now)
+        emitted = self.joiner.joined + self.joiner.negatives
+        mono = time.monotonic()
+        if emitted != self._last_emit_seen:
+            self._last_emit_seen = emitted
+            self._last_emit_at = mono
+        elif (self.joiner.stats()["buffered"]
+              and mono - self._last_emit_at >= self.idle_flush_s):
+            # quiet tail: push the partial shard out so the online
+            # trainer sees the last few joins of a traffic burst
+            self.joiner.flush()
+            self._last_emit_at = mono
+
+    def start(self) -> "FeedbackSink":
+        if self._thread is None or not self._thread.is_alive():
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=self._run, daemon=True, name="distlr-feedback-tick")
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+        self.joiner.flush()
+        self.spool.close()
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+
+    def stats(self) -> dict:
+        return {
+            "spool": self.spool.stats(),
+            "join": self.joiner.stats(),
+            "drift": self.drift.stats(),
+        }

@@ -1,0 +1,277 @@
+"""Request-log spool — the serving tier's journal of what it scored.
+
+The first quarter of the online-learning loop (ROADMAP "close the
+loop"): every scored request is journaled — feature line, served score,
+the engine weights version that produced it, and a timestamp — so a
+label arriving seconds-to-minutes later can be joined back to the exact
+impression it describes (:mod:`distlr_tpu.feedback.join`).
+
+Two bounds, because production request streams are unbounded:
+
+* **on disk** — an append-only JSONL journal rotated into segments of
+  ``segment_records`` lines, keeping at most ``max_segments`` segments
+  (oldest deleted first).  The journal is the audit trail; the join
+  works from memory.
+* **in memory** — at most ``capacity`` records await their label.  Past
+  it, eviction is **importance-aware**: the candidate window (the oldest
+  ``evict_scan`` records) is scored by the serving
+  :class:`~distlr_tpu.serve.hotset.HotSetTracker`'s decayed key counts —
+  the same statistics hot-row reload already maintains — and the LEAST
+  important record is dropped.  Under pressure the spool sheds requests
+  that touched only cold rows (whose labels move the model least) and
+  keeps hot-row impressions joinable.  Without a tracker, plain FIFO.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import re
+import threading
+import time
+
+import numpy as np
+
+from distlr_tpu.obs.registry import get_registry
+
+_reg = get_registry()
+_SPOOLED = _reg.counter(
+    "distlr_feedback_spooled_total",
+    "scored requests journaled into the feedback spool",
+)
+_SPOOL_SIZE = _reg.gauge(
+    "distlr_feedback_spool_size",
+    "spooled requests currently awaiting a label",
+)
+_DROPPED = _reg.counter(
+    "distlr_feedback_dropped_total",
+    "feedback-loop records dropped, by reason (capacity = spool "
+    "eviction under pressure; expired = window elapsed and the "
+    "negative-sampling coin came up drop; duplicate_label = a label "
+    "for an already-joined request; unmatched_label = a label whose "
+    "request was never seen within the window)",
+    labelnames=("reason",),
+)
+
+
+def drop(reason: str, n: int = 1) -> None:
+    """Count a feedback-loop drop (shared with the joiner so every
+    discarded record lands in ONE series, split by reason)."""
+    _DROPPED.labels(reason=reason).inc(n)
+
+
+@dataclasses.dataclass
+class SpoolRecord:
+    """One scored request awaiting its label."""
+
+    rid: str                   # request id (caller-supplied or auto)
+    ts: float                  # wall-clock seconds at scoring time
+    line: str                  # feature line, libsvm grammar, NO label
+    score: float               # served score (P(y=1) / max class prob)
+    version: int               # engine weights version that scored it
+    #: PS row keys the request touched (importance input); None = unknown
+    keys: np.ndarray | None = None
+
+
+class FeedbackSpool:
+    """Bounded spool of scored requests, journaled to disk.
+
+    Thread-safe: request-handler threads ``add`` while the joiner's
+    ticker expires and label lines ``pop``.
+    """
+
+    def __init__(self, directory: str, *, capacity: int = 100_000,
+                 tracker=None, segment_records: int = 10_000,
+                 max_segments: int = 8, evict_scan: int = 16):
+        if capacity <= 0:
+            raise ValueError(f"capacity must be positive, got {capacity}")
+        if segment_records <= 0 or max_segments <= 0:
+            raise ValueError(
+                "segment_records and max_segments must be positive, got "
+                f"{segment_records}/{max_segments}")
+        self.directory = directory
+        os.makedirs(directory, exist_ok=True)
+        self.capacity = int(capacity)
+        self.tracker = tracker
+        self.segment_records = int(segment_records)
+        self.max_segments = int(max_segments)
+        self.evict_scan = max(int(evict_scan), 1)
+        self._lock = threading.Lock()
+        #: insertion-ordered (dict preserves it): front = oldest
+        self._records: dict[str, SpoolRecord] = {}
+        # resume the journal AFTER any segment a previous run left
+        # behind: restarting at 0 would mix two runs' records into one
+        # segment and leave the old run's tail outside the rotation
+        # window (the max_segments disk bound) indefinitely
+        existing = sorted(
+            int(m.group(1)) for name in os.listdir(directory)
+            if (m := re.match(r"spool-(\d+)\.jsonl$", name)))
+        self._seg_index = existing[-1] + 1 if existing else 0
+        for idx in existing:
+            if idx <= self._seg_index - self.max_segments:
+                try:
+                    os.unlink(self._seg_path(idx))
+                except OSError:
+                    pass
+        self._seg_count = 0
+        self._seg_file = None
+        self.spooled = 0
+        self.evicted = 0
+
+    # -- journal ----------------------------------------------------------
+    def _seg_path(self, index: int) -> str:
+        return os.path.join(self.directory, f"spool-{index:06d}.jsonl")
+
+    def _journal_locked(self, rec: SpoolRecord) -> None:
+        if self._seg_file is None or self._seg_count >= self.segment_records:
+            if self._seg_file is not None:
+                self._seg_file.close()
+                self._seg_index += 1
+            self._seg_file = open(self._seg_path(self._seg_index), "a")
+            self._seg_count = 0
+            old = self._seg_index - self.max_segments
+            if old >= 0:
+                try:
+                    os.unlink(self._seg_path(old))
+                except OSError:
+                    pass  # already rotated away (restart) — bound holds
+        self._seg_file.write(json.dumps({
+            "id": rec.rid, "ts": round(rec.ts, 3), "line": rec.line,
+            "score": round(rec.score, 6), "version": rec.version,
+        }) + "\n")
+        self._seg_count += 1
+
+    # -- importance -------------------------------------------------------
+    def _importances(self, window: list[SpoolRecord]) -> list[float]:
+        """Tracker-count mass of each record's touched rows — the same
+        decayed statistics hot-row reload retains rows by.  One
+        ``importance_many`` call: the tracker lock (contended by the
+        scoring hot path's ``observe``) is taken once per eviction, not
+        once per candidate."""
+        if self.tracker is None:
+            return [0.0] * len(window)
+        many = getattr(self.tracker, "importance_many", None)
+        if many is not None:
+            return many([rec.keys for rec in window])
+        # tracker-like object without the batched API
+        return [0.0 if rec.keys is None or not len(rec.keys)
+                else float(self.tracker.importance(rec.keys))
+                for rec in window]
+
+    # -- ingest / claim ---------------------------------------------------
+    def add(self, rec: SpoolRecord) -> bool:
+        """Spool one scored request.  Returns False when the record was
+        immediately evicted (it WAS journaled — the audit trail is
+        append-only; only the joinable working set is bounded)."""
+        kept = True
+        with self._lock:
+            self._journal_locked(rec)
+            self._records[rec.rid] = rec
+            self.spooled += 1
+            if len(self._records) > self.capacity:
+                evicted = self._evict_one_locked()
+                kept = evicted != rec.rid
+            size = len(self._records)
+        _SPOOLED.inc()
+        _SPOOL_SIZE.set(size)
+        return kept
+
+    def _evict_one_locked(self) -> str:
+        """Drop the least-important record among the oldest
+        ``evict_scan`` (importance-aware retention; FIFO without a
+        tracker since all importances tie at 0 and the scan keeps
+        insertion order)."""
+        it = iter(self._records.values())
+        window = []
+        for _ in range(self.evict_scan):
+            try:
+                window.append(next(it))
+            except StopIteration:
+                break
+        scores = self._importances(window)
+        victim = window[min(range(len(window)), key=scores.__getitem__)]
+        del self._records[victim.rid]
+        self.evicted += 1
+        drop("capacity")
+        return victim.rid
+
+    def pop(self, rid: str) -> SpoolRecord | None:
+        """Claim a spooled request by id (the label-join hit path)."""
+        with self._lock:
+            rec = self._records.pop(rid, None)
+            size = len(self._records)
+        _SPOOL_SIZE.set(size)
+        return rec
+
+    def expire_before(self, cutoff_ts: float) -> list[SpoolRecord]:
+        """Remove and return every record scored before ``cutoff_ts``
+        (the joiner's never-labeled set — negative-sampling input).
+        Records are insertion-ordered, but eviction punches holes, so
+        the scan walks until the first fresh record."""
+        out = []
+        with self._lock:
+            for rid, rec in list(self._records.items()):
+                if rec.ts >= cutoff_ts:
+                    break
+                out.append(self._records.pop(rid))
+            size = len(self._records)
+        _SPOOL_SIZE.set(size)
+        return out
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._records)
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "size": len(self._records),
+                "capacity": self.capacity,
+                "spooled": self.spooled,
+                "evicted": self.evicted,
+                "journal_segment": self._seg_index,
+            }
+
+    def close(self) -> None:
+        with self._lock:
+            if self._seg_file is not None:
+                self._seg_file.close()
+                self._seg_file = None
+
+
+def per_row_keys(model: str, rows: tuple, *, max_keys: int = 128
+                 ) -> list[np.ndarray]:
+    """PS row keys touched by EACH request row (the per-record twin of
+    :meth:`distlr_tpu.serve.engine.ScoringEngine.row_keys`, which is
+    batch-level): sparse/blocked families read their id leaf per row,
+    dense rows their nonzero columns.  Capped at ``max_keys`` per row —
+    importance needs a sample, not an index."""
+    first = np.asarray(rows[0])
+    out = []
+    if model in ("sparse_lr", "sparse_softmax", "blocked_lr"):
+        for i in range(first.shape[0]):
+            k = np.unique(first[i].astype(np.int64)).astype(np.uint64)
+            out.append(k[:max_keys])
+        return out
+    for i in range(first.shape[0]):
+        k = np.flatnonzero(first[i] != 0).astype(np.uint64)
+        out.append(k[:max_keys])
+    return out
+
+
+def strip_label(line: str) -> str:
+    """The feature part of a request line: drop a leading label token
+    when present (same rule the engine's ``encode_lines`` normalizes
+    by — a first token without ``:`` is a label)."""
+    line = line.strip()
+    if not line:
+        return line
+    first = line.split(None, 1)
+    if ":" in first[0]:
+        return line
+    return first[1] if len(first) > 1 else ""
+
+
+def now_ts() -> float:
+    return time.time()

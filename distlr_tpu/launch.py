@@ -27,6 +27,7 @@ from __future__ import annotations
 
 import argparse
 import contextlib
+import os
 import sys
 
 from distlr_tpu.config import Config
@@ -73,8 +74,11 @@ def _obs_scope(cfg: Config, role: str | None = None, rank: int = 0):
         if cfg.obs_run_dir and role is not None:
             from distlr_tpu.obs import write_endpoint  # noqa: PLC0415
 
-            endpoint = write_endpoint(cfg.obs_run_dir, role, rank,
-                                      server.host, server.port)
+            # first dir when several were given (multi-dir is an obs-agg
+            # scrape-side capability; a process publishes into one fleet)
+            endpoint = write_endpoint(
+                cfg.obs_run_dir.split(os.pathsep)[0], role, rank,
+                server.host, server.port)
     try:
         yield
     finally:
@@ -90,8 +94,6 @@ def _obs_scope(cfg: Config, role: str | None = None, rank: int = 0):
             # this rank instead of alerting it down forever; a CRASH
             # never reaches this finally — the lingering endpoint file
             # is exactly what makes the outage scrape as down.
-            import os  # noqa: PLC0415
-
             with contextlib.suppress(OSError):
                 os.unlink(endpoint)
 
@@ -149,12 +151,15 @@ def _add_config_flags(p: argparse.ArgumentParser) -> None:
                    "'METRICS host:port' (default: off)")
     p.add_argument("--metrics-host", dest="obs_metrics_host",
                    help="bind address for --metrics-port (default 127.0.0.1)")
-    p.add_argument("--obs-run-dir", dest="obs_run_dir",
+    p.add_argument("--obs-run-dir", dest="obs_run_dir", action="append",
                    help="fleet rendezvous dir shared by every process of "
                    "this run: publishes this process's scrape endpoint as "
                    "endpoints/<role>-<rank>.json (implies --metrics-port 0 "
                    "when none is given); `launch obs-agg` federates the "
-                   "dir, `launch top` watches it")
+                   "dir, `launch top` watches it.  Repeatable for obs-agg "
+                   "only (aggregation of aggregators: the trainer fleet "
+                   "and the serving fleet merge into one scrape); other "
+                   "commands publish into the FIRST dir given")
     p.add_argument("--trace-path", dest="obs_trace_path",
                    help="write per-phase Chrome trace-event JSON here at "
                    "the end of the run (open in Perfetto)")
@@ -188,6 +193,23 @@ def _add_config_flags(p: argparse.ArgumentParser) -> None:
         help="per-op wall deadline across retries, seconds (default 60)",
     )
     p.add_argument(
+        "--ps-optimizer", dest="ps_optimizer", choices=["sgd", "ftrl"],
+        help="server-side update rule for gradient pushes: sgd (the "
+        "reference w -= lr*g, default) or ftrl (per-coordinate "
+        "FTRL-Proximal with z/n accumulators and --ftrl-l1 "
+        "sparsification — the sparse-CTR production optimizer)",
+    )
+    p.add_argument("--ftrl-alpha", dest="ftrl_alpha", type=float,
+                   help="FTRL per-coordinate learning-rate scale "
+                   "(default 0.1)")
+    p.add_argument("--ftrl-beta", dest="ftrl_beta", type=float,
+                   help="FTRL learning-rate smoothing (default 1.0)")
+    p.add_argument("--ftrl-l1", dest="ftrl_l1", type=float,
+                   help="FTRL L1 strength — sparsifies server weights "
+                   "(default 0)")
+    p.add_argument("--ftrl-l2", dest="ftrl_l2", type=float,
+                   help="FTRL L2 strength (default 0)")
+    p.add_argument(
         "--ps-compute-backend", dest="ps_compute_backend",
         choices=["auto", "numpy", "cpu", "default"],
         help="where PS workers run their dense steps: auto (plain numpy "
@@ -220,8 +242,14 @@ def _config_from_args(args: argparse.Namespace) -> Config:
             "ps_retry_attempts", "ps_retry_backoff_ms",
             "ps_retry_backoff_max_ms", "ps_retry_deadline_s",
             "chaos_plan", "chaos_seed",
+            "ps_optimizer", "ftrl_alpha", "ftrl_beta", "ftrl_l1", "ftrl_l2",
         }
     }
+    if isinstance(overrides.get("obs_run_dir"), list):
+        # --obs-run-dir is repeatable (obs-agg federates several fleets);
+        # Config carries the pathsep-joined list, and single-dir consumers
+        # (endpoint publishing) use the first entry — see _obs_scope.
+        overrides["obs_run_dir"] = os.pathsep.join(overrides["obs_run_dir"])
     cfg = Config.from_env(**overrides)
     if getattr(args, "feature_shards", None):
         cfg = cfg.replace(
@@ -491,6 +519,14 @@ def cmd_serve(args: argparse.Namespace) -> int:
         "serve_hot_rows": args.hot_rows,
         "serve_hot_min_coverage": args.hot_min_coverage,
         "serve_hot_full_every": args.hot_full_every,
+        "feedback_spool_dir": args.feedback_spool,
+        "feedback_shard_dir": args.feedback_shards,
+        "feedback_window_s": args.feedback_window,
+        "feedback_negative_rate": args.feedback_negative_rate,
+        "feedback_shard_records": args.feedback_shard_records,
+        "feedback_capacity": args.feedback_capacity,
+        "feedback_drift_block": args.drift_block,
+        "feedback_drift_threshold": args.drift_threshold,
     }
     cfg = cfg.replace(**{k: v for k, v in serve_over.items() if v is not None})
     if not (args.model_file or cfg.checkpoint_dir or args.ps_hosts):
@@ -557,16 +593,76 @@ def cmd_serve(args: argparse.Namespace) -> int:
         if not engine.has_weights:
             reloader.wait_for_weights()
 
+    feedback = None
+    if cfg.feedback_spool_dir:
+        from distlr_tpu.feedback import FeedbackSink  # noqa: PLC0415
+
+        shard_dir = cfg.feedback_shard_dir or os.path.join(
+            cfg.feedback_spool_dir, "shards")
+        feedback = FeedbackSink(
+            cfg.feedback_spool_dir, shard_dir, model=cfg.model,
+            capacity=cfg.feedback_capacity,
+            window_s=cfg.feedback_window_s,
+            negative_rate=cfg.feedback_negative_rate,
+            shard_records=cfg.feedback_shard_records,
+            tracker=hot_tracker,
+            drift_block=cfg.feedback_drift_block,
+            drift_threshold=cfg.feedback_drift_threshold,
+        )
+        log.info("feedback loop ON: spool=%s shards=%s window=%.0fs "
+                 "negative_rate=%.2f", cfg.feedback_spool_dir, shard_dir,
+                 cfg.feedback_window_s, cfg.feedback_negative_rate)
+
     signal.signal(signal.SIGTERM, lambda *_: sys.exit(143))
     server = ScoringServer(
         engine, host=cfg.serve_host, port=cfg.serve_port,
         max_wait_ms=cfg.serve_max_wait_ms, reloader=reloader,
-        hot_tracker=hot_tracker,
+        hot_tracker=hot_tracker, feedback=feedback,
     )
     with _obs_scope(cfg, "serve", _obs_rank(args)):
         # Scriptable readiness line, like ps-server's "HOSTS ..." contract.
         print(f"SERVING {server.host}:{server.port}", flush=True)
         server.serve_forever()
+    return 0
+
+
+def cmd_online(args: argparse.Namespace) -> int:
+    """Continuous trainer (:mod:`distlr_tpu.feedback.online`): watch the
+    feedback joiner's shard dir and push Hogwild updates into the same
+    live PS group the serving engines hot-reload from — the closed
+    loop's training leg.  Runs until SIGTERM/Ctrl-C unless
+    ``--max-shards`` / ``--idle-exit`` bound it."""
+    import signal  # noqa: PLC0415
+    import threading  # noqa: PLC0415
+
+    _maybe_force_cpu_devices(args)
+    from distlr_tpu.feedback import OnlineTrainer  # noqa: PLC0415
+
+    cfg = _config_from_args(args)
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *_: stop.set())
+    with _obs_scope(cfg, "online", _obs_rank(args)):
+        trainer = OnlineTrainer(
+            cfg, args.hosts, args.shard_dir,
+            accum_start=args.accum_start,
+            accum_growth=args.accum_growth,
+            accum_growth_every=args.accum_growth_every,
+            accum_max=args.accum_max,
+            poll_interval_s=args.poll_interval,
+        )
+        print(f"ONLINE shard_dir={args.shard_dir} hosts={args.hosts}",
+              flush=True)
+        try:
+            stats = trainer.run(stop=stop, max_shards=args.max_shards,
+                                idle_exit_s=args.idle_exit)
+        except KeyboardInterrupt:
+            trainer._flush_push()
+            stats = trainer.stats()
+        finally:
+            trainer.close()
+        log.info("online trainer done: %d shards, %d examples, %d pushes "
+                 "(k=%d)", stats["shards_consumed"], stats["examples"],
+                 stats["pushes"], stats["accum_k"])
     return 0
 
 
@@ -690,6 +786,11 @@ def cmd_ps_server(args: argparse.Namespace) -> int:
         last_gradient=bool(cfg.sync_last_gradient),
         ports=ports,
         bind_any=True,
+        optimizer=cfg.ps_optimizer,
+        ftrl_alpha=cfg.ftrl_alpha,
+        ftrl_beta=cfg.ftrl_beta,
+        ftrl_l1=cfg.ftrl_l1,
+        ftrl_l2=cfg.ftrl_l2,
     )
     try:
         with _obs_scope(cfg, "ps-server", _obs_rank(args)), group:
@@ -770,8 +871,9 @@ def cmd_obs_agg(args: argparse.Namespace) -> int:
     print(f"METRICS {server.host}:{server.port}", flush=True)
     # Published under its own role so `launch top --obs-run-dir` can find
     # the aggregator; the scraper skips obs-agg endpoints when merging.
-    endpoint = write_endpoint(cfg.obs_run_dir, "obs-agg", 0,
-                              server.host, server.port)
+    # With several run dirs, the FIRST is the aggregator's home.
+    endpoint = write_endpoint(cfg.obs_run_dir.split(os.pathsep)[0],
+                              "obs-agg", 0, server.host, server.port)
     try:
         scraper.run_forever()
     except KeyboardInterrupt:
@@ -779,8 +881,6 @@ def cmd_obs_agg(args: argparse.Namespace) -> int:
     finally:
         scraper.stop()
         server.stop()
-        import os  # noqa: PLC0415
-
         with contextlib.suppress(OSError):
             # leave cleanly so `launch top` gets the "start obs-agg
             # first" error instead of polling a dead endpoint
@@ -810,7 +910,7 @@ def cmd_top(args: argparse.Namespace) -> int:
         url = f"http://{aggs[-1]['host']}:{aggs[-1]['port']}"
     color = False if args.no_color else None
     return run_top(url, interval=args.interval, iterations=args.iterations,
-                   color=color)
+                   color=color, rate_window=args.rate_window)
 
 
 def main(argv=None) -> int:
@@ -926,7 +1026,73 @@ def main(argv=None) -> int:
                    help="also force a full refresh every N polls, bounding "
                    "cold-row staleness (default 10; 0 = coverage-driven "
                    "only)")
+    r.add_argument("--feedback-spool", dest="feedback_spool",
+                   help="turn the feedback loop ON: journal every scored "
+                   "request into this bounded spool dir, accept LABEL "
+                   "lines, emit joined training shards, and run the "
+                   "score-drift detector (distlr_tpu.feedback)")
+    r.add_argument("--feedback-shards", dest="feedback_shards",
+                   help="joined-shard output dir the online trainer "
+                   "watches (default <feedback-spool>/shards)")
+    r.add_argument("--feedback-window", dest="feedback_window", type=float,
+                   help="delayed-label join window, seconds (default 60)")
+    r.add_argument("--feedback-negative-rate", dest="feedback_negative_rate",
+                   type=float,
+                   help="probability a never-labeled request becomes a "
+                   "label-0 example at window expiry (default 0.1; 0 = "
+                   "drop all never-labeled)")
+    r.add_argument("--feedback-shard-records", dest="feedback_shard_records",
+                   type=int,
+                   help="joined examples per emitted shard (default 1024)")
+    r.add_argument("--feedback-capacity", dest="feedback_capacity", type=int,
+                   help="in-memory spool bound; past it the least-"
+                   "important oldest requests shed (default 100000)")
+    r.add_argument("--drift-block", dest="drift_block", type=int,
+                   help="served scores per drift-PSI comparison block "
+                   "(default 512)")
+    r.add_argument("--drift-threshold", dest="drift_threshold", type=float,
+                   help="block-to-block PSI above which "
+                   "distlr_alert_score_drift fires (default 0.25)")
     r.set_defaults(fn=cmd_serve)
+
+    on = sub.add_parser(
+        "online",
+        help="continuous trainer: consume joined feedback shards as they "
+             "appear and push Hogwild updates into the live PS the "
+             "serving engines hot-reload from (the closed loop)",
+    )
+    _add_config_flags(on)
+    on.add_argument("--hosts", required=True,
+                    help="the live ASYNC KV server group (comma-separated "
+                    "host:port, rank order) — the same group `launch serve "
+                    "--ps-hosts` pulls from")
+    on.add_argument("--shard-dir", dest="shard_dir", required=True,
+                    help="joined-shard dir the serving tier's feedback "
+                    "sink writes (serve --feedback-shards)")
+    on.add_argument("--accum-start", dest="accum_start", type=int, default=1,
+                    help="AdaBatch local accumulation: initial batches "
+                    "per push (default 1 = push every batch)")
+    on.add_argument("--accum-growth", dest="accum_growth", type=float,
+                    default=2.0,
+                    help="multiply the accumulation span by this every "
+                    "--accum-growth-every pushes (default 2)")
+    on.add_argument("--accum-growth-every", dest="accum_growth_every",
+                    type=int, default=32,
+                    help="pushes between accumulation-span growths "
+                    "(default 32)")
+    on.add_argument("--accum-max", dest="accum_max", type=int, default=64,
+                    help="accumulation span cap (default 64)")
+    on.add_argument("--poll-interval", dest="poll_interval", type=float,
+                    default=0.5,
+                    help="shard-dir scan period while idle, seconds "
+                    "(default 0.5)")
+    on.add_argument("--max-shards", dest="max_shards", type=int, default=0,
+                    help="exit after consuming N shards (0 = run forever; "
+                    "scripts/benches)")
+    on.add_argument("--idle-exit", dest="idle_exit", type=float,
+                    help="exit after this many seconds with no new shards "
+                    "(default: wait forever)")
+    on.set_defaults(fn=cmd_online)
 
     rt = sub.add_parser(
         "route",
@@ -1058,6 +1224,9 @@ def main(argv=None) -> int:
                    help="render N frames then exit (default: until Ctrl-C)")
     t.add_argument("--no-color", dest="no_color", action="store_true",
                    help="plain text frames (no ANSI colors/clears)")
+    t.add_argument("--rate-window", dest="rate_window", type=int, default=10,
+                   help="frames of history behind the windowed req/s and "
+                   "push/s columns (default 10 scrapes)")
     t.set_defaults(fn=cmd_top)
 
     args = parser.parse_args(argv)

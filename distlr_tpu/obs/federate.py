@@ -621,12 +621,24 @@ class FleetScraper:
     scraper, extra_json={"/fleet.json": scraper.fleet_json})``.
     """
 
-    def __init__(self, run_dir: str, *, interval_s: float = 2.0,
+    def __init__(self, run_dir, *, interval_s: float = 2.0,
                  stale_after_s: float = 10.0, timeout_s: float = 2.0,
                  thresholds: AlertThresholds | None = None):
         if interval_s <= 0:
             raise ValueError(f"interval_s must be positive, got {interval_s}")
-        self.run_dir = run_dir
+        # Aggregation of aggregators: several run dirs (a list, or one
+        # os.pathsep-joined string — the repeatable `--obs-run-dir` CLI
+        # form) federate into ONE scrape, so the trainer fleet and the
+        # serving fleet read as one system.  Ranks are keyed (role, rank)
+        # across ALL dirs; a collision keeps the first dir's endpoint and
+        # warns — give fleets distinct roles/ranks.
+        if isinstance(run_dir, str):
+            self.run_dirs = [d for d in run_dir.split(os.pathsep) if d]
+        else:
+            self.run_dirs = list(run_dir)
+        if not self.run_dirs:
+            raise ValueError("FleetScraper needs at least one run dir")
+        self.run_dir = os.pathsep.join(self.run_dirs)
         self.interval_s = float(interval_s)
         self.stale_after_s = float(stale_after_s)
         self.timeout_s = float(timeout_s)
@@ -634,6 +646,7 @@ class FleetScraper:
             scrape_stale_s=stale_after_s)
         self._states: dict[tuple[str, int], _RankState] = {}
         self._conflicts: dict[str, int] = {}
+        self._collision_warned: set[tuple[str, int]] = set()
         self._lock = threading.Lock()
         self._merged = MetricsRegistry()
         self._fleet: dict = {"updated": None, "run_dir": run_dir,
@@ -682,13 +695,25 @@ class FleetScraper:
         """Discover + scrape every rank, rebuild the merged registry and
         the /fleet.json summary, and atomically swap them in."""
         targets: dict[tuple[str, int], tuple[str | None, str | None]] = {}
-        for ep in discover_endpoints(self.run_dir):
-            if ep["role"] == "obs-agg":
-                continue  # never scrape ourselves back into the merge
-            targets[(ep["role"], ep["rank"])] = (
-                f"http://{ep['host']}:{ep['port']}", None)
-        for sf in discover_snapshot_files(self.run_dir):
-            targets.setdefault((sf["role"], sf["rank"]), (None, sf["path"]))
+        for d in self.run_dirs:
+            for ep in discover_endpoints(d):
+                if ep["role"] == "obs-agg":
+                    continue  # never scrape ourselves back into the merge
+                key = (ep["role"], ep["rank"])
+                url = f"http://{ep['host']}:{ep['port']}"
+                if key in targets and targets[key][0] not in (None, url):
+                    if key not in self._collision_warned:
+                        self._collision_warned.add(key)
+                        log.warning(
+                            "fleet rank %s-%s published from more than one "
+                            "run dir; keeping the first dir's endpoint — "
+                            "give each fleet distinct roles/ranks",
+                            *key)
+                    continue
+                targets[key] = (url, None)
+            for sf in discover_snapshot_files(d):
+                targets.setdefault((sf["role"], sf["rank"]),
+                                   (None, sf["path"]))
 
         for key, (url, path) in targets.items():
             st = self._states.get(key)
@@ -829,6 +854,25 @@ class FleetScraper:
                 if p is not None:
                     row["staleness_pushes_p50"] = round(p[0], 1)
                     row["staleness_pushes_p99"] = round(p[1], 1)
+                # cumulative request/push counters: `launch top` derives
+                # its windowed rates (req/s, push/s over the last N
+                # scrapes) from successive values of these
+                if snap.get("distlr_serve_requests_total") is not None:
+                    row["requests"] = int(
+                        _snap_sum(snap, "distlr_serve_requests_total"))
+                if snap.get("distlr_ps_client_ops_total") is not None:
+                    row["pushes"] = int(
+                        _snap_sum(snap, "distlr_ps_client_ops_total",
+                                  {"op": "push", "status": "ok"})
+                        + _snap_sum(snap, "distlr_ps_client_ops_total",
+                                    {"op": "push_pull", "status": "ok"}))
+                # feedback-loop ranks: joined-label and drift signals
+                if snap.get("distlr_feedback_joined_total") is not None:
+                    row["feedback_joined"] = int(
+                        _snap_sum(snap, "distlr_feedback_joined_total"))
+                if snap.get("distlr_feedback_score_psi") is not None:
+                    row["score_psi"] = _snap_max(
+                        snap, "distlr_feedback_score_psi")
                 # routing-tier ranks (`launch route`): surface the
                 # admission/health signals next to the trainer rows
                 if snap.get("distlr_route_requests_total") is not None:

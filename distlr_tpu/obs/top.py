@@ -9,6 +9,7 @@ returns the frame, so tests assert on content without a terminal.
 
 from __future__ import annotations
 
+import collections
 import json
 import sys
 import time
@@ -27,9 +28,64 @@ _STATE_COLOR = {"up": _GREEN, "stale": _YELLOW, "down": _RED}
 
 _COLUMNS = (
     ("role", 9), ("rank", 4), ("state", 6), ("steps", 8),
-    ("samples/s", 10), ("step p50", 9), ("pull p50/p99", 13),
-    ("push p50/p99", 13), ("stale s", 8), ("stale pushes", 13),
+    ("samples/s", 10), ("req/s", 8), ("push/s", 8), ("step p50", 9),
+    ("pull p50/p99", 13), ("push p50/p99", 13), ("stale s", 8),
+    ("stale pushes", 13),
 )
+
+
+class RateTracker:
+    """Windowed rates from successive ``/fleet.json`` polls: the frame's
+    cumulative counters (serve/route requests, ok gradient pushes) are
+    differenced against the OLDEST frame in a bounded history — so the
+    dashboard shows requests/s and pushes/s over the last N scrapes next
+    to the cumulative columns, not a lifetime average that flattens
+    every burst."""
+
+    def __init__(self, window: int = 10):
+        if window < 2:
+            raise ValueError(f"window must be >= 2 frames, got {window}")
+        self._hist: collections.deque = collections.deque(maxlen=window)
+
+    @staticmethod
+    def _counters(fleet: dict) -> dict:
+        cur = {}
+        for r in fleet.get("ranks", []):
+            req = r.get("requests")
+            if req is None:
+                req = r.get("route_requests")
+            cur[(r.get("role"), r.get("rank"))] = (req, r.get("pushes"))
+        return cur
+
+    def update(self, fleet: dict) -> dict:
+        """Feed one frame; returns ``{(role, rank): {"req_s", "push_s"}}``
+        (values None where the rank exports no such counter)."""
+        ts = fleet.get("updated")
+        if ts is None:
+            return {}
+        if self._hist and self._hist[-1][0] == ts:
+            # the aggregator hasn't rescraped since our last poll: a
+            # duplicate frame would shrink the window without adding data
+            pass
+        else:
+            self._hist.append((ts, self._counters(fleet)))
+        if len(self._hist) < 2:
+            return {}
+        t0, old = self._hist[0]
+        t1, new = self._hist[-1]
+        dt = t1 - t0
+        if dt <= 0:
+            return {}
+        rates = {}
+        for key, (req1, push1) in new.items():
+            req0, push0 = old.get(key, (None, None))
+            rates[key] = {
+                "req_s": None if req1 is None or req0 is None
+                else max(0.0, (req1 - req0) / dt),
+                "push_s": None if push1 is None or push0 is None
+                else max(0.0, (push1 - push0) / dt),
+            }
+        return rates
 
 
 def _c(text: str, code: str, color: bool) -> str:
@@ -52,11 +108,13 @@ def _num(v, fmt="{:.1f}") -> str:
     return "-" if v is None else fmt.format(v)
 
 
-def _rank_cells(r: dict) -> list[str]:
+def _rank_cells(r: dict, rates: dict | None = None) -> list[str]:
+    rr = (rates or {}).get((r.get("role"), r.get("rank")), {})
     return [
         str(r.get("role", "?")), str(r.get("rank", "?")),
         str(r.get("state", "?")),
         _num(r.get("steps"), "{:d}"), _num(r.get("samples_per_s")),
+        _num(rr.get("req_s")), _num(rr.get("push_s")),
         _ms(r.get("step_p50_ms")),
         _pair(r.get("pull_p50_ms"), r.get("pull_p99_ms")),
         _pair(r.get("push_p50_ms"), r.get("push_p99_ms")),
@@ -66,8 +124,10 @@ def _rank_cells(r: dict) -> list[str]:
 
 
 def render_fleet(fleet: dict, *, color: bool = True,
-                 clear: bool = False) -> str:
-    """One dashboard frame from a parsed ``/fleet.json`` document."""
+                 clear: bool = False, rates: dict | None = None) -> str:
+    """One dashboard frame from a parsed ``/fleet.json`` document.
+    ``rates``: a :class:`RateTracker.update` result — windowed req/s
+    and push/s per rank (``-`` without history)."""
     lines: list[str] = []
     tot = fleet.get("totals", {})
     updated = fleet.get("updated")
@@ -93,7 +153,7 @@ def render_fleet(fleet: dict, *, color: bool = True,
     header = "  ".join(name.ljust(w) for name, w in _COLUMNS)
     lines.append(_c(header, _BOLD, color))
     for r in fleet.get("ranks", []):
-        cells = _rank_cells(r)
+        cells = _rank_cells(r, rates)
         row = "  ".join(c.ljust(w) for c, (_, w) in zip(cells, _COLUMNS))
         state_color = _STATE_COLOR.get(r.get("state"), "")
         lines.append(_c(row, state_color, color) if state_color else row)
@@ -106,13 +166,15 @@ def render_fleet(fleet: dict, *, color: bool = True,
 
 def run_top(url: str, *, interval: float = 1.0,
             iterations: int | None = None, color: bool | None = None,
-            timeout_s: float = 2.0, out=None) -> int:
+            timeout_s: float = 2.0, out=None, rate_window: int = 10) -> int:
     """Poll ``<url>/fleet.json`` and repaint until interrupted (or for
     ``iterations`` frames — what scripts and tests use).  Returns a
-    shell-style exit code."""
+    shell-style exit code.  ``rate_window``: frames of history behind
+    the windowed req/s / push/s columns."""
     out = out or sys.stdout
     if color is None:
         color = bool(getattr(out, "isatty", lambda: False)())
+    tracker = RateTracker(window=max(2, rate_window))
     n = 0
     try:
         while iterations is None or n < iterations:
@@ -122,7 +184,8 @@ def run_top(url: str, *, interval: float = 1.0,
                 with urllib.request.urlopen(url + "/fleet.json",
                                             timeout=timeout_s) as r:
                     fleet = json.load(r)
-                frame = render_fleet(fleet, color=color, clear=color)
+                frame = render_fleet(fleet, color=color, clear=color,
+                                     rates=tracker.update(fleet))
             except Exception as e:  # noqa: BLE001 — show, keep polling
                 frame = (CLEAR if color else "") + \
                     f"fleet aggregator unreachable at {url}: {e}\n"

@@ -280,13 +280,38 @@ class KVWorker:
             self._lib.kv_set_push_visit_all(self._h, 0)
 
     # -- in-place retry (RetryPolicy) -------------------------------------
-    def _with_retry(self, op: str, fn):
-        """Run an IDEMPOTENT op under the retry policy: on a transient
-        transport failure, reconnect the poisoned handle, back off, and
-        re-issue — bounded by attempts and the per-op deadline.  With no
-        policy this is a plain call (today's fail-fast semantics)."""
+    def _run_with_retry(self, op: str, fn, *, idempotent: bool,
+                        on_failure=None):
+        """THE retry driver — one loop for both op classes (the
+        idempotent and push paths used to be near-identical twins; PR 5
+        debt).  On a transient transport failure: reconnect the poisoned
+        handle, back off (jittered exponential), and re-issue — bounded
+        by the policy's attempts and per-op wall deadline.  With no
+        policy this is a plain call (fail-fast semantics).
+
+        ``idempotent=False`` marks a gradient-carrying op, with two
+        extra rules the delivery-proof semantics demand:
+
+        * sync (BSP) groups never retry it at all — the deferred reply
+          IS the barrier and the timeout is the named straggler signal;
+        * a re-issue is allowed only while the native client proves no
+          byte of the failed op reached any server's kernel
+          (``kv_op_delivery_began == 0``).  Once delivery began the
+          outcome is unknown: it is counted
+          (``distlr_ps_push_outcome_unknown_total``), the handle is
+          reconnected best-effort, and the ``on_failure`` hook resolves
+          the op (the fused push_pull re-pulls its weights
+          idempotently); without a hook the push is absorbed as
+          lost-or-applied-once (returns -1) — the bounded-staleness
+          class Hogwild training already tolerates, where a re-issued
+          maybe-applied push would be a silent double-apply.
+
+        ``on_failure`` fires only on the unknown-delivery outcome; the
+        idempotent path never reaches it (re-issue is always legal
+        there).
+        """
         pol = self.retry
-        if pol is None:
+        if pol is None or (not idempotent and self._sync_group):
             return fn()
         deadline = time.monotonic() + pol.deadline_s
         last: Exception | None = None
@@ -312,59 +337,31 @@ class KVWorker:
             try:
                 return fn()
             except OSError as e:
-                last = e
-                if time.monotonic() >= deadline:
-                    break
-        assert last is not None
-        raise last
-
-    def _push_with_retry(self, op: str, fn, *, on_unknown=None):
-        """Run a NON-idempotent (gradient-carrying) op under the retry
-        policy.  Re-issue is allowed only while the native client proves
-        no byte of the failed op reached any server's kernel
-        (kv_op_delivery_began == 0) — then a retry cannot double-apply.
-        Once delivery began, the outcome is unknown: count it, reconnect
-        so the worker keeps running, and resolve via ``on_unknown`` (the
-        fused op re-pulls its weights idempotently) or absorb the
-        possibly-lost push (plain push returns -1) — the same bounded
-        staleness async training already tolerates.  Sync (BSP) groups
-        never retry pushes: the deferred reply is the barrier and the
-        timeout is the named straggler error."""
-        pol = self.retry
-        if pol is None or self._sync_group:
-            return fn()
-        deadline = time.monotonic() + pol.deadline_s
-        last: Exception | None = None
-        for attempt in range(pol.attempts):
-            if attempt:
-                nap = pol.backoff_s(attempt - 1, self._retry_rng)
-                time.sleep(min(nap, max(0.0, deadline - time.monotonic())))
-                try:
-                    self.reconnect()
-                except OSError as e:
-                    last = e
-                    if time.monotonic() >= deadline:
-                        break
-                    continue
-                if time.monotonic() >= deadline:
-                    break  # see _with_retry: never re-issue past deadline
-                _RETRIES.labels(op=op).inc()
-            try:
-                return fn()
-            except OSError as e:
-                if self._lib.kv_op_delivery_began(self._h):
+                if not idempotent and self._lib.kv_op_delivery_began(self._h):
                     _PUSH_UNKNOWN.inc()
                     with contextlib.suppress(OSError):
                         # best-effort: later ops retry their own reconnect
                         self.reconnect()
-                    if on_unknown is not None:
-                        return on_unknown()
+                    if on_failure is not None:
+                        return on_failure()
                     return -1
                 last = e
                 if time.monotonic() >= deadline:
                     break
         assert last is not None
         raise last
+
+    def _with_retry(self, op: str, fn):
+        """Idempotent ops (pull/chunked/keyed/stats/barrier/push_init):
+        re-issue is always legal — the server rolls a dead connection's
+        state back (DropConnection), so a reconnect re-issue counts once."""
+        return self._run_with_retry(op, fn, idempotent=True)
+
+    def _push_with_retry(self, op: str, fn, *, on_unknown=None):
+        """Gradient-carrying ops (push/push_pull): delivery-proof retry
+        semantics — see :meth:`_run_with_retry`."""
+        return self._run_with_retry(op, fn, idempotent=False,
+                                    on_failure=on_unknown)
 
     def set_timeout(self, timeout_ms: int) -> None:
         """Receive timeout for every op; 0 = block forever (reference

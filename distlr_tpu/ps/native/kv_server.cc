@@ -27,6 +27,26 @@
 //   distlr_kv_server --port=P --num_workers=W --dim=D [--lr=0.2]
 //                    [--max_dim=2^31]  (elasticity/corruption cap, §below)
 //                    [--sync=1] [--last_gradient=0] [--bind_any=0]
+//                    [--optimizer=sgd] [--ftrl_alpha=0.1] [--ftrl_beta=1]
+//                    [--ftrl_l1=0] [--ftrl_l2=0]
+//
+// --optimizer selects the server-side update rule applied to incoming
+// gradients (the pluggable point the lr flag already parameterized):
+//   sgd  — w -= lr * g (the reference's DataHandle update, default)
+//   ftrl — per-coordinate FTRL-Proximal (McMahan et al., KDD'13): the
+//          sparse-CTR production optimizer.  Keeps two accumulators per
+//          coordinate (z: L1-shrunk dual state, n: sum of squared
+//          gradients) and derives the weight in closed form:
+//            sigma = (sqrt(n + g^2) - sqrt(n)) / alpha
+//            z    += g - sigma * w;   n += g^2
+//            w     = 0                         if |z| <= l1
+//                  = -(z - sign(z)*l1) /
+//                    ((beta + sqrt(n)) / alpha + l2)   otherwise
+//          Zero-gradient coordinates are untouched (no information, no
+//          update) — which is also what keeps the sync path's dense
+//          merge scan from re-deriving untouched weights.  Sync mode
+//          applies FTRL to the round's MEAN gradient; async per push.
+//          --last_gradient (the Q1 reference-SGD quirk) is rejected.
 //
 // --port=0 binds an ephemeral port; the chosen port is announced as
 // "PORT <n>" on stdout so a supervisor can read it race-free.
@@ -46,6 +66,7 @@
 
 #include <algorithm>
 #include <atomic>
+#include <cmath>
 #include <condition_variable>
 #include <cstring>
 #include <cstdio>
@@ -75,14 +96,26 @@ struct PendingPush {
   bool want_vals = false;
 };
 
+struct FtrlParams {
+  float alpha = 0.1f;
+  float beta = 1.0f;
+  float l1 = 0.0f;
+  float l2 = 0.0f;
+};
+
 class KVServer {
  public:
   KVServer(int port, int num_workers, uint64_t dim, float lr, bool sync,
-           bool last_gradient, bool bind_any, uint64_t max_dim)
+           bool last_gradient, bool bind_any, uint64_t max_dim,
+           bool ftrl, FtrlParams ftrl_params)
       : port_(port), num_workers_(num_workers), lr_(lr), sync_(sync),
         last_gradient_(last_gradient), bind_any_(bind_any),
-        max_dim_(max_dim) {
+        max_dim_(max_dim), ftrl_(ftrl), fp_(ftrl_params) {
     weights_.resize(dim, 0.0f);
+    if (ftrl_) {
+      z_.resize(dim, 0.0f);
+      nacc_.resize(dim, 0.0f);
+    }
   }
 
   int Run() {
@@ -114,9 +147,9 @@ class KVServer {
     printf("PORT %d\n", port_);
     fflush(stdout);
     fprintf(stderr, "[distlr_kv_server] listening on %s:%d "
-            "(workers=%d dim=%zu sync=%d lr=%g)\n",
+            "(workers=%d dim=%zu sync=%d optimizer=%s lr=%g)\n",
             bind_any_ ? "0.0.0.0" : "127.0.0.1", port_, num_workers_,
-            weights_.size(), sync_ ? 1 : 0, lr_);
+            weights_.size(), sync_ ? 1 : 0, ftrl_ ? "ftrl" : "sgd", lr_);
     fflush(stderr);
 
     std::vector<std::thread> conns;
@@ -327,9 +360,14 @@ class KVServer {
     if (max_key < weights_.size()) return;
     const size_t old_w = weights_.size();
     const size_t old_m = merge_.size();
+    const size_t old_z = z_.size();
     try {
       weights_.resize(max_key + 1, 0.0f);
       merge_.resize(weights_.size(), 0.0f);
+      if (ftrl_) {
+        z_.resize(weights_.size(), 0.0f);
+        nacc_.resize(weights_.size(), 0.0f);
+      }
     } catch (...) {
       // All-or-nothing: weights_.resize succeeding and merge_.resize
       // throwing would leave a permanently inflated weights_ whose size
@@ -339,12 +377,53 @@ class KVServer {
       // astronomically unlikely and only costs footprint, not state.
       weights_.resize(old_w);
       merge_.resize(old_m);
+      if (ftrl_) {
+        z_.resize(old_z);
+        nacc_.resize(old_z);
+      }
       try {
         weights_.shrink_to_fit();
         merge_.shrink_to_fit();
+        if (ftrl_) {
+          z_.shrink_to_fit();
+          nacc_.shrink_to_fit();
+        }
       } catch (...) {
       }
       throw;
+    }
+  }
+
+  // One coordinate's FTRL-Proximal step (caller holds mu_; g != 0).
+  // All arithmetic is float32, matching the NumPy oracle the parity
+  // tests compare against (tests/test_ftrl.py) operation for operation.
+  inline void FtrlStep(Key k, float g) {
+    const float n_old = nacc_[k];
+    const float n_new = n_old + g * g;
+    const float sigma =
+        (std::sqrt(n_new) - std::sqrt(n_old)) / fp_.alpha;
+    z_[k] += g - sigma * weights_[k];
+    nacc_[k] = n_new;
+    const float z = z_[k];
+    if (std::fabs(z) <= fp_.l1) {
+      weights_[k] = 0.0f;  // L1 sparsification: the CTR memory saver
+      return;
+    }
+    const float sgn = z > 0.0f ? 1.0f : -1.0f;
+    weights_[k] = -(z - sgn * fp_.l1) /
+                  ((fp_.beta + std::sqrt(n_new)) / fp_.alpha + fp_.l2);
+  }
+
+  // Apply one gradient value to one coordinate under the configured
+  // optimizer — THE pluggable update this server exists to serialize.
+  // FTRL skips zero gradients (no information; and re-deriving w from
+  // unchanged z would zero a freshly init-pushed weight, since init
+  // seeds weights_ directly and leaves z/n at 0 until real traffic).
+  inline void ApplyGrad(Key k, float g) {
+    if (ftrl_) {
+      if (g != 0.0f) FtrlStep(k, g);
+    } else {
+      weights_[k] -= lr_ * g;
     }
   }
 
@@ -399,9 +478,10 @@ class KVServer {
     }
 
     if (!sync_) {
-      // Async/Hogwild: apply immediately (src/main.cc:79-84).
+      // Async/Hogwild: apply immediately (src/main.cc:79-84) under the
+      // configured optimizer (SGD or per-coordinate FTRL-Proximal).
       for (size_t i = 0; i < keys.size(); ++i)
-        weights_[keys[i]] -= lr_ * vals[i];
+        ApplyGrad(keys[i], vals[i]);
       const auto out = reply_weights ? WeightsFor(keys) : std::vector<Val>();
       lock.unlock();
       Respond(fd, h, out.data(), out.size());
@@ -442,8 +522,15 @@ class KVServer {
           for (size_t i = 0; i < pick->keys.size(); ++i)
             weights_[pick->keys[i]] -= lr_ * pick->vals[i] / w;
         }
+      } else if (ftrl_) {
+        // FTRL BSP: ONE optimizer step on the round's mean gradient,
+        // untouched (zero-merge) coordinates skipped — see ApplyGrad.
+        for (size_t i = 0; i < merge_.size(); ++i)
+          if (merge_[i] != 0.0f) FtrlStep(i, merge_[i] / w);
       } else {
-        // Correct BSP: mean of the merged gradients.
+        // Correct BSP: mean of the merged gradients.  Expression kept
+        // verbatim (lr*g/W, not lr*(g/W)) — the trajectory is pinned
+        // bit-identical by the reference-oracle parity tests.
         for (size_t i = 0; i < merge_.size(); ++i)
           weights_[i] -= lr_ * merge_[i] / w;
       }
@@ -579,6 +666,8 @@ class KVServer {
   bool last_gradient_;
   bool bind_any_;
   uint64_t max_dim_;
+  bool ftrl_;
+  FtrlParams fp_;
   int listen_fd_ = -1;
   std::atomic<bool> shutdown_{false};
   std::vector<int> active_fds_;
@@ -589,6 +678,11 @@ class KVServer {
   uint64_t n_pull_ = 0;
   std::vector<Val> weights_;
   std::vector<Val> merge_;
+  // FTRL-Proximal per-coordinate accumulators (sized with weights_ when
+  // --optimizer=ftrl; empty otherwise): z is the L1-shrunk dual state,
+  // nacc the running sum of squared gradients.
+  std::vector<Val> z_;
+  std::vector<Val> nacc_;
   std::vector<PendingPush> pending_;
   std::unordered_map<uint16_t, std::vector<PendingPush>> barrier_;
   std::set<uint16_t> released_barriers_;
@@ -614,6 +708,16 @@ static double ArgF(int argc, char** argv, const char* name, double dflt) {
   return dflt;
 }
 
+static std::string ArgS(int argc, char** argv, const char* name,
+                        const char* dflt) {
+  const std::string prefix = std::string("--") + name + "=";
+  for (int i = 1; i < argc; ++i) {
+    if (std::string(argv[i]).rfind(prefix, 0) == 0)
+      return std::string(argv[i] + prefix.size());
+  }
+  return dflt;
+}
+
 int main(int argc, char** argv) {
   const int port = static_cast<int>(Arg(argc, argv, "port", 8001));
   const int num_workers = static_cast<int>(Arg(argc, argv, "num_workers", 1));
@@ -630,8 +734,35 @@ int main(int argc, char** argv) {
   const uint64_t max_dim = std::max<uint64_t>(
       static_cast<uint64_t>(Arg(argc, argv, "max_dim", 1L << 31)),
       static_cast<uint64_t>(dim));
+  const std::string optimizer = ArgS(argc, argv, "optimizer", "sgd");
+  if (optimizer != "sgd" && optimizer != "ftrl") {
+    std::fprintf(stderr, "[distlr_kv_server] unknown --optimizer=%s "
+                 "(sgd|ftrl)\n", optimizer.c_str());
+    return 2;
+  }
+  const bool ftrl = optimizer == "ftrl";
+  if (ftrl && last_gradient) {
+    // Q1 is a reference-SGD parity quirk; "the last worker's gradient
+    // applied / W with SGD" has no FTRL analogue to mirror.
+    std::fprintf(stderr, "[distlr_kv_server] --optimizer=ftrl is "
+                 "incompatible with --last_gradient=1 (Q1 is an SGD "
+                 "parity quirk)\n");
+    return 2;
+  }
+  distlr::FtrlParams fp;
+  fp.alpha = static_cast<float>(ArgF(argc, argv, "ftrl_alpha", 0.1));
+  fp.beta = static_cast<float>(ArgF(argc, argv, "ftrl_beta", 1.0));
+  fp.l1 = static_cast<float>(ArgF(argc, argv, "ftrl_l1", 0.0));
+  fp.l2 = static_cast<float>(ArgF(argc, argv, "ftrl_l2", 0.0));
+  if (ftrl && (fp.alpha <= 0.0f || fp.beta < 0.0f || fp.l1 < 0.0f ||
+               fp.l2 < 0.0f)) {
+    std::fprintf(stderr, "[distlr_kv_server] bad FTRL params: need "
+                 "alpha > 0 and beta/l1/l2 >= 0 (got alpha=%g beta=%g "
+                 "l1=%g l2=%g)\n", fp.alpha, fp.beta, fp.l1, fp.l2);
+    return 2;
+  }
   distlr::KVServer server(port, num_workers, static_cast<uint64_t>(dim),
                           static_cast<float>(lr), sync, last_gradient,
-                          bind_any, max_dim);
+                          bind_any, max_dim, ftrl, fp);
   return server.Run();
 }

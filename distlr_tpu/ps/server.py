@@ -78,7 +78,21 @@ class ServerGroup:
         binary: str | None = None,
         max_dim: int | None = None,
         via_chaos=None,
+        optimizer: str = "sgd",
+        ftrl_alpha: float = 0.1,
+        ftrl_beta: float = 1.0,
+        ftrl_l1: float = 0.0,
+        ftrl_l2: float = 0.0,
     ):
+        if optimizer not in ("sgd", "ftrl"):
+            raise ValueError(f"optimizer must be sgd|ftrl, got {optimizer!r}")
+        if optimizer == "ftrl" and last_gradient:
+            # Q1 is a reference-SGD parity quirk; there is no "last
+            # worker's FTRL step / W" reference behavior to mirror.
+            raise ValueError(
+                "optimizer='ftrl' is incompatible with last_gradient "
+                "(Q1 compat is an SGD parity quirk)"
+            )
         build_native()
         self._binary = binary or server_binary()
         self.num_servers = num_servers
@@ -103,6 +117,16 @@ class ServerGroup:
             # elasticity/corruption cap (server --max_dim); None = the
             # server's default (2^31, always clamped to >= its slice dim)
             max_dim=max_dim,
+            # server-side update rule (the pluggable optimizer point the
+            # lr flag already parameterized): "sgd" or "ftrl" (per-
+            # coordinate FTRL-Proximal with z/n accumulators — the
+            # sparse-CTR production optimizer the online-learning loop
+            # trains through)
+            optimizer=optimizer,
+            ftrl_alpha=ftrl_alpha,
+            ftrl_beta=ftrl_beta,
+            ftrl_l1=ftrl_l1,
+            ftrl_l2=ftrl_l2,
         )
         # serializes respawn() against stop() (supervisor thread vs
         # teardown) and marks teardown so a racing respawn becomes a no-op
@@ -144,6 +168,16 @@ class ServerGroup:
         ]
         if self._args["max_dim"] is not None:
             cmd.append(f"--max_dim={self._args['max_dim']}")
+        if self._args["optimizer"] != "sgd":
+            # only non-default optimizers touch the command line, so sgd
+            # spawns stay byte-identical to every earlier round's
+            cmd += [
+                f"--optimizer={self._args['optimizer']}",
+                f"--ftrl_alpha={self._args['ftrl_alpha']}",
+                f"--ftrl_beta={self._args['ftrl_beta']}",
+                f"--ftrl_l1={self._args['ftrl_l1']}",
+                f"--ftrl_l2={self._args['ftrl_l2']}",
+            ]
         proc = subprocess.Popen(cmd, stdout=subprocess.PIPE, text=True)
         # The server prints "PORT <n>" once listening; blocking on that
         # line doubles as the readiness wait.

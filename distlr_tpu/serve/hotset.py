@@ -143,6 +143,33 @@ class HotSetTracker:
         _HOT_KEYS.set(keys.size)
         return keys.copy()  # callers must not alias the live snapshot
 
+    def importance(self, keys) -> float:
+        """Decayed-count mass of a key set — how much of the tracked
+        traffic touches these rows.  The feedback spool's retention
+        score (:mod:`distlr_tpu.feedback.spool`): under capacity
+        pressure, requests whose rows nobody asks about are shed first,
+        reusing exactly the statistics hot-row reload already pays for."""
+        keys = np.asarray(keys, dtype=np.uint64).reshape(-1)
+        if keys.size == 0:
+            return 0.0
+        with self._lock:
+            counts = self._counts
+            return float(sum(counts.get(int(k), 0.0) for k in keys))
+
+    def importance_many(self, key_sets) -> list[float]:
+        """:meth:`importance` for a batch of key sets under ONE lock
+        acquisition — the spool's eviction scan calls this per evicted
+        record, and per-candidate locking would contend with the
+        scoring hot path's :meth:`observe`.  ``None``/empty key sets
+        score 0.0."""
+        with self._lock:
+            counts = self._counts
+            return [
+                0.0 if keys is None or not len(keys) else
+                float(sum(counts.get(int(k), 0.0) for k in keys))
+                for keys in key_sets
+            ]
+
     def coverage(self) -> float:
         with self._lock:
             cov = 1.0 if self._total == 0 else self._hits / self._total

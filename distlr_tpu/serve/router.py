@@ -28,6 +28,14 @@ Speaks exactly the replica line protocol (libsvm line / JSON batch /
   replica dies mid-exchange is transparently retried on another replica
   (once); application-level ``ERR`` replies from a replica (malformed
   input) pass through untouched — they are deterministic, not failures.
+* **label fan-out** — a ``LABEL <id> <y>`` feedback line
+  (:mod:`distlr_tpu.feedback`) is BROADCAST to every healthy replica:
+  only the replica that scored request ``id`` holds its spool entry,
+  and the router deliberately does not track which one that was (ids
+  are caller-minted; tracking them would make the router stateful).
+  The reply is the best outcome any replica reported (``joined`` >
+  ``duplicate`` > ``pending``); replicas that never saw the id answer
+  ``pending`` and age the orphan label out of their window.
 
 Stdlib-only and jax-free: ``python -m distlr_tpu.launch route`` starts
 in well under a second and never competes with replicas for a chip.
@@ -89,6 +97,12 @@ _REINSTATES = _reg.counter(
     "distlr_route_reinstates_total",
     "ejected replicas reinstated by a successful backoff probe",
     labelnames=("replica",),
+)
+_LABELS = _reg.counter(
+    "distlr_route_labels_total",
+    "LABEL feedback lines fanned out to replicas, by best outcome "
+    "(joined/duplicate/pending/failed)",
+    labelnames=("listener", "outcome"),
 )
 
 
@@ -423,10 +437,57 @@ class ScoringRouter:
                 if due:
                     self._probe(rep)
 
+    # -- label fan-out ------------------------------------------------------
+    #: reply preference when replicas disagree: a join beats a duplicate
+    #: (someone already joined it) beats a pending hold
+    _LABEL_ORDER = {"joined": 0, "duplicate": 1, "pending": 2}
+
+    def _broadcast_label(self, line: str) -> str:
+        with self._lock:
+            targets = [r for r in self.replicas if r.healthy]
+        best: str | None = None
+        for rep in targets:
+            with self._lock:
+                admitted = rep.try_acquire()
+            if not admitted:
+                continue  # saturated replica: its window will age the id
+            try:
+                reply = rep.exchange(line)
+            except Exception:  # noqa: BLE001 — transport failure
+                self._note_failure(rep)
+                continue
+            finally:
+                self._release(rep)
+            self._note_success(rep)
+            if reply.startswith("OK"):
+                outcome = reply[2:].strip() or "joined"
+                if (best is None or self._LABEL_ORDER.get(outcome, 3)
+                        < self._LABEL_ORDER.get(best, 3)):
+                    best = outcome
+                if best in ("joined", "duplicate"):
+                    # terminal: only the scoring replica can join, and a
+                    # duplicate means it already did — fanning further
+                    # would park the label in every remaining replica's
+                    # bounded pending buffer (and cost their RTTs) for
+                    # nothing
+                    break
+            # ERR (replica without a feedback sink, malformed id):
+            # deterministic, not a transport failure — just not a hit
+        listener = f"{self.host}:{self.port}"
+        _LABELS.labels(listener=listener,
+                       outcome=best if best is not None else "failed").inc()
+        if best is not None:
+            return f"OK {best}"
+        self._errors_c.inc()
+        return ("ERR LABEL: no replica accepted the label (are the "
+                "replicas running a feedback sink?)")
+
     # -- request path ------------------------------------------------------
     def handle_line(self, line: str) -> str:
         if line == "STATS":
             return json.dumps(self.stats())
+        if line.startswith("LABEL ") or line == "LABEL":
+            return self._broadcast_label(line)
         t0 = time.monotonic()
         excluded: list[_Replica] = []
         last_err = "no healthy replica in rotation"

@@ -12,6 +12,17 @@ Line protocol, one request per line, one reply line per request:
   microbatcher request (a single client can fill a bucket by itself).
 * **STATS** — reply: one JSON line of engine/batcher/latency counters
   (p50/p99 ms, QPS, occupancy, reload stats).
+* **ID mode** — ``ID <token> <libsvm line>``: score the line like
+  libsvm mode AND journal it under the caller-supplied request id
+  (``token``) so a later label can join it (additive, like STATS;
+  requires a feedback sink — without one the id is simply ignored).
+  JSON mode's additive twin is an optional ``"ids"`` list parallel to
+  ``"rows"`` (entries may be null).
+* **LABEL** — ``LABEL <request_id> <label>``: a delayed label event for
+  a previously scored request (the feedback loop's return path,
+  :mod:`distlr_tpu.feedback`); reply ``OK <outcome>`` where outcome is
+  ``joined`` / ``pending`` / ``duplicate``, or ``ERR`` when the server
+  runs no feedback sink.
 * Malformed input -> ``ERR <reason>`` for that line; the connection
   stays up (one bad row from one client must not drop its neighbors).
 
@@ -94,12 +105,17 @@ class ScoringServer:
 
     def __init__(self, engine, *, host: str = "127.0.0.1", port: int = 0,
                  max_wait_ms: float = 2.0, reloader=None,
-                 metrics: MetricsLogger | None = None, hot_tracker=None):
+                 metrics: MetricsLogger | None = None, hot_tracker=None,
+                 feedback=None):
         self.engine = engine
         self.reloader = reloader
         #: HotSetTracker fed from request traffic (hot-row keyed reload);
         #: None = full-table refresh semantics, no tracking overhead.
         self.hot_tracker = hot_tracker
+        #: FeedbackSink (distlr_tpu.feedback): journals scored requests,
+        #: joins LABEL lines, feeds the drift detector.  None = the loop
+        #: is open (pre-feedback behavior, zero overhead).
+        self.feedback = feedback
         self.batcher = MicroBatcher(
             engine.score,
             max_batch_size=engine.max_batch_size,
@@ -138,30 +154,68 @@ class ScoringServer:
         with self._conn_lock:
             self._active_conns.discard(conn)
 
-    def _score_lines(self, lines: list[str]):
+    def _score_lines(self, lines: list[str], ids: list | None = None):
         rows = self.engine.encode_lines(lines)
         if self.hot_tracker is not None:
             self.hot_tracker.observe(self.engine.row_keys(rows))
+        # version read BEFORE scoring: a swap racing the batch means the
+        # journal attributes at most one version early, never one that
+        # did not exist when the request entered
+        version = self.engine.weights_version
         labels, scores = self.batcher.submit(rows).result()
-        return np.asarray(labels), np.asarray(scores)
+        labels, scores = np.asarray(labels), np.asarray(scores)
+        if self.feedback is not None:
+            self.feedback.scored(lines, rows, scores, version=version,
+                                 ids=ids)
+        return labels, scores
+
+    def _handle_label(self, line: str) -> str:
+        if self.feedback is None:
+            raise ValueError(
+                "this server runs no feedback sink (start with "
+                "--feedback-spool to close the loop)")
+        parts = line.split()
+        if len(parts) != 3:
+            raise ValueError("LABEL needs exactly: LABEL <request_id> <0|1>")
+        y = float(parts[2])
+        if y not in (0.0, 1.0):
+            raise ValueError(f"label must be 0 or 1, got {parts[2]!r}")
+        return f"OK {self.feedback.label(parts[1], int(y))}"
 
     def handle_line(self, line: str) -> str:
         t0 = time.monotonic()
         try:
             if line == "STATS":
                 return json.dumps(self.stats())
+            if line.startswith("LABEL ") or line == "LABEL":
+                return self._handle_label(line)
             if line.startswith("{"):
                 req = json.loads(line)
                 batch = req.get("rows")
                 if not isinstance(batch, list) or not batch:
                     raise ValueError('JSON request needs a non-empty "rows" list')
-                labels, scores = self._score_lines([str(r) for r in batch])
+                ids = req.get("ids")
+                if ids is not None and (not isinstance(ids, list)
+                                        or len(ids) != len(batch)):
+                    raise ValueError(
+                        '"ids" must be a list parallel to "rows"')
+                labels, scores = self._score_lines(
+                    [str(r) for r in batch],
+                    None if ids is None
+                    else [None if i is None else str(i) for i in ids])
                 reply = json.dumps({
                     "labels": [int(v) for v in labels],
                     "scores": [round(float(v), 6) for v in scores],
                 })
             else:
-                labels, scores = self._score_lines([line])
+                ids = None
+                if line.startswith("ID "):
+                    parts = line.split(None, 2)
+                    if len(parts) != 3:
+                        raise ValueError(
+                            "ID mode needs: ID <request_id> <features>")
+                    line, ids = parts[2], [parts[1]]
+                labels, scores = self._score_lines([line], ids)
                 reply = f"{int(labels[0])} {float(scores[0]):.6g}"
         except Exception as e:
             self._errors_c.inc()
@@ -198,6 +252,10 @@ class ScoringServer:
         }
         if self.reloader is not None:
             rec["reload"] = self.reloader.stats()
+        if self.feedback is not None:
+            # additive, like "reload": the pinned scalar schema above is
+            # untouched when no sink runs
+            rec["feedback"] = self.feedback.stats()
         # mirror into the structured metrics stream (train/metrics.py
         # conventions: one flat record per observation) — unless the
         # logger was closed by stop(): final stats after shutdown must
@@ -213,6 +271,8 @@ class ScoringServer:
     # -- lifecycle ---------------------------------------------------------
     def start(self) -> "ScoringServer":
         self._started = True
+        if self.feedback is not None:
+            self.feedback.start()  # window-expiry / idle-flush ticker
         self._thread.start()
         log.info("serving %s on %s:%d (max_batch=%d, buckets=%s)",
                  self.engine.cfg.model, self.host, self.port,
@@ -239,6 +299,8 @@ class ScoringServer:
         self.batcher.close()
         if self.reloader is not None:
             self.reloader.stop()
+        if self.feedback is not None:
+            self.feedback.stop()  # flushes the partial shard
         self.metrics.close()
 
     def abort(self) -> None:

@@ -1180,6 +1180,11 @@ def run_ps_local(cfg: Config, *, eval_fn=None, save=False, resume=False,
         sync=cfg.sync_mode,
         last_gradient=bool(cfg.sync_last_gradient),
         via_chaos=via_chaos,
+        optimizer=cfg.ps_optimizer,
+        ftrl_alpha=cfg.ftrl_alpha,
+        ftrl_beta=cfg.ftrl_beta,
+        ftrl_l1=cfg.ftrl_l1,
+        ftrl_l2=cfg.ftrl_l2,
     )
     with contextlib.ExitStack() as stack:
         stack.enter_context(group)

@@ -1,0 +1,603 @@
+"""Online learning from served traffic (ISSUE 6): spool, delayed-label
+join, drift detection, the continuous trainer, and the closed-loop
+end-to-end — serve → label → join → online trainer → live PS → hot
+reload → served scores move.
+
+The e2e acceptance (short tier-1 variant here, slow chaos soak marked
+``slow``): labels flip mid-run, served scores measurably track the new
+label distribution within the same process lifetimes (zero restarts),
+``distlr_alert_score_drift`` fires during the shift and clears after
+adaptation, the FTRL server mode does the learning, and the loop's PS
+legs cross the chaos proxy under a scripted fault plan.
+"""
+
+import json
+import os
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from distlr_tpu.config import Config
+from distlr_tpu.feedback import (
+    FeedbackSink,
+    FeedbackSpool,
+    LabelJoiner,
+    OnlineTrainer,
+    ScoreDriftDetector,
+    SpoolRecord,
+    per_row_keys,
+    strip_label,
+)
+from distlr_tpu.ps import KVWorker, ServerGroup
+
+D = 16
+
+
+def _rec(rid, ts, line="1:1 2:1", score=0.5, keys=None):
+    return SpoolRecord(rid=rid, ts=ts, line=line, score=score, version=1,
+                       keys=None if keys is None
+                       else np.asarray(keys, np.uint64))
+
+
+# ---------------------------------------------------------------------------
+# spool
+# ---------------------------------------------------------------------------
+
+class _Tracker:
+    """HotSetTracker stand-in: importance = how many keys are 'hot'."""
+
+    def __init__(self, hot):
+        self.hot = set(hot)
+
+    def importance(self, keys):
+        return float(sum(1 for k in np.asarray(keys).reshape(-1)
+                         if int(k) in self.hot))
+
+
+class TestFeedbackSpool:
+    def test_capacity_eviction_is_importance_aware(self, tmp_path):
+        spool = FeedbackSpool(str(tmp_path), capacity=3,
+                              tracker=_Tracker({7}), evict_scan=3)
+        spool.add(_rec("cold-0", 1.0, keys=[1]))
+        spool.add(_rec("hot", 2.0, keys=[7]))
+        spool.add(_rec("cold-1", 3.0, keys=[2]))
+        spool.add(_rec("cold-2", 4.0, keys=[3]))  # over capacity
+        assert len(spool) == 3
+        # the HOT record survives even though it is older than cold-1/2
+        assert spool.pop("hot") is not None
+        assert spool.pop("cold-0") is None  # the cold oldest was shed
+        assert spool.evicted == 1
+
+    def test_fifo_without_tracker(self, tmp_path):
+        spool = FeedbackSpool(str(tmp_path), capacity=2)
+        for i in range(4):
+            spool.add(_rec(f"r{i}", float(i)))
+        assert len(spool) == 2
+        assert spool.pop("r0") is None and spool.pop("r1") is None
+        assert spool.pop("r3") is not None
+
+    def test_expire_before_returns_old_records(self, tmp_path):
+        spool = FeedbackSpool(str(tmp_path), capacity=10)
+        for i in range(5):
+            spool.add(_rec(f"r{i}", float(i)))
+        expired = spool.expire_before(3.0)
+        assert [r.rid for r in expired] == ["r0", "r1", "r2"]
+        assert len(spool) == 2
+
+    def test_journal_is_bounded_on_disk(self, tmp_path):
+        spool = FeedbackSpool(str(tmp_path), capacity=1000,
+                              segment_records=5, max_segments=2)
+        for i in range(23):
+            spool.add(_rec(f"r{i}", float(i)))
+        spool.close()
+        segs = sorted(p for p in os.listdir(tmp_path)
+                      if p.startswith("spool-"))
+        assert len(segs) <= 2  # oldest segments deleted — bounded spool
+        # the newest journal lines are valid JSON with the record fields
+        with open(tmp_path / segs[-1]) as f:
+            doc = json.loads(f.readline())
+        assert {"id", "ts", "line", "score", "version"} <= set(doc)
+
+    def test_importance_many_matches_per_record_path(self, tmp_path):
+        """The real tracker's batched importance (one lock acquisition
+        per eviction) ranks candidates exactly like the per-record
+        fallback the _Tracker stand-in exercises."""
+        from distlr_tpu.serve.hotset import HotSetTracker
+
+        tracker = HotSetTracker(16)
+        for _ in range(5):
+            tracker.observe([7])
+        spool = FeedbackSpool(str(tmp_path), capacity=3, tracker=tracker,
+                              evict_scan=3)
+        spool.add(_rec("cold-0", 1.0, keys=[1]))
+        spool.add(_rec("hot", 2.0, keys=[7]))
+        spool.add(_rec("cold-1", 3.0, keys=[2]))
+        spool.add(_rec("cold-2", 4.0, keys=[3]))  # over capacity
+        assert spool.pop("hot") is not None
+        assert spool.pop("cold-0") is None
+        assert tracker.importance_many([[7], [1], None, []]) == \
+            [tracker.importance([7]), tracker.importance([1]), 0.0, 0.0]
+
+    def test_journal_segments_resume_across_restart(self, tmp_path):
+        """A restarted spool opens a FRESH segment past the old run's
+        (no mixing) and re-enforces the max_segments disk bound over
+        what the old run left behind."""
+        spool = FeedbackSpool(str(tmp_path), capacity=1000,
+                              segment_records=5, max_segments=2)
+        for i in range(23):
+            spool.add(_rec(f"r{i}", float(i)))
+        spool.close()
+        before = sorted(p for p in os.listdir(tmp_path)
+                        if p.startswith("spool-"))
+        spool2 = FeedbackSpool(str(tmp_path), capacity=1000,
+                               segment_records=5, max_segments=2)
+        spool2.add(_rec("next-run", 99.0))
+        spool2.close()
+        segs = sorted(p for p in os.listdir(tmp_path)
+                      if p.startswith("spool-"))
+        assert len(segs) <= 2  # bound holds across the restart
+        assert segs[-1] not in before  # fresh segment, no mixed runs
+        with open(tmp_path / segs[-1]) as f:
+            assert json.loads(f.readline())["id"] == "next-run"
+
+    def test_per_row_keys_and_strip_label(self):
+        X = np.zeros((2, 6), np.float32)
+        X[0, [1, 4]] = 1.0
+        X[1, 2] = 2.0
+        keys = per_row_keys("binary_lr", (X,))
+        assert keys[0].tolist() == [1, 4] and keys[1].tolist() == [2]
+        cols = np.array([[3, 5], [1, 1]])
+        skeys = per_row_keys("sparse_lr", (cols, np.ones_like(cols)))
+        assert skeys[0].tolist() == [3, 5] and skeys[1].tolist() == [1]
+        assert strip_label("1 3:1 4:2") == "3:1 4:2"
+        assert strip_label("3:1 4:2") == "3:1 4:2"
+        assert strip_label("0.5 1:2") == "1:2"
+
+
+# ---------------------------------------------------------------------------
+# joiner
+# ---------------------------------------------------------------------------
+
+class TestLabelJoiner:
+    def _mk(self, tmp_path, **kw):
+        spool = FeedbackSpool(str(tmp_path / "spool"), capacity=100)
+        kw.setdefault("window_s", 10.0)
+        kw.setdefault("shard_records", 3)
+        j = LabelJoiner(spool, str(tmp_path / "shards"), **kw)
+        return spool, j
+
+    def _shards(self, j):
+        return sorted(p for p in os.listdir(j.out_dir)
+                      if p.endswith(".libsvm"))
+
+    def test_join_emits_labeled_lines(self, tmp_path):
+        _, j = self._mk(tmp_path)
+        for i in range(3):
+            j.scored(_rec(f"r{i}", float(i), line=f"{i + 1}:1"))
+        assert j.label("r0", 1, ts=5.0) == "joined"
+        assert j.label("r1", 0, ts=5.0) == "joined"
+        assert j.label("r2", 1, ts=5.0) == "joined"
+        shards = self._shards(j)
+        assert len(shards) == 1  # shard_records=3 filled exactly once
+        with open(os.path.join(j.out_dir, shards[0])) as f:
+            assert f.read().splitlines() == ["1 1:1", "0 2:1", "1 3:1"]
+
+    def test_label_before_request_joins_on_arrival(self, tmp_path):
+        _, j = self._mk(tmp_path)
+        assert j.label("early", 1, ts=1.0) == "pending"
+        j.scored(_rec("early", 2.0, line="9:1"))
+        assert j.joined == 1
+        j.flush()
+        with open(os.path.join(j.out_dir, self._shards(j)[0])) as f:
+            assert f.read().splitlines() == ["1 9:1"]
+
+    def test_duplicate_labels_counted_not_reemitted(self, tmp_path):
+        _, j = self._mk(tmp_path)
+        j.scored(_rec("r", 1.0))
+        assert j.label("r", 1, ts=2.0) == "joined"
+        assert j.label("r", 0, ts=2.5) == "duplicate"
+        assert j.joined == 1
+
+    def test_expired_window_negative_sampling(self, tmp_path):
+        _, j = self._mk(tmp_path, window_s=5.0, negative_rate=1.0)
+        j.scored(_rec("old", 0.0, line="2:1"))
+        j.scored(_rec("fresh", 8.0, line="3:1"))
+        j.tick(now=6.0)  # only "old" is past the window
+        assert j.negatives == 1
+        j.flush()
+        with open(os.path.join(j.out_dir, self._shards(j)[0])) as f:
+            assert f.read().splitlines() == ["0 2:1"]
+        # the fresh record is still joinable
+        assert j.label("fresh", 1, ts=9.0) == "joined"
+
+    def test_expired_window_drop_when_rate_zero(self, tmp_path):
+        spool, j = self._mk(tmp_path, window_s=5.0, negative_rate=0.0)
+        j.scored(_rec("old", 0.0))
+        j.tick(now=6.0)
+        assert j.negatives == 0 and len(spool) == 0
+        # a late label for the expired request no longer joins
+        assert j.label("old", 1, ts=7.0) == "duplicate"
+
+    def test_unmatched_labels_expire(self, tmp_path):
+        _, j = self._mk(tmp_path, window_s=5.0)
+        assert j.label("ghost", 1, ts=0.0) == "pending"
+        j.tick(now=6.0)
+        assert j.stats()["pending_labels"] == 0
+
+    def test_shard_seq_resumes_past_previous_run(self, tmp_path):
+        """A restarted joiner must never os.replace-clobber shards a
+        lagging online trainer has not consumed yet — numbering resumes
+        after BOTH unconsumed (.libsvm) and consumed (.done) shards."""
+        _, j = self._mk(tmp_path)
+        for i in range(3):
+            j.scored(_rec(f"r{i}", float(i), line=f"{i + 1}:1"))
+            j.label(f"r{i}", 1, ts=5.0)
+        assert self._shards(j) == ["shard-000000.libsvm"]
+        # simulate the trainer consuming shard 0, then a serve restart
+        os.replace(os.path.join(j.out_dir, "shard-000000.libsvm"),
+                   os.path.join(j.out_dir, "shard-000000.libsvm.done"))
+        _, j2 = self._mk(tmp_path)
+        j2.scored(_rec("s0", 1.0, line="5:1"))
+        j2.label("s0", 0, ts=2.0)
+        j2.flush()
+        assert self._shards(j2) == ["shard-000001.libsvm"]
+        with open(os.path.join(j2.out_dir, "shard-000001.libsvm")) as f:
+            assert f.read().splitlines() == ["0 5:1"]
+
+
+# ---------------------------------------------------------------------------
+# drift
+# ---------------------------------------------------------------------------
+
+class TestScoreDrift:
+    def test_fires_on_shift_and_clears_when_stable(self):
+        det = ScoreDriftDetector(block=100, threshold=0.2)
+        det.observe(np.full(200, 0.45))   # two identical blocks: PSI ~ 0
+        assert det.psi_last is not None and det.psi_last < 0.01
+        assert not det.firing
+        det.observe(np.full(100, 0.92))   # distribution jumps: fires
+        assert det.firing and det.fired_total == 1
+        det.observe(np.full(100, 0.92))   # stable at the NEW level: clears
+        assert not det.firing and det.cleared_total == 1
+
+    def test_gradual_noise_does_not_fire(self):
+        rng = np.random.default_rng(0)
+        det = ScoreDriftDetector(block=200, threshold=0.25)
+        det.observe(rng.uniform(0.3, 0.7, size=1000))
+        assert det.fired_total == 0
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            ScoreDriftDetector(block=0)
+        with pytest.raises(ValueError):
+            ScoreDriftDetector(threshold=0.0)
+
+
+# ---------------------------------------------------------------------------
+# online trainer (unit: pre-written shards, SGD servers)
+# ---------------------------------------------------------------------------
+
+def _libsvm(x):
+    return " ".join(f"{i + 1}:{v:g}" for i, v in enumerate(x) if v)
+
+
+def _make_rows(n, w_true, rng, *, min_margin=2.0):
+    """Dense 0/1 rows with an unambiguous label under ``w_true``."""
+    X, y = [], []
+    while len(X) < n:
+        x = np.zeros(len(w_true), np.float32)
+        x[rng.choice(len(w_true), size=4, replace=False)] = 1.0
+        m = float(x @ w_true)
+        if abs(m) < min_margin:
+            continue
+        X.append(x)
+        y.append(1 if m > 0 else 0)
+    return np.stack(X), np.asarray(y, np.int32)
+
+
+class TestOnlineTrainer:
+    def test_consumes_shards_and_learns(self, tmp_path):
+        rng = np.random.default_rng(0)
+        w_true = np.where(np.arange(D) % 2 == 0, 1.0, -1.0).astype(np.float32)
+        X, y = _make_rows(160, w_true, rng)
+        shard_dir = tmp_path / "shards"
+        shard_dir.mkdir()
+        for s in range(4):
+            with open(shard_dir / f"shard-{s:06d}.libsvm", "w") as f:
+                for i in range(s * 40, (s + 1) * 40):
+                    f.write(f"{y[i]} {_libsvm(X[i])}\n")
+        cfg = Config(model="binary_lr", num_feature_dim=D, batch_size=20,
+                     l2_c=0.0, sync_mode=False, learning_rate=0.5)
+        with ServerGroup(1, 1, D, sync=False, learning_rate=0.5) as sg:
+            tr = OnlineTrainer(cfg, sg.hosts, str(shard_dir),
+                               accum_start=1, accum_growth=2.0,
+                               accum_growth_every=2, accum_max=4,
+                               poll_interval_s=0.05)
+            stats = tr.run(max_shards=4)
+            with KVWorker(sg.hosts, D) as kv:
+                w = kv.pull()
+            tr.close()
+        assert stats["shards_consumed"] == 4
+        assert stats["examples"] == 160
+        assert stats["pushes"] >= 2
+        # AdaBatch schedule grew (growth_every=2 pushes, x2, capped at 4)
+        assert stats["accum_k"] > 1
+        # consumed shards stepped aside
+        assert not [p for p in os.listdir(shard_dir)
+                    if p.endswith(".libsvm")]
+        assert [p for p in os.listdir(shard_dir) if p.endswith(".done")]
+        # and the model learned the separator
+        acc = float((((X @ w) > 0).astype(np.int32) == y).mean())
+        assert acc > 0.85, f"online trainer failed to learn (acc={acc})"
+
+    def test_sparse_model_keyed_pushes(self, tmp_path):
+        rng = np.random.default_rng(1)
+        w_true = np.where(np.arange(D) % 2 == 0, 1.0, -1.0).astype(np.float32)
+        X, y = _make_rows(120, w_true, rng)
+        shard_dir = tmp_path / "shards"
+        shard_dir.mkdir()
+        with open(shard_dir / "shard-000000.libsvm", "w") as f:
+            for i in range(len(y)):
+                f.write(f"{y[i]} {_libsvm(X[i])}\n")
+        cfg = Config(model="sparse_lr", num_feature_dim=D, batch_size=30,
+                     l2_c=0.0, sync_mode=False, learning_rate=0.5)
+        with ServerGroup(2, 1, D, sync=False, learning_rate=0.5) as sg:
+            tr = OnlineTrainer(cfg, sg.hosts, str(shard_dir),
+                               poll_interval_s=0.05)
+            stats = tr.run(max_shards=1)
+            with KVWorker(sg.hosts, D) as kv:
+                w = kv.pull()
+            tr.close()
+        assert stats["examples"] == 120 and stats["pushes"] >= 1
+        acc = float((((X @ w) > 0).astype(np.int32) == y).mean())
+        assert acc > 0.8
+
+    def test_rejects_unsupported_model(self, tmp_path):
+        cfg = Config(model="blocked_lr", num_feature_dim=D, block_size=8)
+        with pytest.raises(ValueError, match="online training supports"):
+            OnlineTrainer(cfg, "127.0.0.1:1", str(tmp_path))
+
+
+# ---------------------------------------------------------------------------
+# serve protocol (LABEL / ID lines, STATS, JSON ids)
+# ---------------------------------------------------------------------------
+
+class TestServeProtocol:
+    def _server(self, tmp_path, with_feedback=True):
+        from distlr_tpu.serve import ScoringEngine, ScoringServer  # noqa: PLC0415
+
+        cfg = Config(model="binary_lr", num_feature_dim=D, l2_c=0.0)
+        engine = ScoringEngine(cfg, max_batch_size=64)
+        engine.set_weights(np.linspace(-1, 1, D).astype(np.float32))
+        sink = None
+        if with_feedback:
+            sink = FeedbackSink(str(tmp_path / "spool"),
+                                str(tmp_path / "shards"),
+                                model="binary_lr", window_s=30.0,
+                                shard_records=4)
+        return ScoringServer(engine, feedback=sink), sink
+
+    def test_id_and_label_lines(self, tmp_path):
+        srv, sink = self._server(tmp_path)
+        try:
+            reply = srv.handle_line("ID req-1 3:1 5:1")
+            assert not reply.startswith("ERR")
+            assert len(sink.spool) == 1
+            assert srv.handle_line("LABEL req-1 1") == "OK joined"
+            assert srv.handle_line("LABEL req-1 0") == "OK duplicate"
+            assert srv.handle_line("LABEL never-seen 1") == "OK pending"
+            assert srv.handle_line("LABEL bad").startswith("ERR")
+            assert srv.handle_line("LABEL x 7").startswith("ERR")
+        finally:
+            srv.stop()
+
+    def test_label_without_sink_is_err(self, tmp_path):
+        srv, _ = self._server(tmp_path, with_feedback=False)
+        try:
+            assert srv.handle_line("LABEL x 1").startswith("ERR")
+            # plain scoring still works and nothing is journaled
+            assert not srv.handle_line("3:1").startswith("ERR")
+        finally:
+            srv.stop()
+
+    def test_json_ids_and_stats_schema(self, tmp_path):
+        srv, sink = self._server(tmp_path)
+        try:
+            req = json.dumps({"rows": ["1:1", "2:1"], "ids": ["a", None]})
+            doc = json.loads(srv.handle_line(req))
+            assert len(doc["scores"]) == 2
+            assert srv.handle_line("LABEL a 1") == "OK joined"
+            # auto-id rows are spooled too (negative-sampling pool)
+            assert len(sink.spool) == 1
+            stats = srv.stats()
+            assert "feedback" in stats
+            assert stats["feedback"]["join"]["joined"] == 1
+            bad = json.dumps({"rows": ["1:1"], "ids": ["a", "b"]})
+            assert srv.handle_line(bad).startswith("ERR")
+        finally:
+            srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# the closed loop
+# ---------------------------------------------------------------------------
+
+CHAOS_PLAN = {
+    "seed": 11,
+    "faults": [
+        {"kind": "delay", "links": "*", "delay_ms": 3, "jitter_ms": 2},
+        {"kind": "reset", "links": [0], "after_ops": 150},
+    ],
+}
+
+
+class _LoopHarness:
+    """serve → label → join → online trainer → live PS → hot reload."""
+
+    def __init__(self, tmp_path, *, chaos=None, retry_attempts=0):
+        from distlr_tpu.serve import (  # noqa: PLC0415
+            HotReloader,
+            LivePSWatcher,
+            ScoringEngine,
+            ScoringServer,
+        )
+
+        self.cfg = Config(model="binary_lr", num_feature_dim=D,
+                          batch_size=24, l2_c=0.0, sync_mode=False,
+                          ps_timeout_ms=20_000,
+                          ps_retry_attempts=retry_attempts,
+                          ps_retry_backoff_ms=20.0,
+                          ps_retry_deadline_s=20.0)
+        self.group = ServerGroup(
+            1, 1, D, sync=False, optimizer="ftrl", ftrl_alpha=1.0,
+            ftrl_beta=1.0, ftrl_l1=0.001, ftrl_l2=0.0, via_chaos=chaos,
+        ).start()
+        # the online trainer seeds the group (zero init) — the loop's
+        # only trainer, exactly the from-cold production bring-up
+        self.trainer = OnlineTrainer(
+            self.cfg, self.group.hosts, str(tmp_path / "shards"),
+            accum_start=1, accum_growth=2.0, accum_growth_every=50,
+            accum_max=4, poll_interval_s=0.05, idle_flush_s=0.2)
+        self.sink = FeedbackSink(
+            str(tmp_path / "spool"), str(tmp_path / "shards"),
+            model="binary_lr", window_s=1.0, negative_rate=0.3,
+            shard_records=24, drift_block=120, drift_threshold=0.15,
+            tick_interval_s=0.1, idle_flush_s=0.3)
+        self.engine = ScoringEngine(self.cfg, max_batch_size=64)
+        retry = None
+        if retry_attempts:
+            from distlr_tpu.ps import RetryPolicy  # noqa: PLC0415
+
+            retry = RetryPolicy(attempts=retry_attempts, backoff_ms=20.0,
+                                deadline_s=20.0)
+        self.reloader = HotReloader(
+            self.engine,
+            LivePSWatcher(self.group.hosts, D, retry=retry),
+            interval_s=0.1, jitter=0.0).start()
+        self.reloader.wait_for_weights(timeout_s=20.0)
+        self.server = ScoringServer(self.engine, feedback=self.sink,
+                                    max_wait_ms=1.0,
+                                    reloader=self.reloader).start()
+        self._stop = threading.Event()
+        self._trainer_thread = threading.Thread(
+            target=self.trainer.run, kwargs={"stop": self._stop},
+            daemon=True)
+        self._trainer_thread.start()
+        self._sock = socket.create_connection(
+            (self.server.host, self.server.port), timeout=30.0)
+        self._f = self._sock.makefile("rwb")
+        self._next_id = 0
+
+    def _exchange(self, line):
+        self._f.write((line + "\n").encode())
+        self._f.flush()
+        reply = self._f.readline().decode().rstrip("\n")
+        assert reply, "server closed mid-stream"
+        return reply
+
+    def drive(self, X, y, *, label_frac=0.85, rng=None):
+        """Score + (mostly) label a traffic burst."""
+        rng = rng or np.random.default_rng(0)
+        for i in range(len(y)):
+            rid = f"r{self._next_id}"
+            self._next_id += 1
+            reply = self._exchange(f"ID {rid} {_libsvm(X[i])}")
+            assert not reply.startswith("ERR"), reply
+            if rng.random() < label_frac:
+                reply = self._exchange(f"LABEL {rid} {int(y[i])}")
+                assert reply.startswith("OK"), reply
+
+    def probe(self, X):
+        req = json.dumps({"rows": [_libsvm(x) for x in X]})
+        doc = json.loads(self._exchange(req))
+        return np.asarray(doc["scores"], np.float64)
+
+    def close(self):
+        self._stop.set()
+        self._trainer_thread.join(timeout=20)
+        try:
+            self._f.close()
+            self._sock.close()
+        except OSError:
+            pass
+        self.server.stop()
+        self.trainer.close()
+        self.group.stop()
+
+
+def _run_closed_loop(tmp_path, *, chaos=None, retry_attempts=0,
+                     deadline_s=60.0):
+    rng = np.random.default_rng(42)
+    w_true = np.where(np.arange(D) % 2 == 0, 1.0, -1.0).astype(np.float32)
+    Xp, _ = _make_rows(8, w_true, rng)          # probes: 4 pos, 4 neg
+    yp = (Xp @ w_true > 0).astype(np.int32)
+    pos, neg = Xp[yp == 1], Xp[yp == 0]
+    assert len(pos) and len(neg)
+
+    h = _LoopHarness(tmp_path, chaos=chaos, retry_attempts=retry_attempts)
+    try:
+        def adapted(sign):
+            sp, sn = h.probe(pos).mean(), h.probe(neg).mean()
+            return (sp > 0.6 and sn < 0.4) if sign > 0 else \
+                   (sp < 0.4 and sn > 0.6)
+
+        def phase(truth_sign, tag):
+            deadline = time.monotonic() + deadline_s
+            while True:
+                X, y = _make_rows(60, truth_sign * w_true, rng)
+                h.drive(X, y, rng=rng)
+                time.sleep(0.3)  # window ticks, trainer consumes, reloads
+                if adapted(truth_sign):
+                    return
+                assert time.monotonic() < deadline, (
+                    f"{tag}: served scores never tracked the label "
+                    f"distribution; pos={h.probe(pos).mean():.3f} "
+                    f"neg={h.probe(neg).mean():.3f} "
+                    f"stats={h.sink.stats()} trainer={h.trainer.stats()}")
+
+        # phase 1: learn the world from cold (scores start at 0.5)
+        phase(+1, "phase1")
+        # phase 2: THE FLIP — labels invert mid-run, zero restarts
+        phase(-1, "phase2")
+        assert h.sink.drift.fired_total >= 1, h.sink.drift.stats()
+        # stable tail: consistent traffic until the drift alert clears
+        deadline = time.monotonic() + deadline_s
+        while h.sink.drift.firing:
+            X, y = _make_rows(60, -w_true, rng)
+            h.drive(X, y, rng=rng)
+            time.sleep(0.2)
+            assert time.monotonic() < deadline, (
+                f"drift alert never cleared: {h.sink.drift.stats()}")
+        # loop accounting: labels joined, never-labeled negative-sampled
+        st = h.sink.stats()
+        assert st["join"]["joined"] > 50, st
+        assert h.trainer.pushes > 0 and h.trainer.examples > 0
+        # the alert gauge is scrape-visible with its threshold label
+        from distlr_tpu.obs.registry import get_registry  # noqa: PLC0415
+
+        text = get_registry().prometheus_text()
+        assert 'distlr_alert_score_drift{threshold="0.15"} 0' in text
+        return h
+    finally:
+        h.close()
+
+
+class TestClosedLoopEndToEnd:
+    def test_closed_loop_tracks_label_flip(self, tmp_path):
+        """Tier-1 acceptance: the full loop adapts to a mid-run label
+        flip with zero restarts; drift fires then clears."""
+        _run_closed_loop(tmp_path)
+
+    @pytest.mark.slow
+    def test_closed_loop_under_chaos(self, tmp_path):
+        """Slow soak: same loop with the PS legs crossing the chaos
+        proxy (delay + a mid-run reset) — faults cost retries, not
+        restarts, and the loop still adapts."""
+        from distlr_tpu.chaos import parse_plan  # noqa: PLC0415
+
+        plan = parse_plan(CHAOS_PLAN)
+        _run_closed_loop(tmp_path, chaos=plan, retry_attempts=4,
+                         deadline_s=120.0)
