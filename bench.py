@@ -44,21 +44,32 @@ def resilience_snapshot() -> dict:
     that ran under network faults — or silently fought a flaky link —
     banks what the faults cost next to what the run scored; all-zero is
     the healthy-network signature."""
-    from distlr_tpu.obs.registry import get_registry  # noqa: PLC0415
-
-    reg = get_registry()
-
-    def total(name: str) -> int:
-        fam = reg.get(name)
-        if fam is None:
-            return 0
-        return int(sum(child.value for _v, child in fam.children()))
+    from distlr_tpu.obs.registry import family_total  # noqa: PLC0415
 
     return {
-        "retries": total("distlr_ps_retries_total"),
-        "reconnects": total("distlr_ps_reconnects_total"),
-        "push_outcome_unknown": total("distlr_ps_push_outcome_unknown_total"),
-        "chaos_faults": total("distlr_chaos_faults_total"),
+        "retries": int(family_total("distlr_ps_retries_total")),
+        "reconnects": int(family_total("distlr_ps_reconnects_total")),
+        "push_outcome_unknown": int(
+            family_total("distlr_ps_push_outcome_unknown_total")),
+        "chaos_faults": int(family_total("distlr_chaos_faults_total")),
+    }
+
+
+def compression_snapshot() -> dict:
+    """Push-byte accounting of THIS process's registry at read time
+    (ISSUE 7): raw = dense-f32-equivalent bytes of every delivered
+    gradient push, wire = what actually crossed (coded payloads +
+    re-rowed keys + headers), ratio = raw/wire.  All-zero raw means the
+    run never pushed to a PS (e.g. the on-device headline); a ratio of
+    ~1.0 means pushes went dense f32."""
+    from distlr_tpu.obs.registry import family_total  # noqa: PLC0415
+
+    raw = family_total("distlr_ps_push_bytes_raw_total")
+    wire = family_total("distlr_ps_push_bytes_wire_total")
+    return {
+        "push_bytes_raw": int(raw),
+        "push_bytes_wire": int(wire),
+        "compress_ratio": round(raw / wire, 3) if wire else 1.0,
     }
 
 
@@ -619,6 +630,10 @@ def main():
         # faults): all-zero = healthy network; non-zero explains a slow
         # row without re-running it
         "resilience": resilience_snapshot(),
+        # push-byte accounting (raw/wire/ratio): zero for the on-device
+        # headline, meaningful for any sub-run that pushed to a PS —
+        # benchmarks/bench_compress.py measures the codecs head-on
+        **compression_snapshot(),
         **subs,
     }
     if smoke:

@@ -37,6 +37,12 @@ def _resilience() -> dict:
     return resilience_snapshot()
 
 
+def _compression() -> dict:
+    from bench import compression_snapshot  # noqa: PLC0415
+
+    return compression_snapshot()
+
+
 def bench_join(n_events: int, d: int, nnz: int, tmp: str) -> dict:
     """Scored+labeled event pairs through spool + joiner, events/s."""
     import numpy as np  # noqa: PLC0415
@@ -159,6 +165,9 @@ def main() -> int:
         "D": d_train,
         "optimizer": "ftrl",
         "resilience": _resilience(),
+        # push-byte accounting of the trainer leg (raw/wire/ratio; the
+        # online trainer's pushes ride cfg.ps_compress like everyone's)
+        **_compression(),
         **subs,
     }
     print(json.dumps(row))

@@ -67,6 +67,18 @@ DISTLR_METRICS_SNAPSHOT="benchmarks/capture_logs/online_metrics.prom:benchmarks/
   && echo "bench_online ok" \
   || echo "bench_online failed (non-fatal; artifact not refreshed)"
 
+echo "== bench_compress.py (push-byte reduction through throttled link; best-effort) =="
+# Gradient-compression row (ISSUE 7): dense/int8/int8+AdaBatch/signSGD
+# push bytes + quality at D=1M, every run crossing the chaos proxy's
+# throttle mode (the DCN stand-in — localhost alone won't show the
+# win).  Host-side path, but banked in the window so the on-chip record
+# carries the codec story at the same rev as everything else.
+DISTLR_METRICS_SNAPSHOT="benchmarks/capture_logs/fleet/snapshots/compress-0.json" \
+  timeout 900 python -u benchmarks/bench_compress.py \
+  > benchmarks/capture_logs/bench_compress.json \
+  && echo "bench_compress ok" \
+  || echo "bench_compress failed (non-fatal; artifact not refreshed)"
+
 echo "== bank the fleet metrics snapshot (merged view; best-effort) =="
 # Federates every snapshot banked into the window's fleet dir (today:
 # bench.py; any --obs-run-dir'd process that joins a future window rides

@@ -46,6 +46,7 @@ import threading
 import time
 
 from distlr_tpu.chaos.plan import FaultPlan, FaultSpec
+from distlr_tpu.compress import codecs
 from distlr_tpu.obs.registry import get_registry
 from distlr_tpu.utils.logging import get_logger
 
@@ -82,6 +83,24 @@ _DELAY_MS = _reg.counter(
 _HEADER = struct.Struct("<IBBHIIQ")
 _MAGIC = 0xD157C0DE
 _OP_PUSH, _OP_PUSHPULL = 1, 7
+#: flags fields the framing depends on (kv_protocol.h): bits 4-5 carry
+#: the gradient codec of a push-class value payload, bit 6 marks an
+#: opt-state op (2x vals per key)
+_CODEC_SHIFT, _CODEC_MASK, _OPT_STATE = 4, 0x30, 64
+_CODEC_NAMES = {v: k for k, v in codecs.CODEC_IDS.items()}
+
+
+def _push_vals_bytes(flags: int, n_flat: int) -> int:
+    """Value-payload bytes of a push-class frame carrying ``n_flat``
+    expanded values — codec-aware via the shared
+    :func:`distlr_tpu.compress.codecs.payload_bytes` (one definition of
+    the byte layout next to the native CodecPayloadBytes): a proxy that
+    assumed dense f32 would misframe every compressed push and degrade
+    the whole stream to a raw relay, silently disabling op-offset
+    faults for exactly the runs the compression bench needs them on."""
+    codec = _CODEC_NAMES.get((flags & _CODEC_MASK) >> _CODEC_SHIFT, "none")
+    mult = 2 if codec == "none" and flags & _OPT_STATE else 1
+    return codecs.payload_bytes(codec, n_flat) * mult
 #: pump socket timeout: bounds stop() latency without busy-waiting
 _TICK_S = 0.1
 #: event-log cap — a runaway plan must not grow memory unboundedly
@@ -241,7 +260,12 @@ class ChaosLink:
                 end = time.monotonic() + pause
                 while (time.monotonic() < end
                        and not (self._stop.is_set() or severed.is_set())):
-                    time.sleep(min(_TICK_S, end - time.monotonic()))
+                    # re-read the clock for the sleep arg: the deadline
+                    # can pass between the while-check and here, and a
+                    # negative sleep raises, killing the pump thread
+                    # (observed as a spurious severed link under a
+                    # high-rate throttle)
+                    time.sleep(min(_TICK_S, max(0.0, end - time.monotonic())))
                 return
 
     def _sever(self, down: socket.socket, up: socket.socket,
@@ -271,7 +295,7 @@ class ChaosLink:
                 header = self._read_exact(down, _HEADER.size, severed)
                 if header is None:
                     break
-                magic, op, _flags, aux, _cid, _ts, num_keys = \
+                magic, op, flags, aux, _cid, _ts, num_keys = \
                     _HEADER.unpack(header)
                 if magic != _MAGIC:
                     # not KV framing (or stream corrupted upstream of
@@ -284,7 +308,7 @@ class ChaosLink:
                 vpk = max(aux, 1) if op in (_OP_PUSH, _OP_PUSHPULL) else 1
                 payload_len = num_keys * 8
                 if op in (_OP_PUSH, _OP_PUSHPULL):
-                    payload_len += num_keys * vpk * 4
+                    payload_len += _push_vals_bytes(flags, num_keys * vpk)
                 payload = b""
                 if payload_len:
                     payload = self._read_exact(down, payload_len, severed)

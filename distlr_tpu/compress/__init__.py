@@ -1,0 +1,53 @@
+"""Gradient compression on the PS wire (ISSUE 7).
+
+Two cooperating levers that multiply:
+
+* **wire codecs** (:mod:`distlr_tpu.compress.codecs`): the value
+  payload of every gradient push crosses the wire int8 block-quantized
+  (``--ps-compress int8``, ~3.9x fewer value bytes, error <= scale/2)
+  or as 1-bit signSGD (``--ps-compress signsgd``, 32x, majority-vote
+  aggregation server-side).  Negotiated per connection via the kHello
+  capability handshake — old servers answer empty and the client falls
+  back to dense f32, so mixed fleets degrade instead of desynchronize.
+  Encode/decode run natively (``ps/native``); this package holds the
+  bit-exact NumPy reference the parity tests oracle against.
+
+* **AdaBatch accumulation** (:mod:`distlr_tpu.compress.accum`): push
+  the MEAN every k batches with k growing on a schedule
+  (``--accum-start``/``--accum-max``) — divides push frequency, and
+  under a keyed model also unions k batches' key sets into one frame.
+
+``--ps-compress none`` (the default) skips negotiation entirely: not
+one wire byte differs from the previous round, so the oracle-pinned
+trajectories stand.
+"""
+
+from distlr_tpu.compress.accum import GradientAccumulator
+from distlr_tpu.compress.codecs import (
+    CODEC_IDS,
+    CODECS,
+    QUANT_BLOCK,
+    decode_int8,
+    decode_sign,
+    encode_int8,
+    encode_sign,
+    int8_error_bound,
+    int8_roundtrip,
+    payload_bytes,
+    sign_roundtrip,
+)
+
+__all__ = [
+    "CODEC_IDS",
+    "CODECS",
+    "QUANT_BLOCK",
+    "GradientAccumulator",
+    "decode_int8",
+    "decode_sign",
+    "encode_int8",
+    "encode_sign",
+    "int8_error_bound",
+    "int8_roundtrip",
+    "payload_bytes",
+    "sign_roundtrip",
+]

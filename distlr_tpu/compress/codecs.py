@@ -1,0 +1,118 @@
+"""NumPy reference implementations of the gradient wire codecs.
+
+These mirror the native ``EncodeGrad``/``DecodeGrad`` in
+``ps/native/kv_protocol.h`` BIT FOR BIT — same block size, same
+``amax/127`` symmetric scale, same round-half-to-even (``np.rint`` ==
+``nearbyintf``), same LSB-first sign bitmap — so they serve as the
+oracle the wire-parity tests compare real server state against, and as
+the raw/wire byte calculators benches and docs use.  The hot path never
+runs this Python: clients encode in the native library, servers decode
+at the parsing layer.
+
+Codec table (the ``--ps-compress`` choices):
+
+=========  =====================================  ==================
+codec      value payload per n coords             bytes (vs 4n dense)
+=========  =====================================  ==================
+``none``   n float32                              ``4n``
+``int8``   ceil(n/256) f32 scales + n int8        ``~n + n/64``
+``signsgd``  ceil(n/8) bitmap bytes               ``n/8``
+=========  =====================================  ==================
+
+``int8`` decode error is bounded by ``scale/2`` per coordinate (scale =
+the block's ``amax/127``) — quality-neutral for SGD/FTRL gradients in
+practice.  ``signsgd`` keeps only the sign; it is only meaningful
+against the server's majority-vote kernel (``--optimizer=signsgd``) and
+needs a signSGD-scale learning rate.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+#: int8 block-quantization granularity (values per f32 scale) — must
+#: match kQuantBlock in ps/native/kv_protocol.h
+QUANT_BLOCK = 256
+
+#: wire codec ids (kv_protocol.h Codec) keyed by the --ps-compress name
+CODEC_IDS = {"none": 0, "int8": 1, "signsgd": 2}
+CODECS = tuple(CODEC_IDS)
+
+
+def payload_bytes(codec: str, n: int) -> int:
+    """Exact value-payload bytes of a coded frame carrying ``n`` values
+    (the native ``CodecPayloadBytes``)."""
+    if codec == "int8":
+        return ((n + QUANT_BLOCK - 1) // QUANT_BLOCK) * 4 + n
+    if codec == "signsgd":
+        return (n + 7) // 8
+    if codec == "none":
+        return 4 * n
+    raise ValueError(f"unknown codec {codec!r} (choose from {CODECS})")
+
+
+def encode_int8(v: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+    """Block-symmetric int8 quantization: ``(scales, q)`` with one f32
+    scale per :data:`QUANT_BLOCK` values, ``q = rint(v/scale)`` clamped
+    to [-127, 127] (ties to even, matching ``nearbyintf``)."""
+    v = np.ascontiguousarray(v, np.float32).reshape(-1)
+    n = v.size
+    nb = (n + QUANT_BLOCK - 1) // QUANT_BLOCK
+    padded = np.zeros(nb * QUANT_BLOCK, np.float32)
+    padded[:n] = v
+    blocks = padded.reshape(nb, QUANT_BLOCK)
+    scales = (np.abs(blocks).max(axis=1) / np.float32(127.0)).astype(
+        np.float32)
+    safe = np.where(scales > 0, scales, np.float32(1.0))
+    q = np.clip(np.rint(blocks / safe[:, None]), -127, 127)
+    q = np.where(scales[:, None] > 0, q, 0.0).astype(np.int8)
+    return scales, q.reshape(-1)[:n]
+
+
+def decode_int8(scales: np.ndarray, q: np.ndarray) -> np.ndarray:
+    """Inverse of :func:`encode_int8`: ``v = q * scale`` in f32."""
+    q = np.asarray(q, np.int8)
+    scales = np.asarray(scales, np.float32)
+    idx = np.arange(q.size) // QUANT_BLOCK
+    return (q.astype(np.float32) * scales[idx]).astype(np.float32)
+
+
+def int8_roundtrip(v: np.ndarray) -> np.ndarray:
+    """``decode(encode(v))`` — what the server's optimizer actually sees
+    for an int8-coded push (the wire-parity oracle)."""
+    return decode_int8(*encode_int8(v))
+
+
+def int8_error_bound(v: np.ndarray) -> np.ndarray:
+    """Per-coordinate worst-case quantization error: half the owning
+    block's scale (+1 ulp of slack for the f32 divide/multiply)."""
+    v = np.ascontiguousarray(v, np.float32).reshape(-1)
+    n = v.size
+    nb = (n + QUANT_BLOCK - 1) // QUANT_BLOCK
+    padded = np.zeros(nb * QUANT_BLOCK, np.float32)
+    padded[:n] = v
+    scales = np.abs(padded.reshape(nb, QUANT_BLOCK)).max(axis=1) / 127.0
+    per = scales[np.arange(n) // QUANT_BLOCK]
+    return (per / 2.0 + np.abs(v) * 1e-6).astype(np.float32)
+
+
+def encode_sign(v: np.ndarray) -> np.ndarray:
+    """1-bit signSGD encoding: LSB-first bitmap, bit i = (v_i > 0).
+    An exact zero encodes as 0 (decodes -1) — senders push touched
+    coordinates, where exact zeros carry no information anyway."""
+    v = np.ascontiguousarray(v, np.float32).reshape(-1)
+    bits = (v > 0).astype(np.uint8)
+    return np.packbits(bits, bitorder="little")
+
+
+def decode_sign(bitmap: np.ndarray, n: int) -> np.ndarray:
+    """Inverse of :func:`encode_sign`: ±1 float32 per coordinate."""
+    bits = np.unpackbits(np.asarray(bitmap, np.uint8),
+                         count=n, bitorder="little")
+    return np.where(bits > 0, np.float32(1.0), np.float32(-1.0))
+
+
+def sign_roundtrip(v: np.ndarray) -> np.ndarray:
+    """The ±1 vector a signSGD server decodes from a coded push of
+    ``v`` — the majority-vote oracle's per-worker input."""
+    return decode_sign(encode_sign(v), np.asarray(v).size)

@@ -190,6 +190,35 @@ class Config:
     ftrl_beta: float = 1.0            # learning-rate smoothing
     ftrl_l1: float = 0.0              # L1 strength (sparsifies weights)
     ftrl_l2: float = 0.0              # L2 strength
+    # Gradient wire codec for PS pushes (distlr_tpu.compress; negotiated
+    # per connection via the kHello capability handshake — a group with
+    # any pre-codec server falls back to dense f32).  "int8": block-
+    # quantized values with per-block f32 scales (~3.9x fewer value
+    # bytes, error <= scale/2, works under sgd and ftrl).  "signsgd":
+    # 1 bit/coordinate + server-side majority-vote aggregation (the
+    # server group is spawned --optimizer=signsgd; requires
+    # ps_optimizer="sgd" since signSGD replaces the update rule, and a
+    # signSGD-scale learning_rate — the step is lr * sign, not lr * g).
+    # "none" (default) skips negotiation entirely: zero wire deltas, so
+    # oracle-pinned trajectories stand.  Incompatible with the Q1
+    # sync_last_gradient quirk (a dense-SGD parity artifact).
+    ps_compress: str = "none"         # none | int8 | signsgd
+    # AdaBatch local accumulation (distlr_tpu.compress.accum): push the
+    # MEAN gradient every k batches, k growing from ps_accum_start by
+    # x ps_accum_growth every ps_accum_growth_every pushes, capped at
+    # ps_accum_max.  Default (1, 1) = off (push every batch, the
+    # trajectory-pinned behavior).  Divides push traffic by k on top of
+    # whatever the codec saves; within a span batches ride the span-
+    # start weights (the span is the self-staleness bound).
+    ps_accum_start: int = 1
+    ps_accum_growth: float = 2.0
+    ps_accum_growth_every: int = 32
+    ps_accum_max: int = 1
+    # Scale the retry backoff base by the observed recent transport-
+    # fault rate (FaultRateTracker) instead of keeping it static: fault
+    # storms back off up to 8x harder (still capped by
+    # ps_retry_backoff_max_ms), quiet windows decay back.
+    ps_retry_adaptive: bool = False
 
     # ---- chaos (distlr_tpu.chaos fault injection) ----
     # Path to a JSON fault plan: local `launch ps` runs interpose the
@@ -406,6 +435,34 @@ class Config:
                 "ftrl_beta/ftrl_l1/ftrl_l2 must be >= 0, got "
                 f"{self.ftrl_beta}/{self.ftrl_l1}/{self.ftrl_l2}"
             )
+        if self.ps_compress not in ("none", "int8", "signsgd"):
+            raise ValueError(
+                f"ps_compress must be none|int8|signsgd, "
+                f"got {self.ps_compress!r}")
+        if self.ps_compress != "none" and self.sync_last_gradient:
+            raise ValueError(
+                "ps_compress is incompatible with sync_last_gradient "
+                "(Q1 compat pins the dense-SGD wire trajectory)"
+            )
+        if self.ps_compress == "signsgd" and self.ps_optimizer != "sgd":
+            raise ValueError(
+                "ps_compress='signsgd' replaces the server update rule "
+                "(the group runs --optimizer=signsgd); it is incompatible "
+                f"with ps_optimizer={self.ps_optimizer!r}"
+            )
+        if self.ps_accum_start < 1 or self.ps_accum_max < self.ps_accum_start:
+            raise ValueError(
+                "need 1 <= ps_accum_start <= ps_accum_max, got "
+                f"{self.ps_accum_start}/{self.ps_accum_max} "
+                "(raise --accum-max when setting --accum-start)"
+            )
+        if self.ps_accum_growth < 1.0:
+            raise ValueError(
+                f"ps_accum_growth must be >= 1, got {self.ps_accum_growth}")
+        if self.ps_accum_growth_every <= 0:
+            raise ValueError(
+                "ps_accum_growth_every must be positive, "
+                f"got {self.ps_accum_growth_every}")
         if self.chaos_seed is not None and not 0 <= self.chaos_seed < 1 << 64:
             raise ValueError(
                 "chaos_seed must be None (use the plan's seed) or in "

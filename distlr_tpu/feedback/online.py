@@ -11,14 +11,25 @@ batch trainer's pushes) by construction — it is just one more async
 client of the Hogwild PS (the lock-free continuous-update regime of
 arXiv:1508.05711).
 
-AdaBatch-style local accumulation (arXiv:1712.02029): gradients are
+AdaBatch-style local accumulation (arXiv:1712.02029) rides the shared
+:class:`~distlr_tpu.compress.GradientAccumulator` (extracted from this
+module once the batch trainers adopted the pattern): gradients are
 accumulated locally and pushed as a mean every ``k`` batches, with
 ``k`` GROWING on a schedule (multiply by ``accum_growth`` every
 ``accum_growth_every`` pushes, capped at ``accum_max``).  Early in the
 loop's life small ``k`` keeps served weights fresh; as the model
-stabilizes, growing ``k`` cuts push traffic — the same
-communication/freshness dial the ROADMAP's gradient-compression item
-turns, applied on the cadence axis.
+stabilizes, growing ``k`` cuts push traffic — the cadence axis of the
+communication dial whose encoding axis is ``cfg.ps_compress`` (the
+negotiated wire codec; this trainer's pushes ride it too).
+
+Multi-worker sharding: any number of online trainers may share one
+shard dir.  A trainer takes a shard by atomically renaming it to
+``<shard>.claim`` (exactly one rename wins; losers skip), consumes it,
+then retires it to ``<shard>.done`` — and a ``.claim`` whose owner died
+is reclaimed after ``claim_stale_s`` (claim time is the file's mtime,
+touched at claim).  ``claim_stale_s`` must exceed the worst-case
+consume time of one shard, or a slow-but-alive worker's shard gets
+double-trained (Hogwild-tolerable, but logged).
 
 Requires an ASYNC server group: against a sync (BSP) group a lone
 online push would block forever in the deferred-reply barrier.
@@ -78,24 +89,17 @@ class OnlineTrainer:
                  accum_start: int = 1, accum_growth: float = 2.0,
                  accum_growth_every: int = 32, accum_max: int = 64,
                  poll_interval_s: float = 0.5, idle_flush_s: float = 2.0,
-                 client_id: int | None = None, seed_init: bool = True):
+                 client_id: int | None = None, seed_init: bool = True,
+                 worker_id: int = 0, claim_stale_s: float = 300.0):
         if cfg.model not in _SUPPORTED:
             raise ValueError(
                 f"online training supports {_SUPPORTED}, got {cfg.model!r}")
-        if accum_start < 1 or accum_max < accum_start:
-            raise ValueError(
-                "need 1 <= accum_start <= accum_max, got "
-                f"{accum_start}/{accum_max}")
-        if accum_growth < 1.0:
-            raise ValueError(
-                f"accum_growth must be >= 1, got {accum_growth}")
-        if accum_growth_every <= 0:
-            raise ValueError(
-                f"accum_growth_every must be positive, got "
-                f"{accum_growth_every}")
+        if worker_id < 0:
+            raise ValueError(f"worker_id must be >= 0, got {worker_id}")
         # imported here, not at module top: these helpers live with the
         # batch PS trainer (the asked-for reuse), which imports jax —
         # acceptable for a trainer process, deferred for everyone else
+        from distlr_tpu.compress import GradientAccumulator  # noqa: PLC0415
         from distlr_tpu.ps import KVWorker, RetryPolicy  # noqa: PLC0415
         from distlr_tpu.train.ps_trainer import ps_param_dim  # noqa: PLC0415
 
@@ -104,21 +108,16 @@ class OnlineTrainer:
         self.dim = ps_param_dim(cfg)
         self.poll_interval_s = float(poll_interval_s)
         self.idle_flush_s = float(idle_flush_s)
-        retry = None
-        if cfg.ps_retry_attempts > 0:
-            retry = RetryPolicy(
-                attempts=cfg.ps_retry_attempts,
-                backoff_ms=cfg.ps_retry_backoff_ms,
-                backoff_max_ms=cfg.ps_retry_backoff_max_ms,
-                deadline_s=cfg.ps_retry_deadline_s,
-            )
+        self.worker_id = int(worker_id)
+        self.claim_stale_s = float(claim_stale_s)
         self.kv = KVWorker(
             hosts, self.dim,
-            client_id=self.ONLINE_CLIENT_ID if client_id is None
+            client_id=self.ONLINE_CLIENT_ID + worker_id if client_id is None
             else client_id,
             timeout_ms=cfg.ps_timeout_ms,
             sync_group=False,  # Hogwild client: no barriers, keyed shortcut
-            retry=retry,
+            retry=RetryPolicy.from_config(cfg),
+            compress=cfg.ps_compress,
         )
         if seed_init:
             # idempotent: seeds an unseeded group with zeros (FTRL's
@@ -126,13 +125,10 @@ class OnlineTrainer:
             # online trainer can be the loop's FIRST trainer or join an
             # already-trained group without a flag
             self.kv.push_init(np.zeros(self.dim, np.float32))
-        self.accum_k = int(accum_start)
-        self.accum_growth = float(accum_growth)
-        self.accum_growth_every = int(accum_growth_every)
-        self.accum_max = int(accum_max)
-        _ACCUM_K.set(self.accum_k)
-        self._g_acc = np.zeros(self.dim, np.float32)
-        self._acc_batches = 0
+        self._accum = GradientAccumulator(
+            self.dim, start=accum_start, growth=accum_growth,
+            growth_every=accum_growth_every, max_k=accum_max,
+            gauge=_ACCUM_K)
         self._w_cache: np.ndarray | None = None
         self.shards_consumed = 0
         self.examples = 0
@@ -140,12 +136,17 @@ class OnlineTrainer:
         self._num_classes = (cfg.num_classes if cfg.model == "softmax"
                              else None)
 
+    @property
+    def accum_k(self) -> int:
+        """Current AdaBatch span (batches per push)."""
+        return self._accum.k
+
     # -- gradient plumbing -------------------------------------------------
     def _dense_batch(self, X, y) -> None:
         from distlr_tpu.train.ps_trainer import _np_dense_grad  # noqa: PLC0415
 
         cfg = self.cfg
-        if self._acc_batches == 0:
+        if self._accum.batches == 0:
             # pull once per accumulation span: batches within a span ride
             # the same weights (AdaBatch local accumulation; the span is
             # the self-staleness bound)
@@ -156,8 +157,7 @@ class OnlineTrainer:
         mask = np.ones(len(y), np.float32)
         g = _np_dense_grad(w, X, y, mask, cfg.l2_c,
                            bool(cfg.l2_scale_by_batch), K)
-        self._g_acc += np.asarray(g, np.float32).reshape(-1)
-        self._acc_batches += 1
+        self._accum.add(g)
         self.examples += len(y)
         _EXAMPLES.inc(len(y))
 
@@ -171,43 +171,86 @@ class OnlineTrainer:
         mask = np.ones(len(y), np.float32)
         g_u = _sparse_batch_grad(w_u, pos.reshape(pc.shape), pv, y, mask,
                                  cfg.l2_c, bool(cfg.l2_scale_by_batch))
-        self._g_acc[ub] += g_u
-        self._acc_batches += 1
+        self._accum.add_at(ub, g_u)
         self.examples += len(y)
         _EXAMPLES.inc(len(y))
 
     def _flush_push(self) -> None:
         """Push the accumulated MEAN gradient (one Hogwild update of
-        batch size span*B) and advance the AdaBatch schedule."""
-        if self._acc_batches == 0:
-            return
-        g = self._g_acc / np.float32(self._acc_batches)
+        batch size span*B); the accumulator advances its own AdaBatch
+        schedule per flush."""
         if self.cfg.model == "sparse_lr":
-            keys = np.flatnonzero(g).astype(np.uint64)
-            if keys.size:
-                self.kv.wait(self.kv.push(g[keys.astype(np.int64)],
-                                          keys=keys))
+            res = self._accum.flush_keyed()
+            if res is None:
+                return
+            keys, vals = res
+            if keys.size:  # async Hogwild: a cancelled span pushes nothing
+                self.kv.wait(self.kv.push(vals, keys=keys))
         else:
+            g = self._accum.flush_dense()
+            if g is None:
+                return
             self.kv.wait(self.kv.push(g))
-        self._g_acc[:] = 0.0
-        self._acc_batches = 0
         self._w_cache = None
         self.pushes += 1
         _PUSHES.inc()
-        if self.pushes % self.accum_growth_every == 0:
-            grown = max(self.accum_k + 1,
-                        int(round(self.accum_k * self.accum_growth)))
-            self.accum_k = min(self.accum_max, grown)
-            _ACCUM_K.set(self.accum_k)
 
     # -- shard consumption -------------------------------------------------
     def _scan(self) -> list[str]:
+        # ".libsvm.claim" / ".libsvm.done" fail the endswith filter, so
+        # the scan (and the lag gauge) only ever see unclaimed work
         try:
             names = sorted(os.listdir(self.shard_dir))
         except OSError:
             return []
         return [os.path.join(self.shard_dir, n) for n in names
                 if n.startswith("shard-") and n.endswith(".libsvm")]
+
+    def _claim(self, path: str) -> str | None:
+        """Take exclusive ownership of a shard via the ``.claim`` rename
+        protocol: the atomic rename is the lock (exactly one of N
+        workers wins; losers get ENOENT and move on).  The claim
+        file's mtime records CLAIM time."""
+        claim = path + ".claim"
+        # Fresh mtime BEFORE the claim becomes visible: rename preserves
+        # the shard's own (arbitrarily old) mtime, and a claim that is
+        # born looking stale can be stolen back by a peer's
+        # _reclaim_stale before our utime lands — then consume crashes
+        # on the vanished file instead of losing the race cleanly.
+        try:
+            os.utime(path)
+        except OSError:
+            return None  # shard vanished (a peer claimed or consumed it)
+        try:
+            os.rename(path, claim)
+        except OSError:
+            return None  # a peer worker won the race (or shard vanished)
+        return claim
+
+    def _reclaim_stale(self) -> None:
+        """Return orphaned claims to the pool: a worker that died
+        mid-shard leaves a ``.claim`` nobody will finish; after
+        ``claim_stale_s`` (measured from claim time) any worker renames
+        it back.  Racing reclaimers are safe — one rename wins."""
+        if self.claim_stale_s <= 0:
+            return
+        try:
+            names = os.listdir(self.shard_dir)
+        except OSError:
+            return
+        now = time.time()
+        for nm in names:
+            if not nm.endswith(".libsvm.claim"):
+                continue
+            p = os.path.join(self.shard_dir, nm)
+            try:
+                if now - os.path.getmtime(p) < self.claim_stale_s:
+                    continue
+                os.rename(p, p[:-len(".claim")])
+            except OSError:
+                continue  # raced a peer reclaimer, or owner just finished
+            log.warning("online[%d]: reclaimed stale claim %s (owner "
+                        "presumed dead)", self.worker_id, nm)
 
     def consume_shard(self, path: str) -> int:
         """Train over one joined shard; returns examples consumed."""
@@ -229,7 +272,7 @@ class OnlineTrainer:
             for lo in range(0, len(y), B):
                 self._sparse_batch(pc[lo:lo + B], pv[lo:lo + B],
                                    y[lo:lo + B])
-                if self._acc_batches >= self.accum_k:
+                if self._accum.ready:
                     self._flush_push()
                 n += len(y[lo:lo + B])
         else:
@@ -238,7 +281,7 @@ class OnlineTrainer:
                 multiclass=self._num_classes is not None)
             for lo in range(0, len(y), B):
                 self._dense_batch(X[lo:lo + B], y[lo:lo + B])
-                if self._acc_batches >= self.accum_k:
+                if self._accum.ready:
                     self._flush_push()
                 n += len(y[lo:lo + B])
         self.shards_consumed += 1
@@ -257,11 +300,15 @@ class OnlineTrainer:
         idle_since = time.monotonic()
         consumed_this_run = 0
         while not stop.is_set():
+            # every cycle, not just idle ones: under sustained traffic
+            # `pending` may never drain, and a dead peer's orphaned
+            # .claim must still re-pool (its shard re-enters next scan)
+            self._reclaim_stale()
             pending = self._scan()
             _LAG.set(len(pending))
             if not pending:
                 now = time.monotonic()
-                if (self._acc_batches
+                if (self._accum.batches
                         and now - idle_since >= self.idle_flush_s):
                     # traffic lull: a partial accumulation span must not
                     # strand its gradients locally forever
@@ -273,14 +320,36 @@ class OnlineTrainer:
             for path in pending:
                 if stop.is_set():
                     break
-                n = self.consume_shard(path)
+                claimed = self._claim(path)
+                if claimed is None:
+                    continue  # a peer worker owns this shard
+                try:
+                    n = self.consume_shard(claimed)
+                except FileNotFoundError:
+                    # claim outlived claim_stale_s before we opened it
+                    # and a peer reclaimed: the shard re-pooled, a live
+                    # worker owns it — lose the race, don't die
+                    log.warning(
+                        "online[%d]: claim on %s stolen before consume "
+                        "(raise claim_stale_s?)", self.worker_id,
+                        os.path.basename(path))
+                    continue
                 # consumed shards step aside (audit trail kept), so the
                 # scan and the lag gauge only ever see fresh work
-                os.replace(path, path + ".done")
+                try:
+                    os.replace(claimed, path + ".done")
+                except OSError:
+                    # our claim outlived claim_stale_s and a peer
+                    # reclaimed it mid-consume: the shard may train
+                    # twice — Hogwild-tolerable, but worth a line
+                    log.warning("online[%d]: claim on %s expired while "
+                                "consuming (raise claim_stale_s?)",
+                                self.worker_id, os.path.basename(path))
                 idle_since = time.monotonic()
                 consumed_this_run += 1
-                log.info("online: consumed %s (%d examples, k=%d, "
-                         "%d pushes)", os.path.basename(path), n,
+                log.info("online[%d]: consumed %s (%d examples, k=%d, "
+                         "%d pushes)", self.worker_id,
+                         os.path.basename(path), n,
                          self.accum_k, self.pushes)
                 if max_shards and consumed_this_run >= max_shards:
                     self._flush_push()

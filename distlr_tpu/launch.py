@@ -210,6 +210,38 @@ def _add_config_flags(p: argparse.ArgumentParser) -> None:
     p.add_argument("--ftrl-l2", dest="ftrl_l2", type=float,
                    help="FTRL L2 strength (default 0)")
     p.add_argument(
+        "--ps-compress", dest="ps_compress",
+        choices=["none", "int8", "signsgd"],
+        help="gradient wire codec for PS pushes (negotiated per "
+        "connection; groups with a pre-codec server fall back to dense "
+        "f32): int8 = block-quantized values with per-block scales "
+        "(~3.9x fewer value bytes, sgd/ftrl), signsgd = 1 bit/coordinate "
+        "with server-side majority-vote aggregation (spawns the group "
+        "--optimizer=signsgd; use a signSGD-scale --learning-rate). "
+        "Default none = byte-identical wire, trajectory pins stand",
+    )
+    p.add_argument("--accum-start", dest="ps_accum_start", type=int,
+                   help="AdaBatch local accumulation: initial batches "
+                   "per push (default 1 = push every batch)")
+    p.add_argument("--accum-growth", dest="ps_accum_growth", type=float,
+                   help="multiply the accumulation span by this every "
+                   "--accum-growth-every pushes (default 2)")
+    p.add_argument("--accum-growth-every", dest="ps_accum_growth_every",
+                   type=int,
+                   help="pushes between accumulation-span growths "
+                   "(default 32)")
+    p.add_argument("--accum-max", dest="ps_accum_max", type=int,
+                   help="accumulation span cap (default 1 = accumulation "
+                   "off for trainers; `launch online` defaults to 64, "
+                   "its PR-6 contract)")
+    p.add_argument(
+        "--ps-retry-adaptive", dest="ps_retry_adaptive",
+        action="store_true", default=None,
+        help="scale the retry backoff base by the observed recent "
+        "transport-fault rate (up to 8x under a fault storm, decaying "
+        "back when quiet) instead of the static per-run base",
+    )
+    p.add_argument(
         "--ps-compute-backend", dest="ps_compute_backend",
         choices=["auto", "numpy", "cpu", "default"],
         help="where PS workers run their dense steps: auto (plain numpy "
@@ -243,6 +275,8 @@ def _config_from_args(args: argparse.Namespace) -> Config:
             "ps_retry_backoff_max_ms", "ps_retry_deadline_s",
             "chaos_plan", "chaos_seed",
             "ps_optimizer", "ftrl_alpha", "ftrl_beta", "ftrl_l1", "ftrl_l2",
+            "ps_compress", "ps_accum_start", "ps_accum_growth",
+            "ps_accum_growth_every", "ps_accum_max", "ps_retry_adaptive",
         }
     }
     if isinstance(overrides.get("obs_run_dir"), list):
@@ -560,20 +594,13 @@ def cmd_serve(args: argparse.Namespace) -> int:
             from distlr_tpu.serve import HotSetTracker  # noqa: PLC0415
 
             hot_tracker = HotSetTracker(cfg.serve_hot_rows)
-        retry = None
-        if cfg.ps_retry_attempts > 0:
-            from distlr_tpu.ps import RetryPolicy  # noqa: PLC0415
+        from distlr_tpu.ps import RetryPolicy  # noqa: PLC0415
 
-            # serving pulls are idempotent, so the full policy applies: a
-            # PS blip mid-poll is retried inside the poll; an exhausted
-            # policy degrades to last-good weights (HotReloader), never
-            # kills the server
-            retry = RetryPolicy(
-                attempts=cfg.ps_retry_attempts,
-                backoff_ms=cfg.ps_retry_backoff_ms,
-                backoff_max_ms=cfg.ps_retry_backoff_max_ms,
-                deadline_s=cfg.ps_retry_deadline_s,
-            )
+        # serving pulls are idempotent, so the full policy applies: a
+        # PS blip mid-poll is retried inside the poll; an exhausted
+        # policy degrades to last-good weights (HotReloader), never
+        # kills the server
+        retry = RetryPolicy.from_config(cfg)
         source = LivePSWatcher(
             args.ps_hosts, ps_param_dim(cfg),
             vals_per_key=max(row_width, 1),
@@ -638,20 +665,25 @@ def cmd_online(args: argparse.Namespace) -> int:
     _maybe_force_cpu_devices(args)
     from distlr_tpu.feedback import OnlineTrainer  # noqa: PLC0415
 
+    if args.ps_accum_max is None:
+        # the online loop's PR-6 contract: growing accumulation ON by
+        # default (trainers default to 1 = off; the flag overrides both)
+        args.ps_accum_max = 64
     cfg = _config_from_args(args)
     stop = threading.Event()
     signal.signal(signal.SIGTERM, lambda *_: stop.set())
     with _obs_scope(cfg, "online", _obs_rank(args)):
         trainer = OnlineTrainer(
             cfg, args.hosts, args.shard_dir,
-            accum_start=args.accum_start,
-            accum_growth=args.accum_growth,
-            accum_growth_every=args.accum_growth_every,
-            accum_max=args.accum_max,
+            accum_start=cfg.ps_accum_start,
+            accum_growth=cfg.ps_accum_growth,
+            accum_growth_every=cfg.ps_accum_growth_every,
+            accum_max=cfg.ps_accum_max,
             poll_interval_s=args.poll_interval,
+            worker_id=args.worker_id,
         )
-        print(f"ONLINE shard_dir={args.shard_dir} hosts={args.hosts}",
-              flush=True)
+        print(f"ONLINE shard_dir={args.shard_dir} hosts={args.hosts} "
+              f"worker={args.worker_id}", flush=True)
         try:
             stats = trainer.run(stop=stop, max_shards=args.max_shards,
                                 idle_exit_s=args.idle_exit)
@@ -764,7 +796,10 @@ def cmd_ps_server(args: argparse.Namespace) -> int:
     import signal  # noqa: PLC0415
 
     from distlr_tpu.ps import ServerGroup  # noqa: PLC0415
-    from distlr_tpu.train.ps_trainer import ps_param_dim  # noqa: PLC0415
+    from distlr_tpu.train.ps_trainer import (  # noqa: PLC0415
+        ps_param_dim,
+        server_optimizer,
+    )
 
     # A terminated foreground group must not orphan its native server
     # processes: route SIGTERM through SystemExit so the context manager
@@ -786,7 +821,7 @@ def cmd_ps_server(args: argparse.Namespace) -> int:
         last_gradient=bool(cfg.sync_last_gradient),
         ports=ports,
         bind_any=True,
-        optimizer=cfg.ps_optimizer,
+        optimizer=server_optimizer(cfg),
         ftrl_alpha=cfg.ftrl_alpha,
         ftrl_beta=cfg.ftrl_beta,
         ftrl_l1=cfg.ftrl_l1,
@@ -1069,19 +1104,12 @@ def main(argv=None) -> int:
     on.add_argument("--shard-dir", dest="shard_dir", required=True,
                     help="joined-shard dir the serving tier's feedback "
                     "sink writes (serve --feedback-shards)")
-    on.add_argument("--accum-start", dest="accum_start", type=int, default=1,
-                    help="AdaBatch local accumulation: initial batches "
-                    "per push (default 1 = push every batch)")
-    on.add_argument("--accum-growth", dest="accum_growth", type=float,
-                    default=2.0,
-                    help="multiply the accumulation span by this every "
-                    "--accum-growth-every pushes (default 2)")
-    on.add_argument("--accum-growth-every", dest="accum_growth_every",
-                    type=int, default=32,
-                    help="pushes between accumulation-span growths "
-                    "(default 32)")
-    on.add_argument("--accum-max", dest="accum_max", type=int, default=64,
-                    help="accumulation span cap (default 64)")
+    on.add_argument("--worker-id", dest="worker_id", type=int, default=0,
+                    help="this trainer's id among the online workers "
+                    "sharing one shard dir (distinct PS client_id + log "
+                    "identity; shards are claimed exclusively via the "
+                    ".claim rename protocol, so any number of `launch "
+                    "online` processes can share the dir)")
     on.add_argument("--poll-interval", dest="poll_interval", type=float,
                     default=0.5,
                     help="shard-dir scan period while idle, seconds "

@@ -428,3 +428,13 @@ REGISTRY = MetricsRegistry()
 
 def get_registry() -> MetricsRegistry:
     return REGISTRY
+
+
+def family_total(name: str, registry: MetricsRegistry | None = None) -> float:
+    """Sum of a counter/gauge family's children across all label sets in
+    the process registry (0.0 when the family was never declared) — the
+    snapshot primitive bench rows and delta-based tests are built on."""
+    fam = (registry or REGISTRY).get(name)
+    if fam is None:
+        return 0.0
+    return float(sum(child.value for _v, child in fam.children()))

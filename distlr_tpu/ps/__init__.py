@@ -1,6 +1,8 @@
 from distlr_tpu.ps.build import build_native, native_dir  # noqa: F401
 from distlr_tpu.ps.client import (  # noqa: F401
+    FaultRateTracker,
     KVWorker,
+    PSRejectedError,
     PSTimeoutError,
     RetryPolicy,
     STATS_FIELDS,

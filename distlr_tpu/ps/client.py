@@ -16,8 +16,11 @@ import time
 
 import numpy as np
 
-from distlr_tpu.obs.registry import get_registry
+from distlr_tpu.obs.registry import family_total, get_registry
 from distlr_tpu.ps.build import build_native, client_lib
+from distlr_tpu.utils.logging import get_logger
+
+log = get_logger(__name__)
 
 _lib = None
 
@@ -63,13 +66,46 @@ _PUSH_UNKNOWN = _reg.counter(
     "class), NEVER re-issued (a maybe-applied push re-issued is a "
     "silent double-apply)",
 )
+#: Push-byte accounting (ISSUE 7): raw = the dense-f32 encoding the
+#: same frame would have cost before codecs (uncompressed keys + 4
+#: bytes/value), wire = what actually left the kernel (headers + keys +
+#: coded payload, summed over servers).  Both count DELIVERED pushes
+#: exactly once: a failed attempt contributes nothing, its successful
+#: re-issue counts once, and an absorbed unknown-outcome push counts
+#: zero — so the ratio can never be inflated by retries.
+_PUSH_RAW = _reg.counter(
+    "distlr_ps_push_bytes_raw_total",
+    "dense-f32-equivalent bytes of delivered gradient pushes "
+    "(what the same pushes would have cost uncompressed)",
+)
+_PUSH_WIRE = _reg.counter(
+    "distlr_ps_push_bytes_wire_total",
+    "actual wire bytes of delivered gradient pushes "
+    "(headers + keys + coded value payload)",
+)
+_COMPRESS_RATIO = _reg.gauge(
+    "distlr_ps_push_compress_ratio",
+    "cumulative push-byte compression ratio raw/wire (1.0-ish = dense "
+    "f32; the codec x accumulation win reads directly off this gauge)",
+)
+def _account_push_bytes(raw: int, wire: int) -> None:
+    _PUSH_RAW.inc(raw)
+    _PUSH_WIRE.inc(wire)
+    # ratio derived from the counters themselves — no shadow totals to
+    # drift if the registry is ever reset or the counters relabeled
+    wire_total = family_total("distlr_ps_push_bytes_wire_total")
+    if wire_total > 0:
+        _COMPRESS_RATIO.set(
+            family_total("distlr_ps_push_bytes_raw_total") / wire_total)
 
 
 @contextlib.contextmanager
-def _observe_op(op: str, *, sent: int = 0, received: int = 0):
+def _observe_op(op: str, *, sent=0, received: int = 0):
     """Record one op's latency, outcome, and payload bytes.  Timeouts are
     distinguished from hard failures (a wedged barrier vs a dead peer
-    read very differently on a dashboard)."""
+    read very differently on a dashboard).  ``sent`` may be a callable
+    evaluated on success — for ops whose wire size is only known after
+    the native call (compressed pushes)."""
     t0 = time.perf_counter()
     try:
         yield
@@ -81,6 +117,7 @@ def _observe_op(op: str, *, sent: int = 0, received: int = 0):
         raise
     _OP_SECONDS.labels(op=op).observe(time.perf_counter() - t0)
     _OPS_TOTAL.labels(op=op, status="ok").inc()
+    sent = sent() if callable(sent) else sent
     if sent:
         _BYTES_TOTAL.labels(op=op, direction="sent").inc(sent)
     if received:
@@ -101,6 +138,62 @@ class PSTimeoutError(TimeoutError):
     """A KV op hit the receive timeout — in sync mode, the named
     straggler failure: a dead/slow worker holding the BSP barrier
     (SURVEY.md §5.3; the reference deadlocks forever here)."""
+
+
+class PSRejectedError(OSError):
+    """The server answered an explicit kError rejection: the op is
+    unsupported for its configuration (e.g. an FTRL opt-state op
+    against an sgd server) — deterministic, so the retry driver
+    raises it immediately instead of burning its attempt/deadline
+    budget re-issuing an op that can never succeed."""
+
+
+class FaultRateTracker:
+    """Sliding-window transport-fault counter -> adaptive backoff scale.
+
+    A static backoff base is tuned for the QUIET network: under a fault
+    storm (a flapping switch, a long partition's edge) every worker
+    re-hammers the servers at the same quiet-network cadence, which both
+    prolongs the storm and burns retry budget.  This tracker observes
+    the worker's own recent transport faults and scales the policy's
+    backoff BASE linearly with the fault count in the window —
+    ``1 + 0.5 * faults``, capped at ``max_scale`` — so a noisy period
+    automatically backs off harder and a quiet one decays back to the
+    configured base as old faults age out.  The scaled base still
+    respects the policy's ``backoff_max_ms`` cap.
+    """
+
+    def __init__(self, window_s: float = 30.0, max_scale: float = 8.0):
+        if window_s <= 0:
+            raise ValueError(f"window_s must be positive, got {window_s}")
+        if max_scale < 1.0:
+            raise ValueError(f"max_scale must be >= 1, got {max_scale}")
+        self.window_s = float(window_s)
+        self.max_scale = float(max_scale)
+        self._faults: list[float] = []
+
+    def _prune(self, now: float) -> None:
+        cutoff = now - self.window_s
+        # faults append in time order, so the stale prefix is contiguous
+        drop = 0
+        for t in self._faults:
+            if t >= cutoff:
+                break
+            drop += 1
+        if drop:
+            del self._faults[:drop]
+
+    def record(self, now: float | None = None) -> None:
+        """One observed transport fault (call at failure time)."""
+        now = time.monotonic() if now is None else now
+        self._prune(now)
+        self._faults.append(now)
+
+    def scale(self, now: float | None = None) -> float:
+        """Current backoff-base multiplier in [1, max_scale]."""
+        now = time.monotonic() if now is None else now
+        self._prune(now)
+        return min(self.max_scale, 1.0 + 0.5 * len(self._faults))
 
 
 @dataclasses.dataclass(frozen=True)
@@ -140,6 +233,13 @@ class RetryPolicy:
     deadline_s: float = 60.0
     #: RNG seed for the jitter draw (None = nondeterministic)
     seed: int | None = None
+    #: Scale the backoff BASE by the observed recent fault rate
+    #: (:class:`FaultRateTracker`) instead of keeping it static per run:
+    #: a fault storm backs off up to ``adaptive_max_scale`` x harder
+    #: (still capped by ``backoff_max_ms``), a quiet window decays back.
+    adaptive: bool = False
+    adaptive_window_s: float = 30.0
+    adaptive_max_scale: float = 8.0
 
     def __post_init__(self):
         if self.attempts < 1:
@@ -153,10 +253,39 @@ class RetryPolicy:
         if self.deadline_s <= 0:
             raise ValueError(
                 f"deadline_s must be positive, got {self.deadline_s}")
+        if self.adaptive_window_s <= 0:
+            raise ValueError(
+                f"adaptive_window_s must be positive, "
+                f"got {self.adaptive_window_s}")
+        if self.adaptive_max_scale < 1.0:
+            raise ValueError(
+                f"adaptive_max_scale must be >= 1, "
+                f"got {self.adaptive_max_scale}")
 
-    def backoff_s(self, retry_index: int, rng: random.Random) -> float:
-        """Sleep before re-issue number ``retry_index`` (0-based)."""
-        base = min(self.backoff_ms * (2.0 ** retry_index),
+    @classmethod
+    def from_config(cls, cfg) -> "RetryPolicy | None":
+        """The policy a :class:`~distlr_tpu.config.Config` asks for, or
+        None when retries are off (``ps_retry_attempts == 0``) — the ONE
+        construction every consumer (PS workers, the online trainer,
+        serving pulls) shares, so a new knob like ``ps_retry_adaptive``
+        reaches all of them at once."""
+        if cfg.ps_retry_attempts <= 0:
+            return None
+        return cls(
+            attempts=cfg.ps_retry_attempts,
+            backoff_ms=cfg.ps_retry_backoff_ms,
+            backoff_max_ms=cfg.ps_retry_backoff_max_ms,
+            deadline_s=cfg.ps_retry_deadline_s,
+            adaptive=bool(getattr(cfg, "ps_retry_adaptive", False)),
+        )
+
+    def backoff_s(self, retry_index: int, rng: random.Random,
+                  scale: float = 1.0) -> float:
+        """Sleep before re-issue number ``retry_index`` (0-based).
+        ``scale`` multiplies the BASE (the adaptive fault-rate path);
+        the ``backoff_max_ms`` cap applies after scaling, so adaptivity
+        can saturate but never exceed the configured ceiling."""
+        base = min(self.backoff_ms * scale * (2.0 ** retry_index),
                    self.backoff_max_ms)
         if self.jitter:
             base *= 1.0 + self.jitter * (2.0 * rng.random() - 1.0)
@@ -206,8 +335,24 @@ def _load():
         lib.kv_set_push_visit_all.argtypes = [ctypes.c_void_p, ctypes.c_int]
         lib.kv_timed_out.restype = ctypes.c_int
         lib.kv_timed_out.argtypes = [ctypes.c_void_p]
+        lib.kv_op_rejected.restype = ctypes.c_int
+        lib.kv_op_rejected.argtypes = [ctypes.c_void_p]
         lib.kv_op_delivery_began.restype = ctypes.c_int
         lib.kv_op_delivery_began.argtypes = [ctypes.c_void_p]
+        lib.kv_negotiate_codec.restype = ctypes.c_int
+        lib.kv_negotiate_codec.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.kv_last_wire_sent.restype = ctypes.c_uint64
+        lib.kv_last_wire_sent.argtypes = [ctypes.c_void_p]
+        lib.kv_pull_opt_state.restype = ctypes.c_int
+        lib.kv_pull_opt_state.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+            ctypes.c_uint64,
+        ]
+        lib.kv_push_init_opt_state.restype = ctypes.c_int
+        lib.kv_push_init_opt_state.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+            ctypes.c_uint64, ctypes.c_int,
+        ]
         lib.kv_stats.restype = ctypes.c_int
         lib.kv_stats.argtypes = [  # out buffer is float64 (see kv_protocol.h)
             ctypes.c_void_p, ctypes.c_uint32, ctypes.c_void_p, ctypes.c_uint64,
@@ -224,7 +369,14 @@ class KVWorker:
 
     def __init__(self, hosts: str, dim: int, client_id: int = 0, *,
                  timeout_ms: int = 0, sync_group: bool = True,
-                 retry: RetryPolicy | None = None):
+                 retry: RetryPolicy | None = None,
+                 compress: str = "none"):
+        from distlr_tpu.compress import CODEC_IDS  # noqa: PLC0415  (cycle-free, numpy-only)
+
+        if compress not in CODEC_IDS:
+            raise ValueError(
+                f"compress must be one of {tuple(CODEC_IDS)}, "
+                f"got {compress!r}")
         lib = _load()
         self._lib = lib
         self.dim = dim
@@ -237,47 +389,93 @@ class KVWorker:
         self._sync_group = bool(sync_group)
         self.retry = retry
         self._retry_rng = random.Random(retry.seed if retry else None)
-        self._h = lib.kv_connect(hosts.encode(), dim, client_id)
-        if not self._h:
-            raise ConnectionError(f"could not connect to KV servers at {hosts}")
+        self._fault_rate = (FaultRateTracker(retry.adaptive_window_s,
+                                             retry.adaptive_max_scale)
+                            if retry is not None and retry.adaptive else None)
+        #: requested wire codec name ("none" = dense f32, never negotiated)
+        self.compress = compress
+        #: codec actually in force after the kHello capability handshake
+        #: ("none" when any server of the group lacks it — graceful
+        #: fallback, re-derived on every reconnect).  None until the
+        #: first handshake so the initial outcome — including a
+        #: fallback — always logs (the change-only guard in
+        #: :meth:`_build_handle` would otherwise swallow a first-connect
+        #: downgrade the operator explicitly asked to see).
+        self.compress_active: str | None = None
+        self._codec_id = CODEC_IDS[compress]
+        # one-time sparse-gradient sanity check on the first sign push
+        self._sign_zero_checked = False
+        # dense-default row encoding under compression (lazy): (keys, vpk)
+        self._dense_rows: tuple[np.ndarray, int] | None = None
+        self._h = self._build_handle()
         # dense default key set 0..D-1, like the reference app (src/lr.cc:117-121)
         self._all_keys = np.arange(dim, dtype=np.uint64)
-        if timeout_ms:
-            self.set_timeout(timeout_ms)
-        if not sync_group:
-            # Async group: no BSP barrier to vote in, so keyed pushes may
-            # skip servers whose key slice is empty (saves S-1 round
-            # trips per sparse push).  MUST stay True for sync groups.
-            lib.kv_set_push_visit_all(self._h, 0)
+
+    def _build_handle(self):
+        """Connect + configure + (when asked) negotiate a NEW native
+        handle — shared by the constructor and :meth:`reconnect` so a
+        rebuilt connection always re-runs the capability handshake
+        (codec state lives per handle)."""
+        lib = self._lib
+        h = lib.kv_connect(self._hosts.encode(), self.dim, self._client_id)
+        if not h:
+            raise ConnectionError(
+                f"could not connect to KV servers at {self._hosts}")
+        try:
+            if self._timeout_ms and lib.kv_set_timeout_ms(
+                    h, self._timeout_ms) != 0:
+                raise OSError("failed to set KV socket timeout")
+            if not self._sync_group:
+                # Async group: no BSP barrier to vote in, so keyed pushes
+                # may skip servers whose key slice is empty (saves S-1
+                # round trips per sparse push).  MUST stay True for sync
+                # groups.
+                lib.kv_set_push_visit_all(h, 0)
+            if self._codec_id:
+                got = lib.kv_negotiate_codec(h, self._codec_id)
+                if got < 0:
+                    raise OSError(
+                        "codec negotiation failed: "
+                        + lib.kv_last_error(h).decode())
+                active = self.compress if got == self._codec_id else "none"
+                if active != getattr(self, "compress_active", None):
+                    if active == "none":
+                        log.warning(
+                            "KV group at %s does not advertise codec %r; "
+                            "falling back to dense f32 pushes",
+                            self._hosts, self.compress)
+                    else:
+                        log.info("negotiated %r gradient pushes with %s",
+                                 active, self._hosts)
+                self.compress_active = active
+            else:
+                self.compress_active = "none"
+        except Exception:
+            lib.kv_close(h)
+            raise
+        return h
 
     def reconnect(self) -> None:
         """Rebuild the native handle in place — same hosts, dim,
-        client_id, timeout, and group-mode flags — the escape from a
-        poisoned connection (one receive failure fails every later op
-        on that stream until reconnect; kv_client.cc).  Callers running
-        their own recovery loop use this instead of recreating the
-        whole object; a :class:`RetryPolicy` calls it automatically.
+        client_id, timeout, group-mode flags, and (re-negotiated) wire
+        codec — the escape from a poisoned connection (one receive
+        failure fails every later op on that stream until reconnect;
+        kv_client.cc).  Callers running their own recovery loop use this
+        instead of recreating the whole object; a :class:`RetryPolicy`
+        calls it automatically.
 
-        The new connections are established BEFORE the old ones close,
-        so a failed reconnect (servers still down) leaves the worker on
-        its previous — poisoned but intact — handle and raises
-        ``ConnectionError``; closing the old stream is also what makes
-        the servers roll back any of its pending barrier votes or
-        deferred pushes (DropConnection), which is exactly why a
-        post-reconnect re-vote counts once."""
-        h = self._lib.kv_connect(self._hosts.encode(), self.dim,
-                                 self._client_id)
-        if not h:
-            raise ConnectionError(
-                f"could not reconnect to KV servers at {self._hosts}")
+        The new connections are established (and the codec handshake
+        completed) BEFORE the old ones close, so a failed reconnect
+        (servers still down) leaves the worker on its previous —
+        poisoned but intact — handle and raises an ``OSError``; closing
+        the old stream is also what makes the servers roll back any of
+        its pending barrier votes or deferred pushes (DropConnection),
+        which is exactly why a post-reconnect re-vote counts once."""
+        h = self._build_handle()
         old, self._h = self._h, h
         if old:
             self._lib.kv_close(old)
         _RECONNECTS.inc()
-        if self._timeout_ms:
-            self.set_timeout(self._timeout_ms)
-        if not self._sync_group:
-            self._lib.kv_set_push_visit_all(self._h, 0)
 
     # -- in-place retry (RetryPolicy) -------------------------------------
     def _run_with_retry(self, op: str, fn, *, idempotent: bool,
@@ -317,13 +515,20 @@ class KVWorker:
         last: Exception | None = None
         for attempt in range(pol.attempts):
             if attempt:
-                nap = pol.backoff_s(attempt - 1, self._retry_rng)
+                # adaptive policies scale the backoff BASE by the
+                # observed recent fault rate (FaultRateTracker): a storm
+                # backs off harder, a quiet window decays to the static
+                # base — backoff_max_ms still caps either way
+                scale = (self._fault_rate.scale()
+                         if self._fault_rate is not None else 1.0)
+                nap = pol.backoff_s(attempt - 1, self._retry_rng, scale)
                 time.sleep(min(nap, max(0.0, deadline - time.monotonic())))
                 try:
                     self.reconnect()
                 except OSError as e:
                     # servers unreachable (e.g. mid-partition): burn the
                     # attempt on the reconnect and keep backing off
+                    self._record_fault()
                     last = e
                     if time.monotonic() >= deadline:
                         break
@@ -336,7 +541,12 @@ class KVWorker:
                 _RETRIES.labels(op=op).inc()
             try:
                 return fn()
+            except PSRejectedError:
+                # explicit protocol rejection: deterministic caller
+                # error, identical on every re-issue — never retried
+                raise
             except OSError as e:
+                self._record_fault()
                 if not idempotent and self._lib.kv_op_delivery_began(self._h):
                     _PUSH_UNKNOWN.inc()
                     with contextlib.suppress(OSError):
@@ -350,6 +560,10 @@ class KVWorker:
                     break
         assert last is not None
         raise last
+
+    def _record_fault(self) -> None:
+        if self._fault_rate is not None:
+            self._fault_rate.record()
 
     def _with_retry(self, op: str, fn):
         """Idempotent ops (pull/chunked/keyed/stats/barrier/push_init):
@@ -378,6 +592,8 @@ class KVWorker:
             err = self._lib.kv_last_error(self._h).decode()
             if self._lib.kv_timed_out(self._h):
                 raise PSTimeoutError(f"KV {what} timed out: {err}")
+            if self._lib.kv_op_rejected(self._h):
+                raise PSRejectedError(f"KV {what} rejected: {err}")
             raise IOError(f"KV {what} failed: {err}")
         return ts
 
@@ -425,6 +641,61 @@ class KVWorker:
             return self._all_keys
         return self._validate_keys(keys, vpk)
 
+    def _dense_row_encoding(self) -> tuple[np.ndarray, int]:
+        """Row encoding for DENSE default-key pushes under an active
+        codec: the largest ``vpk`` (<= the protocol cap) that divides
+        ``dim`` and aligns with the group's range boundaries, so the
+        key frame shrinks from ``dim`` u64s to ``dim/vpk`` — at D=1M an
+        8 MB key frame becomes ~2 KB, without which value compression
+        would be hidden behind uncompressed keys.  Compression mode
+        only: the uncompressed path keeps the flat dense key set so its
+        wire bytes stay identical to every earlier round.  Falls back
+        to the flat keys when no divisor aligns."""
+        if self._dense_rows is None:
+            best = 1
+            for v in range(min(4096, self.dim), 1, -1):
+                if self.dim % v == 0 and self.supports_vals_per_key(v):
+                    best = v
+                    break
+            keys = (np.arange(self.dim // best, dtype=np.uint64)
+                    if best > 1 else self._all_keys)
+            self._dense_rows = (keys, best)
+        return self._dense_rows
+
+    def _push_frame(self, keys: np.ndarray | None, vpk: int,
+                    vals: np.ndarray):
+        """Resolve a push's (raw_bytes, keys, vpk): raw is the
+        dense-f32 encoding THIS push would have cost uncompressed (the
+        as-given key frame + 4 bytes/value — the compression-ratio
+        numerator), and dense default pushes re-row their key frame
+        when a codec is active (see :meth:`_dense_row_encoding`)."""
+        if self.compress_active == "signsgd" and not self._sign_zero_checked:
+            # 1-bit signSGD has no abstention: an exact zero votes -1,
+            # so a mostly-zero gradient (sparse data pushed full-width)
+            # silently walks every untouched weight +lr per round.  One
+            # representative check on the first coded push, then free.
+            self._sign_zero_checked = True
+            if vals.size and np.count_nonzero(vals) < vals.size // 2:
+                log.warning(
+                    "signsgd push is mostly exact zeros (%d of %d "
+                    "coordinates): zero votes decode -1 and drift "
+                    "untouched weights by +lr per round — push touched "
+                    "keys only, or use compress='int8' for sparse "
+                    "gradients", vals.size - np.count_nonzero(vals),
+                    vals.size)
+        if keys is None and vpk == 1 and self.compress_active != "none":
+            raw = self._all_keys.nbytes + vals.nbytes
+            keys, vpk = self._dense_row_encoding()
+            keys = self._validate_keys(keys, vpk)
+        else:
+            keys = self._default_or_validated(keys, vpk)
+            raw = keys.nbytes + vals.nbytes
+        if vals.shape[0] != keys.shape[0] * vpk:
+            raise ValueError(
+                f"{vals.shape[0]} vals vs {keys.shape[0]} keys "
+                f"x vals_per_key {vpk}")
+        return raw, keys, vpk
+
     def push(self, vals: np.ndarray, keys: np.ndarray | None = None,
              *, vals_per_key: int = 1) -> int:
         """Blocking push; in sync mode returns only after ALL workers
@@ -434,24 +705,27 @@ class KVWorker:
         slots ``[k*R, (k+1)*R)``) and ``vals`` holds ``len(keys)*R``
         floats row-major — one u64 of key per R values on the wire
         instead of R expanded keys (the blocked CTR path's encoding;
-        requires :meth:`supports_vals_per_key`)."""
+        requires :meth:`supports_vals_per_key`).
+
+        With a negotiated codec (``compress=``) the value payload
+        crosses the wire coded; delivered pushes tick the
+        ``distlr_ps_push_bytes_{raw,wire}_total`` counters exactly once
+        each (a retried attempt counts only on its successful issue)."""
         vals = np.ascontiguousarray(vals, dtype=np.float32).reshape(-1)
-        vpk = int(vals_per_key)
-        keys = self._default_or_validated(keys, vpk)
-        if vals.shape[0] != keys.shape[0] * vpk:
-            raise ValueError(
-                f"{vals.shape[0]} vals vs {keys.shape[0]} keys "
-                f"x vals_per_key {vpk}")
+        raw, keys, vpk = self._push_frame(keys, int(vals_per_key), vals)
 
         def _issue():
-            with _observe_op("push", sent=keys.nbytes + vals.nbytes):
+            with _observe_op(
+                    "push", sent=lambda: self._lib.kv_last_wire_sent(self._h)):
                 ts = self._lib.kv_push_vpk(
                     self._h,
                     keys.ctypes.data_as(ctypes.c_void_p),
                     vals.ctypes.data_as(ctypes.c_void_p),
                     keys.shape[0], vpk,
                 )
-                return self._check(ts, "push")
+                self._check(ts, "push")
+                _account_push_bytes(raw, self._lib.kv_last_wire_sent(self._h))
+                return ts
 
         return self._push_with_retry("push", _issue)
 
@@ -492,18 +766,15 @@ class KVWorker:
         returned weights are the post-round state — bit-identical to the
         pull that would have followed.  ``vals_per_key``: see
         :meth:`push`."""
-        vpk = int(vals_per_key)
         vals = np.ascontiguousarray(vals, dtype=np.float32).reshape(-1)
-        keys = self._default_or_validated(keys, vpk)
-        if vals.shape[0] != keys.shape[0] * vpk:
-            raise ValueError(
-                f"{vals.shape[0]} vals vs {keys.shape[0]} keys "
-                f"x vals_per_key {vpk}")
+        raw, keys, vpk = self._push_frame(keys, int(vals_per_key), vals)
         out = np.empty(keys.shape[0] * vpk, dtype=np.float32)
 
         def _issue():
-            with _observe_op("push_pull", sent=keys.nbytes + vals.nbytes,
-                             received=out.nbytes):
+            with _observe_op(
+                    "push_pull",
+                    sent=lambda: self._lib.kv_last_wire_sent(self._h),
+                    received=out.nbytes):
                 ts = self._lib.kv_push_pull_vpk(
                     self._h,
                     keys.ctypes.data_as(ctypes.c_void_p),
@@ -512,6 +783,7 @@ class KVWorker:
                     keys.shape[0], vpk,
                 )
                 self._check(ts, "push_pull")
+                _account_push_bytes(raw, self._lib.kv_last_wire_sent(self._h))
             return out
 
         # Unknown push outcome: the gradient is lost-or-applied-once
@@ -615,6 +887,67 @@ class KVWorker:
         view = table.reshape(self.dim // vpk, vpk)
         view[keys.astype(np.int64)] = vals.reshape(-1, vpk)
         return int(keys.size)
+
+    def pull_opt_state(self) -> tuple[np.ndarray, np.ndarray]:
+        """The server's FTRL per-coordinate accumulators ``(z, n)`` for
+        this handle's full key range (kOptState; the supervisor's
+        snapshot path).  Single-server handles only — the supervisor's
+        per-rank connections — because the ``[z..., n...]`` layout
+        cannot be range-sliced.  Raises against a non-FTRL server (the
+        server replies kError)."""
+        if self.num_servers != 1:
+            raise ValueError(
+                "pull_opt_state addresses ONE server per handle (got "
+                f"{self.num_servers}); use a per-rank connection")
+        out = np.empty(2 * self.dim, dtype=np.float32)
+
+        def _issue():
+            with _observe_op("pull_opt_state", sent=self._all_keys.nbytes,
+                             received=out.nbytes):
+                ts = self._lib.kv_pull_opt_state(
+                    self._h,
+                    self._all_keys.ctypes.data_as(ctypes.c_void_p),
+                    out.ctypes.data_as(ctypes.c_void_p),
+                    self._all_keys.shape[0],
+                )
+                self._check(ts, "pull_opt_state")
+            return out[:self.dim].copy(), out[self.dim:].copy()
+
+        return self._with_retry("pull_opt_state", _issue)
+
+    def push_init_opt_state(self, z: np.ndarray, n: np.ndarray, *,
+                            force: bool = False) -> int:
+        """Seed the server's FTRL z/n accumulators (idempotent like
+        :meth:`push_init`; ``force=True`` overwrites — the supervisor's
+        restore path, which pairs this with a forced weight init so a
+        respawned FTRL rank resumes with its full optimizer state
+        instead of degrading to a warm restart)."""
+        if self.num_servers != 1:
+            raise ValueError(
+                "push_init_opt_state addresses ONE server per handle "
+                f"(got {self.num_servers}); use a per-rank connection")
+        z = np.ascontiguousarray(z, dtype=np.float32).reshape(-1)
+        n = np.ascontiguousarray(n, dtype=np.float32).reshape(-1)
+        if z.shape[0] != self.dim or n.shape[0] != self.dim:
+            raise ValueError(
+                f"z/n must each hold dim={self.dim} values, got "
+                f"{z.shape[0]}/{n.shape[0]}")
+        buf = np.concatenate([z, n])
+
+        def _issue():
+            with _observe_op("push_init_opt_state",
+                             sent=self._all_keys.nbytes + buf.nbytes):
+                ts = self._lib.kv_push_init_opt_state(
+                    self._h,
+                    self._all_keys.ctypes.data_as(ctypes.c_void_p),
+                    buf.ctypes.data_as(ctypes.c_void_p),
+                    self._all_keys.shape[0],
+                    1 if force else 0,
+                )
+                return self._check(ts, "push_init_opt_state")
+
+        # idempotent by protocol design (seed-only, like push_init)
+        return self._with_retry("push_init_opt_state", _issue)
 
     def wait(self, ts: int) -> None:
         """No-op for API parity: push/pull already block (the reference

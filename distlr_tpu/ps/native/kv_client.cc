@@ -58,6 +58,11 @@ struct Client {
   // that does not know the group's mode.
   bool push_visit_all = true;
   bool timed_out = false;  // last failure was a receive timeout
+  // Last failure was an explicit kError protocol rejection (the server
+  // answered "unsupported for its configuration") — a deterministic
+  // caller error that will fail identically on every re-issue, so the
+  // retry layer must surface it instead of burning attempts on it.
+  bool op_rejected = false;
   // After any receive failure the stream may still hold a late/partial
   // reply, so every subsequent frame would be misparsed.  The handle is
   // poisoned: ops fail fast until the caller reconnects.
@@ -71,6 +76,14 @@ struct Client {
   // write counts as "began" even though the server drops incomplete
   // frames, so "false" is a hard safety guarantee, never a guess.
   bool op_delivery_began = false;
+  // Gradient wire codec for push-class value payloads (kv_protocol.h),
+  // 0 = dense f32.  Set ONLY by kv_negotiate_codec after the kHello
+  // capability handshake proved every server decodes it.
+  uint8_t codec = 0;
+  // Request bytes (headers + keys + value payload, summed over servers)
+  // the most recent op put on the wire — the honest numerator/
+  // denominator for the push-byte compression-ratio accounting.
+  uint64_t wire_sent = 0;
   char err[256] = {0};
 };
 
@@ -175,7 +188,9 @@ int RoundTrip(Client* c, Op op, const Key* keys, const float* vals,
               float* out_vals, uint64_t n, uint8_t flags = kNone,
               uint16_t barrier_id = 0, uint64_t vpk = 1) {
   c->timed_out = false;
+  c->op_rejected = false;
   c->op_delivery_began = false;
+  c->wire_sent = 0;
   if (c->poisoned) {
     snprintf(c->err, sizeof(c->err),
              "connection poisoned by an earlier receive failure; "
@@ -188,6 +203,18 @@ int RoundTrip(Client* c, Op op, const Key* keys, const float* vals,
              (unsigned long long)vpk, (unsigned long long)kMaxValsPerKey);
     return -1;
   }
+  // Opt-state ops ship BOTH accumulators ([z..., n...], 2x vals per
+  // key); the flat buffer cannot be range-sliced per server, and the
+  // only caller (the supervisor) holds per-rank connections — so the
+  // restriction costs nothing and keeps the wire layout trivial.
+  const bool opt_state = (flags & kOptState) != 0;
+  if (opt_state && c->servers.size() != 1) {
+    snprintf(c->err, sizeof(c->err),
+             "opt-state ops address ONE server per handle (got %zu); "
+             "use a per-rank connection", c->servers.size());
+    return -1;
+  }
+  const uint64_t mult = opt_state ? 2 : 1;
   if (vpk > 1) {
     // A row's whole [k*vpk, (k+1)*vpk) range must live on ONE server:
     // every range boundary (dim*s/S by construction) must be a
@@ -226,11 +253,21 @@ int RoundTrip(Client* c, Op op, const Key* keys, const float* vals,
   // the keyed ops.
   const uint16_t aux =
       op == Op::kBarrier ? barrier_id : static_cast<uint16_t>(vpk);
+  // Gradient codec (kv_protocol.h): compress the value payload of
+  // gradient-carrying pushes PER SERVER SLICE (the slice is the frame;
+  // each server decodes its own blocks independently).  Init and
+  // opt-state pushes seed exact values and are never compressed.
+  const uint8_t codec =
+      (is_push && c->codec && !(flags & (kInitPush | kOptState)))
+          ? c->codec : 0;
+  const uint8_t send_flags =
+      static_cast<uint8_t>(flags | (codec << kCodecShift));
   std::vector<std::vector<Key>> local_keys(c->servers.size());
+  std::vector<uint8_t> coded;
   for (size_t s = 0; s < c->servers.size(); ++s) {
     const auto [b, e] = slices[s];
     if (b == e && !visit_all && !(op == Op::kBarrier && s == 0)) continue;
-    MsgHeader h{kMagic, static_cast<uint8_t>(op), flags, aux,
+    MsgHeader h{kMagic, static_cast<uint8_t>(op), send_flags, aux,
                 c->client_id, ts, e - b};
     auto& lk = local_keys[s];
     lk.resize(e - b);
@@ -239,16 +276,30 @@ int RoundTrip(Client* c, Op op, const Key* keys, const float* vals,
     const Key rebase = c->servers[s].range_begin / vpk;
     for (uint64_t i = b; i < e; ++i) lk[i - b] = keys[i] - rebase;
     const int fd = c->servers[s].fd;
+    const uint64_t n_vals = (e - b) * vpk * mult;
+    const void* payload = nullptr;
+    uint64_t payload_bytes = 0;
+    if (is_push && n_vals) {
+      payload = vals + b * vpk * mult;
+      payload_bytes = n_vals * sizeof(Val);
+      if (codec != 0) {
+        payload_bytes = CodecPayloadBytes(codec, n_vals);
+        coded.resize(payload_bytes);
+        EncodeGrad(codec, vals + b * vpk, n_vals, coded.data());
+        payload = coded.data();
+      }
+    }
     if (!WriteFull(fd, &h, sizeof(h), &c->op_delivery_began) ||
         (h.num_keys && !WriteFull(fd, lk.data(), lk.size() * sizeof(Key),
                                   &c->op_delivery_began)) ||
         (is_push && h.num_keys &&
-         !WriteFull(fd, vals + b * vpk, (e - b) * vpk * sizeof(Val),
-                    &c->op_delivery_began))) {
+         !WriteFull(fd, payload, payload_bytes, &c->op_delivery_began))) {
       c->poisoned = true;  // peers already received slices of this ts
       snprintf(c->err, sizeof(c->err), "send to server %zu failed", s);
       return -1;
     }
+    c->wire_sent += sizeof(h) + lk.size() * sizeof(Key) +
+                    (is_push && h.num_keys ? payload_bytes : 0);
   }
   // Every request frame left intact; any failure from here on is on the
   // receive side, where delivery is a fact (only the REPLY is in doubt).
@@ -289,7 +340,20 @@ int RoundTrip(Client* c, Op op, const Key* keys, const float* vals,
     // bad frame demand an arbitrary allocation, and a bad_alloc
     // escaping this extern "C" boundary would terminate the worker.
     const uint64_t expected =
-        (op == Op::kPull || op == Op::kPushPull) ? (e - b) * vpk : 0;
+        (op == Op::kPull || op == Op::kPushPull) ? (e - b) * vpk * mult : 0;
+    if (rh.flags & kError) {
+      // Explicit protocol-level rejection (e.g. an opt-state op against
+      // a non-FTRL server): a caller error with a clean, still-framed
+      // stream — named, and not poisoned on the single-server handles
+      // these ops ride (a multi-server op abandons peers' replies
+      // mid-collection, so THAT stream set must poison).
+      c->poisoned = c->servers.size() > 1;
+      c->op_rejected = true;
+      snprintf(c->err, sizeof(c->err),
+               "server %zu rejected op %d (flags 0x%x): unsupported for "
+               "its configuration", s, static_cast<int>(op), flags);
+      return -1;
+    }
     if (rh.num_keys != expected) {
       c->poisoned = true;
       snprintf(c->err, sizeof(c->err),
@@ -299,7 +363,7 @@ int RoundTrip(Client* c, Op op, const Key* keys, const float* vals,
     if (expected) {
       bool ok;
       if (out_vals != nullptr) {
-        ok = ReadFull(c->servers[s].fd, out_vals + b * vpk,
+        ok = ReadFull(c->servers[s].fd, out_vals + b * vpk * mult,
                       expected * sizeof(Val));
       } else {
         // Caller doesn't want the weights (push_pull with a null out is
@@ -435,6 +499,103 @@ int kv_push_pull_vpk(void* handle, const uint64_t* keys, const float* vals,
                            distlr::kNone, 0, vpk);
 }
 
+// --- gradient-codec negotiation (kv_protocol.h capability handshake).
+// Sends kHello to EVERY server and intersects the capability masks: a
+// legacy server's empty reply reads as "no capabilities", so the
+// negotiated codec degrades to dense f32 against any old binary in the
+// group.  `want` is a Codec id (1 = int8 block-quant, 2 = signSGD
+// 1-bit); returns the codec now in force (want, or 0 on fallback), or
+// -1 on a transport failure (handle poisoned like any receive failure).
+// Subsequent gradient pushes on this handle carry the negotiated codec;
+// init and opt-state pushes stay dense f32 always.
+int kv_negotiate_codec(void* handle, int want) {
+  auto* c = static_cast<distlr::Client*>(handle);
+  c->timed_out = false;
+  if (c->poisoned) {
+    snprintf(c->err, sizeof(c->err),
+             "connection poisoned by an earlier receive failure; "
+             "reconnect (kv_connect) before issuing more ops");
+    return -1;
+  }
+  if (want != distlr::kCodecInt8 && want != distlr::kCodecSign) {
+    snprintf(c->err, sizeof(c->err), "unknown codec %d (1=int8, 2=sign)",
+             want);
+    return -1;
+  }
+  uint64_t caps = ~0ull;
+  for (size_t s = 0; s < c->servers.size(); ++s) {
+    const uint32_t ts = c->next_ts++;
+    distlr::MsgHeader h{distlr::kMagic,
+                        static_cast<uint8_t>(distlr::Op::kHello),
+                        distlr::kNone, 0, c->client_id, ts, 0};
+    const int fd = c->servers[s].fd;
+    if (!distlr::WriteFull(fd, &h, sizeof(h))) {
+      c->poisoned = true;
+      snprintf(c->err, sizeof(c->err), "hello to server %zu failed", s);
+      return -1;
+    }
+    distlr::MsgHeader rh{};
+    errno = 0;
+    if (!distlr::ReadFull(fd, &rh, sizeof(rh))) {
+      c->poisoned = true;
+      c->timed_out = errno == EAGAIN || errno == EWOULDBLOCK;
+      snprintf(c->err, sizeof(c->err),
+               "no hello reply from server %zu", s);
+      return -1;
+    }
+    if (rh.magic != distlr::kMagic || !(rh.flags & distlr::kResponse) ||
+        rh.timestamp != ts ||
+        (rh.num_keys != 0 && rh.num_keys != 2)) {
+      c->poisoned = true;
+      snprintf(c->err, sizeof(c->err),
+               "bad hello reply from server %zu", s);
+      return -1;
+    }
+    uint64_t mask = 0;  // legacy empty reply: no capabilities
+    if (rh.num_keys == 2) {
+      double d = 0.0;
+      static_assert(sizeof(d) == 2 * sizeof(distlr::Val),
+                    "capability mask layout");
+      if (!distlr::ReadFull(fd, &d, sizeof(d))) {
+        c->poisoned = true;
+        snprintf(c->err, sizeof(c->err),
+                 "short hello reply from server %zu", s);
+        return -1;
+      }
+      mask = static_cast<uint64_t>(d);
+    }
+    caps &= mask;
+  }
+  c->codec = (caps & (1ull << want)) ? static_cast<uint8_t>(want) : 0;
+  return c->codec;
+}
+
+// Request bytes the most recent op put on the wire (headers + keys +
+// value payload over all servers) — the compression-ratio denominator.
+uint64_t kv_last_wire_sent(void* handle) {
+  return static_cast<distlr::Client*>(handle)->wire_sent;
+}
+
+// --- FTRL opt-state snapshot/restore (kOptState, kv_protocol.h).
+// Single-server handles only (the supervisor's per-rank connections):
+// out/vals hold [z for every key..., n for every key...] = 2n floats.
+int kv_pull_opt_state(void* handle, const uint64_t* keys, float* out_vals,
+                      uint64_t n) {
+  auto* c = static_cast<distlr::Client*>(handle);
+  return distlr::RoundTrip(c, distlr::Op::kPull, keys, nullptr, out_vals, n,
+                           distlr::kOptState);
+}
+
+int kv_push_init_opt_state(void* handle, const uint64_t* keys,
+                           const float* vals, uint64_t n, int force) {
+  auto* c = static_cast<distlr::Client*>(handle);
+  const uint8_t flags = static_cast<uint8_t>(
+      distlr::kInitPush | distlr::kOptState |
+      (force ? distlr::kForceInit : 0));
+  return distlr::RoundTrip(c, distlr::Op::kPush, keys, vals, nullptr, n,
+                           flags);
+}
+
 // Receive timeout for every pending/future op, in milliseconds; 0
 // restores the reference's semantics (block forever — and deadlock on a
 // sync-mode straggler exactly like ps-lite, SURVEY.md §5.3).
@@ -463,6 +624,14 @@ int kv_set_push_visit_all(void* handle, int on) {
 // connection / protocol error).
 int kv_timed_out(void* handle) {
   return static_cast<distlr::Client*>(handle)->timed_out ? 1 : 0;
+}
+
+// 1 if the most recent failed op was an explicit kError protocol
+// rejection — deterministic (e.g. an opt-state op against a non-FTRL
+// server), so re-issuing it can never succeed and retry loops must
+// fail fast instead of burning their attempt/deadline budget.
+int kv_op_rejected(void* handle) {
+  return static_cast<distlr::Client*>(handle)->op_rejected ? 1 : 0;
 }
 
 // Delivery state of the most recent FAILED op: 0 = no byte of its

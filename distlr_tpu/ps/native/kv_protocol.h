@@ -38,7 +38,9 @@
 #ifndef DISTLR_TPU_PS_KV_PROTOCOL_H_
 #define DISTLR_TPU_PS_KV_PROTOCOL_H_
 
+#include <cmath>
 #include <cstdint>
+#include <cstring>
 
 namespace distlr {
 
@@ -91,7 +93,143 @@ enum Flags : uint8_t {
   // weights while the epoch counter says otherwise.  Restarted workers
   // must NOT set it (they would roll peers back to the checkpoint).
   kForceInit = 8,
+  // Bits 4-5: gradient CODEC of a push-class frame's value payload
+  // (see Codec below; 0 = dense f32, the only encoding older peers
+  // speak).  Landed additively like vals_per_key: the server decodes at
+  // the parsing layer, so merge/barrier/rollback/optimizer semantics
+  // are byte-identical to a client that sent dense f32.  A client may
+  // set these bits ONLY after the kHello capability handshake proved
+  // every server of the group decodes the codec — an un-negotiated
+  // compressed frame against an old server would desynchronize the
+  // stream (the old server reads num_keys*vpk f32s of payload).
+  kCodecShift = 4,
+  kCodecMask = 0x30,
+  // The op addresses the server optimizer's per-coordinate accumulator
+  // state (FTRL z/n) instead of the weights: a kPull|kOptState reply
+  // carries 2x vals per key ([z..., n...]); a kPush|kInitPush|kOptState
+  // request seeds them the same way.  This is what lets a supervisor
+  // snapshot/restore an FTRL rank without degrading a respawn to a
+  // warm restart (weights-only reseed loses the accumulators).  Only
+  // valid with kInitPush on the push side — optimizer state has no
+  // gradient semantics to merge.
+  kOptState = 64,
 };
+
+// --- gradient wire codecs (the Flags bits 4-5 field) -------------------
+//
+// A coded push replaces the num_keys*vpk f32 value payload with:
+//   kCodecInt8: ceil(n/kQuantBlock) f32 per-block scales, then n int8
+//               quantized values (block-symmetric: scale = amax/127,
+//               q = rint(v/scale) clamped to [-127, 127]) — ~3.9x
+//               fewer value bytes, error bounded by scale/2 per coord;
+//   kCodecSign: ceil(n/8) bytes, bit i (LSB-first) = (v_i > 0) — the
+//               1-bit signSGD encoding (Bernstein et al.): decode is
+//               +1/-1, with NO abstention — an exact zero decodes -1
+//               and votes like any other coordinate.  Safe when the
+//               gradient crossing the wire is dense in the measure-
+//               theoretic sense (the paper's regime: every coordinate
+//               stochastically nonzero); NOT safe for a full-width
+//               push of an effectively-sparse gradient, where every
+//               never-touched coordinate's -1 vote walks its weight
+//               +lr per round.  Sparse workloads must push touched
+//               keys only (the keyed path) or use kCodecInt8 (a zero
+//               block encodes exactly); the Python client logs a
+//               one-time warning when a sign-coded push is mostly
+//               zeros.  Pairs with the server's signsgd majority-vote
+//               optimizer; the capability mask only advertises it there.
+// Keys, headers, and every reply stay dense/uncompressed — pulls are
+// the serving tier's path and already have keyed/chunked/hot-row
+// reductions; the PUSH payload is what crosses the wire every batch.
+enum Codec : uint8_t {
+  kCodecNone = 0,
+  kCodecInt8 = 1,
+  kCodecSign = 2,
+};
+
+//: int8 block-quantization granularity (values per f32 scale)
+constexpr uint64_t kQuantBlock = 256;
+
+inline uint8_t CodecOf(uint8_t flags) {
+  return (flags & kCodecMask) >> kCodecShift;
+}
+
+// Exact value-payload size of a coded frame carrying n values — both
+// sides derive it from (codec, n), so a compressed frame needs no extra
+// length field and stays as corruption-guarded as the dense layout.
+inline uint64_t CodecPayloadBytes(uint8_t codec, uint64_t n) {
+  if (codec == kCodecInt8)
+    return ((n + kQuantBlock - 1) / kQuantBlock) * 4 + n;
+  if (codec == kCodecSign) return (n + 7) / 8;
+  return n * sizeof(float);
+}
+
+// Shared by client (encode) and server (decode) so the two sides cannot
+// drift: one definition of the byte layout, compiled into both.
+inline void EncodeGrad(uint8_t codec, const float* v, uint64_t n,
+                       uint8_t* out) {
+  if (codec == kCodecInt8) {
+    const uint64_t nb = (n + kQuantBlock - 1) / kQuantBlock;
+    int8_t* q = reinterpret_cast<int8_t*>(out + nb * 4);
+    for (uint64_t b = 0; b < nb; ++b) {
+      const uint64_t lo = b * kQuantBlock;
+      const uint64_t hi = lo + kQuantBlock < n ? lo + kQuantBlock : n;
+      float amax = 0.0f;
+      for (uint64_t i = lo; i < hi; ++i) {
+        const float a = v[i] < 0 ? -v[i] : v[i];
+        if (a > amax) amax = a;
+      }
+      const float scale = amax / 127.0f;
+      std::memcpy(out + b * 4, &scale, 4);
+      for (uint64_t i = lo; i < hi; ++i) {
+        if (scale == 0.0f) {
+          q[i] = 0;
+          continue;
+        }
+        // nearbyintf default mode = round-half-to-even = np.rint: the
+        // NumPy reference codec (distlr_tpu/compress/codecs.py) must
+        // reproduce this bit for bit
+        float r = nearbyintf(v[i] / scale);
+        if (r > 127.0f) r = 127.0f;
+        if (r < -127.0f) r = -127.0f;
+        q[i] = static_cast<int8_t>(r);
+      }
+    }
+  } else if (codec == kCodecSign) {
+    const uint64_t nb = (n + 7) / 8;
+    for (uint64_t b = 0; b < nb; ++b) out[b] = 0;
+    for (uint64_t i = 0; i < n; ++i) {
+      if (v[i] > 0.0f) out[i / 8] |= static_cast<uint8_t>(1u << (i % 8));
+    }
+  }
+}
+
+inline void DecodeGrad(uint8_t codec, const uint8_t* in, uint64_t n,
+                       float* out) {
+  if (codec == kCodecInt8) {
+    const uint64_t nb = (n + kQuantBlock - 1) / kQuantBlock;
+    const int8_t* q = reinterpret_cast<const int8_t*>(in + nb * 4);
+    for (uint64_t i = 0; i < n; ++i) {
+      float scale;
+      std::memcpy(&scale, in + (i / kQuantBlock) * 4, 4);
+      out[i] = static_cast<float>(q[i]) * scale;
+    }
+  } else if (codec == kCodecSign) {
+    for (uint64_t i = 0; i < n; ++i) {
+      out[i] = (in[i / 8] >> (i % 8)) & 1 ? 1.0f : -1.0f;
+    }
+  }
+}
+
+// --- kHello capability handshake ---------------------------------------
+// A capability-aware server answers kHello with ONE f64 bitmask shipped
+// as 2 Val slots (the kStats float64-in-Val convention); a legacy
+// server echoes an EMPTY reply (num_keys == 0), which the client reads
+// as "no capabilities" and falls back to dense f32 — negotiation is
+// additive, no version field needed.  kCapCodecSign is advertised only
+// by --optimizer=signsgd servers: decoded ±1 votes through any other
+// update rule would be sign-mean, not the paper's majority vote.
+constexpr uint64_t kCapCodecInt8 = 1ull << kCodecInt8;
+constexpr uint64_t kCapCodecSign = 1ull << kCodecSign;
 
 #pragma pack(push, 1)
 struct MsgHeader {

@@ -28,7 +28,7 @@
 //                    [--max_dim=2^31]  (elasticity/corruption cap, §below)
 //                    [--sync=1] [--last_gradient=0] [--bind_any=0]
 //                    [--optimizer=sgd] [--ftrl_alpha=0.1] [--ftrl_beta=1]
-//                    [--ftrl_l1=0] [--ftrl_l2=0]
+//                    [--ftrl_l1=0] [--ftrl_l2=0] [--compress=1]
 //
 // --optimizer selects the server-side update rule applied to incoming
 // gradients (the pluggable point the lr flag already parameterized):
@@ -47,6 +47,20 @@
 //          merge scan from re-deriving untouched weights.  Sync mode
 //          applies FTRL to the round's MEAN gradient; async per push.
 //          --last_gradient (the Q1 reference-SGD quirk) is rejected.
+//   signsgd — majority-vote signSGD (Bernstein et al., arXiv:1802.04434;
+//          the 1-bit-per-coordinate PS aggregation the paper's theory
+//          covers): workers push sign(g) (normally via the kCodecSign
+//          wire codec, ±1 after decode).  Sync/BSP: the round's votes
+//          accumulate in the merge buffer and release applies ONE step
+//          w -= lr * sign(sum of votes), tied coordinates untouched —
+//          the vote-then-apply kernel.  Async: each push applies
+//          w -= lr * sign(g) (a one-voter majority).  Incompatible
+//          with --last_gradient (an SGD parity quirk).
+//
+// --compress=0 hides the gradient-codec capability: kHello answers with
+// the legacy empty reply, so negotiating clients fall back to dense f32
+// exactly as against a pre-codec server binary (the compatibility knob,
+// and what the graceful-fallback tests simulate an old server with).
 //
 // --port=0 binds an ephemeral port; the chosen port is announced as
 // "PORT <n>" on stdout so a supervisor can read it race-free.
@@ -103,16 +117,21 @@ struct FtrlParams {
   float l2 = 0.0f;
 };
 
+// Server-side update rule (--optimizer); kSign is the majority-vote
+// signSGD aggregation path, the third peer of sgd/ftrl.
+enum class Opt : uint8_t { kSgd, kFtrl, kSign };
+
 class KVServer {
  public:
   KVServer(int port, int num_workers, uint64_t dim, float lr, bool sync,
            bool last_gradient, bool bind_any, uint64_t max_dim,
-           bool ftrl, FtrlParams ftrl_params)
+           Opt opt, FtrlParams ftrl_params, bool compress)
       : port_(port), num_workers_(num_workers), lr_(lr), sync_(sync),
         last_gradient_(last_gradient), bind_any_(bind_any),
-        max_dim_(max_dim), ftrl_(ftrl), fp_(ftrl_params) {
+        max_dim_(max_dim), opt_(opt), fp_(ftrl_params),
+        compress_(compress) {
     weights_.resize(dim, 0.0f);
-    if (ftrl_) {
+    if (opt_ == Opt::kFtrl) {
       z_.resize(dim, 0.0f);
       nacc_.resize(dim, 0.0f);
     }
@@ -147,9 +166,12 @@ class KVServer {
     printf("PORT %d\n", port_);
     fflush(stdout);
     fprintf(stderr, "[distlr_kv_server] listening on %s:%d "
-            "(workers=%d dim=%zu sync=%d optimizer=%s lr=%g)\n",
+            "(workers=%d dim=%zu sync=%d optimizer=%s lr=%g compress=%d)\n",
             bind_any_ ? "0.0.0.0" : "127.0.0.1", port_, num_workers_,
-            weights_.size(), sync_ ? 1 : 0, ftrl_ ? "ftrl" : "sgd", lr_);
+            weights_.size(), sync_ ? 1 : 0,
+            opt_ == Opt::kFtrl ? "ftrl"
+            : opt_ == Opt::kSign ? "signsgd" : "sgd",
+            lr_, compress_ ? 1 : 0);
     fflush(stderr);
 
     std::vector<std::thread> conns;
@@ -238,6 +260,7 @@ class KVServer {
     std::vector<Key> keys;
     std::vector<Key> expanded;
     std::vector<Val> vals;
+    std::vector<uint8_t> coded;
     while (true) {
       MsgHeader h{};
       if (!ReadFull(fd, &h, sizeof(h)) || h.magic != kMagic) break;
@@ -309,16 +332,58 @@ class KVServer {
       MsgHeader hf = h;
       hf.num_keys = n_flat;
       if (op == Op::kPush || op == Op::kPushPull) {
-        if (!ReadChunked(fd, vals, n_flat)) break;
-        HandlePush(fd, hf, *use_keys, vals, max_key, op == Op::kPushPull);
+        // Wire codec (kv_protocol.h): a coded push's value payload is
+        // decoded HERE, at the parsing layer — like vpk expansion, so
+        // every handler below (merge, rollback, optimizer, deferred
+        // release) sees exactly the dense f32 values a legacy client
+        // would have sent and the semantics cannot diverge.  A codec
+        // this server never advertised (negotiation is the only legal
+        // path to these bits) is wire corruption: drop the connection.
+        const uint8_t codec = CodecOf(h.flags);
+        const bool opt_state = (h.flags & kOptState) != 0;
+        if (codec != kCodecNone &&
+            (!compress_ || codec > kCodecSign || opt_state ||
+             (h.flags & kInitPush) ||
+             (codec == kCodecSign && opt_ != Opt::kSign))) {
+          std::fprintf(stderr,
+                       "[distlr_kv_server] dropping connection: "
+                       "un-negotiated or invalid codec %u on push "
+                       "(flags 0x%x)\n", codec, h.flags);
+          break;
+        }
+        if (opt_state && !(h.flags & kInitPush)) {
+          // optimizer state has no gradient semantics to merge — only
+          // the idempotent init/seed form exists
+          std::fprintf(stderr,
+                       "[distlr_kv_server] dropping connection: "
+                       "kOptState push without kInitPush\n");
+          break;
+        }
+        if (codec != kCodecNone) {
+          if (!ReadChunked(fd, coded, CodecPayloadBytes(codec, n_flat)))
+            break;
+          vals.resize(n_flat);
+          DecodeGrad(codec, coded.data(), n_flat, vals.data());
+        } else if (!ReadChunked(fd, vals, opt_state ? 2 * n_flat : n_flat)) {
+          break;
+        }
+        if (opt_state) {
+          HandleOptStatePush(fd, hf, *use_keys, vals, max_key);
+        } else {
+          HandlePush(fd, hf, *use_keys, vals, max_key, op == Op::kPushPull);
+        }
       } else if (op == Op::kPull) {
-        HandlePull(fd, hf, *use_keys, max_key);
+        if (h.flags & kOptState) {
+          HandleOptStatePull(fd, hf, *use_keys, max_key);
+        } else {
+          HandlePull(fd, hf, *use_keys, max_key);
+        }
       } else if (op == Op::kBarrier) {
         HandleBarrier(fd, h);
       } else if (op == Op::kStats) {
         HandleStats(fd, h);
       } else if (op == Op::kHello) {
-        Respond(fd, h, nullptr, 0);
+        HandleHello(fd, h);
       } else if (op == Op::kShutdown) {
         Respond(fd, h, nullptr, 0);
         shutdown_.store(true);
@@ -356,6 +421,33 @@ class KVServer {
     if (nvals) WriteFull(fd, vals, nvals * sizeof(Val));
   }
 
+  // Explicit protocol-level rejection (kError): the stream stays framed
+  // — unlike a dropped connection — so the client can surface a named
+  // error and keep the handle (e.g. an opt-state op against a non-FTRL
+  // server is a CALLER bug, not wire corruption).
+  void RespondError(int fd, MsgHeader h) {
+    h.flags |= kError;
+    Respond(fd, h, nullptr, 0);
+  }
+
+  // --- HELLO: capability handshake (kv_protocol.h).  With --compress=0
+  // the reply is the legacy empty frame — byte-identical to a pre-codec
+  // server, which is exactly what negotiating clients fall back on. ---
+  void HandleHello(int fd, const MsgHeader& h) {
+    if (!compress_) {
+      Respond(fd, h, nullptr, 0);
+      return;
+    }
+    uint64_t mask = kCapCodecInt8;
+    // sign votes only mean majority-vote through the signsgd kernel;
+    // any other optimizer would apply sign-mean, so don't offer it
+    if (opt_ == Opt::kSign) mask |= kCapCodecSign;
+    const double d = static_cast<double>(mask);
+    Val out[2];
+    std::memcpy(out, &d, sizeof(d));
+    Respond(fd, h, out, 2);
+  }
+
   void EnsureCapacity(Key max_key) {
     if (max_key < weights_.size()) return;
     const size_t old_w = weights_.size();
@@ -364,7 +456,7 @@ class KVServer {
     try {
       weights_.resize(max_key + 1, 0.0f);
       merge_.resize(weights_.size(), 0.0f);
-      if (ftrl_) {
+      if (opt_ == Opt::kFtrl) {
         z_.resize(weights_.size(), 0.0f);
         nacc_.resize(weights_.size(), 0.0f);
       }
@@ -377,14 +469,14 @@ class KVServer {
       // astronomically unlikely and only costs footprint, not state.
       weights_.resize(old_w);
       merge_.resize(old_m);
-      if (ftrl_) {
+      if (opt_ == Opt::kFtrl) {
         z_.resize(old_z);
         nacc_.resize(old_z);
       }
       try {
         weights_.shrink_to_fit();
         merge_.shrink_to_fit();
-        if (ftrl_) {
+        if (opt_ == Opt::kFtrl) {
           z_.shrink_to_fit();
           nacc_.shrink_to_fit();
         }
@@ -419,9 +511,13 @@ class KVServer {
   // FTRL skips zero gradients (no information; and re-deriving w from
   // unchanged z would zero a freshly init-pushed weight, since init
   // seeds weights_ directly and leaves z/n at 0 until real traffic).
+  // signSGD async is the one-voter majority: w -= lr * sign(g).
   inline void ApplyGrad(Key k, float g) {
-    if (ftrl_) {
+    if (opt_ == Opt::kFtrl) {
       if (g != 0.0f) FtrlStep(k, g);
+    } else if (opt_ == Opt::kSign) {
+      if (g > 0.0f) weights_[k] -= lr_;
+      else if (g < 0.0f) weights_[k] += lr_;
     } else {
       weights_[k] -= lr_ * g;
     }
@@ -522,11 +618,22 @@ class KVServer {
           for (size_t i = 0; i < pick->keys.size(); ++i)
             weights_[pick->keys[i]] -= lr_ * pick->vals[i] / w;
         }
-      } else if (ftrl_) {
+      } else if (opt_ == Opt::kFtrl) {
         // FTRL BSP: ONE optimizer step on the round's mean gradient,
         // untouched (zero-merge) coordinates skipped — see ApplyGrad.
         for (size_t i = 0; i < merge_.size(); ++i)
           if (merge_[i] != 0.0f) FtrlStep(i, merge_[i] / w);
+      } else if (opt_ == Opt::kSign) {
+        // signSGD BSP: the merge buffer accumulated the round's ±1
+        // votes (kCodecSign decodes to exactly ±1, so vote counts are
+        // exact small integers in f32); majority vote then ONE step —
+        // w -= lr * sign(sum of votes), tied/untouched coordinates
+        // skipped.  NOT divided by W: the paper's server applies the
+        // aggregate sign, magnitude lr, however many voters.
+        for (size_t i = 0; i < merge_.size(); ++i) {
+          if (merge_[i] > 0.0f) weights_[i] -= lr_;
+          else if (merge_[i] < 0.0f) weights_[i] += lr_;
+        }
       } else {
         // Correct BSP: mean of the merged gradients.  Expression kept
         // verbatim (lr*g/W, not lr*(g/W)) — the trajectory is pinned
@@ -576,6 +683,55 @@ class KVServer {
         else ++it;
       }
     }
+  }
+
+  // --- OPT-STATE (kOptState): read/seed the FTRL z/n accumulators.
+  // The supervisor's snapshot/restore path: a weights-only reseed of a
+  // respawned FTRL rank silently degrades to a warm restart (z/n reset
+  // to zero = per-coordinate learning rates and L1 duals forgotten);
+  // these two ops let it capture and restore the full optimizer state.
+  // Layout on the wire: [z for every key..., n for every key...] —
+  // 2x vals per expanded key, both directions. ---
+  void HandleOptStatePull(int fd, const MsgHeader& h,
+                          const std::vector<Key>& keys, Key max_key) {
+    if (opt_ != Opt::kFtrl) {
+      RespondError(fd, h);
+      return;
+    }
+    const size_t n = keys.size();
+    std::vector<Val> out(2 * n);
+    {
+      std::lock_guard<std::mutex> lock(mu_);
+      ++n_pull_;
+      if (!keys.empty()) EnsureCapacity(max_key);
+      for (size_t i = 0; i < n; ++i) {
+        out[i] = z_[keys[i]];
+        out[n + i] = nacc_[keys[i]];
+      }
+    }
+    Respond(fd, h, out.data(), out.size());
+  }
+
+  void HandleOptStatePush(int fd, const MsgHeader& h,
+                          const std::vector<Key>& keys,
+                          const std::vector<Val>& vals, Key max_key) {
+    // ServeLoop enforced kInitPush: this is the idempotent seed form
+    // only, replied immediately, never merged (mirrors weight init).
+    if (opt_ != Opt::kFtrl) {
+      RespondError(fd, h);
+      return;
+    }
+    std::lock_guard<std::mutex> lock(mu_);
+    ++n_push_;
+    if (!keys.empty()) EnsureCapacity(max_key);
+    if ((!initialized_ || (h.flags & kForceInit)) && !keys.empty()) {
+      const size_t n = keys.size();
+      for (size_t i = 0; i < n; ++i) {
+        z_[keys[i]] = vals[i];
+        nacc_[keys[i]] = vals[n + i];
+      }
+    }
+    Respond(fd, h, nullptr, 0);
   }
 
   // --- PULL: reply current weights (src/main.cc:85-95) ---
@@ -666,8 +822,9 @@ class KVServer {
   bool last_gradient_;
   bool bind_any_;
   uint64_t max_dim_;
-  bool ftrl_;
+  Opt opt_;
   FtrlParams fp_;
+  bool compress_;
   int listen_fd_ = -1;
   std::atomic<bool> shutdown_{false};
   std::vector<int> active_fds_;
@@ -735,18 +892,25 @@ int main(int argc, char** argv) {
       static_cast<uint64_t>(Arg(argc, argv, "max_dim", 1L << 31)),
       static_cast<uint64_t>(dim));
   const std::string optimizer = ArgS(argc, argv, "optimizer", "sgd");
-  if (optimizer != "sgd" && optimizer != "ftrl") {
+  distlr::Opt opt;
+  if (optimizer == "sgd") {
+    opt = distlr::Opt::kSgd;
+  } else if (optimizer == "ftrl") {
+    opt = distlr::Opt::kFtrl;
+  } else if (optimizer == "signsgd") {
+    opt = distlr::Opt::kSign;
+  } else {
     std::fprintf(stderr, "[distlr_kv_server] unknown --optimizer=%s "
-                 "(sgd|ftrl)\n", optimizer.c_str());
+                 "(sgd|ftrl|signsgd)\n", optimizer.c_str());
     return 2;
   }
-  const bool ftrl = optimizer == "ftrl";
-  if (ftrl && last_gradient) {
-    // Q1 is a reference-SGD parity quirk; "the last worker's gradient
-    // applied / W with SGD" has no FTRL analogue to mirror.
-    std::fprintf(stderr, "[distlr_kv_server] --optimizer=ftrl is "
+  if (opt != distlr::Opt::kSgd && last_gradient) {
+    // Q1 is a reference-SGD parity quirk; neither "the last worker's
+    // FTRL step / W" nor "the last worker's majority vote" exists as a
+    // reference behavior to mirror.
+    std::fprintf(stderr, "[distlr_kv_server] --optimizer=%s is "
                  "incompatible with --last_gradient=1 (Q1 is an SGD "
-                 "parity quirk)\n");
+                 "parity quirk)\n", optimizer.c_str());
     return 2;
   }
   distlr::FtrlParams fp;
@@ -754,15 +918,17 @@ int main(int argc, char** argv) {
   fp.beta = static_cast<float>(ArgF(argc, argv, "ftrl_beta", 1.0));
   fp.l1 = static_cast<float>(ArgF(argc, argv, "ftrl_l1", 0.0));
   fp.l2 = static_cast<float>(ArgF(argc, argv, "ftrl_l2", 0.0));
-  if (ftrl && (fp.alpha <= 0.0f || fp.beta < 0.0f || fp.l1 < 0.0f ||
-               fp.l2 < 0.0f)) {
+  if (opt == distlr::Opt::kFtrl &&
+      (fp.alpha <= 0.0f || fp.beta < 0.0f || fp.l1 < 0.0f ||
+       fp.l2 < 0.0f)) {
     std::fprintf(stderr, "[distlr_kv_server] bad FTRL params: need "
                  "alpha > 0 and beta/l1/l2 >= 0 (got alpha=%g beta=%g "
                  "l1=%g l2=%g)\n", fp.alpha, fp.beta, fp.l1, fp.l2);
     return 2;
   }
+  const bool compress = Arg(argc, argv, "compress", 1) != 0;
   distlr::KVServer server(port, num_workers, static_cast<uint64_t>(dim),
                           static_cast<float>(lr), sync, last_gradient,
-                          bind_any, max_dim, ftrl, fp);
+                          bind_any, max_dim, opt, fp, compress);
   return server.Run();
 }

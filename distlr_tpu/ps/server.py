@@ -83,15 +83,18 @@ class ServerGroup:
         ftrl_beta: float = 1.0,
         ftrl_l1: float = 0.0,
         ftrl_l2: float = 0.0,
+        compress: bool = True,
     ):
-        if optimizer not in ("sgd", "ftrl"):
-            raise ValueError(f"optimizer must be sgd|ftrl, got {optimizer!r}")
-        if optimizer == "ftrl" and last_gradient:
-            # Q1 is a reference-SGD parity quirk; there is no "last
-            # worker's FTRL step / W" reference behavior to mirror.
+        if optimizer not in ("sgd", "ftrl", "signsgd"):
             raise ValueError(
-                "optimizer='ftrl' is incompatible with last_gradient "
-                "(Q1 compat is an SGD parity quirk)"
+                f"optimizer must be sgd|ftrl|signsgd, got {optimizer!r}")
+        if optimizer != "sgd" and last_gradient:
+            # Q1 is a reference-SGD parity quirk; there is no "last
+            # worker's FTRL step / majority vote / W" reference behavior
+            # to mirror.
+            raise ValueError(
+                f"optimizer={optimizer!r} is incompatible with "
+                "last_gradient (Q1 compat is an SGD parity quirk)"
             )
         build_native()
         self._binary = binary or server_binary()
@@ -118,15 +121,20 @@ class ServerGroup:
             # server's default (2^31, always clamped to >= its slice dim)
             max_dim=max_dim,
             # server-side update rule (the pluggable optimizer point the
-            # lr flag already parameterized): "sgd" or "ftrl" (per-
+            # lr flag already parameterized): "sgd", "ftrl" (per-
             # coordinate FTRL-Proximal with z/n accumulators — the
             # sparse-CTR production optimizer the online-learning loop
-            # trains through)
+            # trains through), or "signsgd" (1-bit majority-vote
+            # aggregation — the kCodecSign wire codec's server half)
             optimizer=optimizer,
             ftrl_alpha=ftrl_alpha,
             ftrl_beta=ftrl_beta,
             ftrl_l1=ftrl_l1,
             ftrl_l2=ftrl_l2,
+            # False spawns --compress=0: the server hides its codec
+            # capabilities and answers kHello like a pre-codec binary —
+            # how the graceful-fallback tests simulate an old server
+            compress=bool(compress),
         )
         # serializes respawn() against stop() (supervisor thread vs
         # teardown) and marks teardown so a racing respawn becomes a no-op
@@ -168,7 +176,7 @@ class ServerGroup:
         ]
         if self._args["max_dim"] is not None:
             cmd.append(f"--max_dim={self._args['max_dim']}")
-        if self._args["optimizer"] != "sgd":
+        if self._args["optimizer"] == "ftrl":
             # only non-default optimizers touch the command line, so sgd
             # spawns stay byte-identical to every earlier round's
             cmd += [
@@ -178,6 +186,11 @@ class ServerGroup:
                 f"--ftrl_l1={self._args['ftrl_l1']}",
                 f"--ftrl_l2={self._args['ftrl_l2']}",
             ]
+        elif self._args["optimizer"] != "sgd":
+            cmd.append(f"--optimizer={self._args['optimizer']}")
+        if not self._args["compress"]:
+            # non-default only: default spawns stay byte-identical
+            cmd.append("--compress=0")
         proc = subprocess.Popen(cmd, stdout=subprocess.PIPE, text=True)
         # The server prints "PORT <n>" once listening; blocking on that
         # line doubles as the readiness wait.
@@ -367,6 +380,15 @@ class ServerSupervisor:
         self._snap_valid = [False] * group.num_servers
         self._snap_pushes = [-1] * group.num_servers
         self._snap_at = [0.0] * group.num_servers
+        # FTRL groups: the z/n per-coordinate accumulators ride the same
+        # rolling snapshot (pulled via kOptState next to each weight
+        # capture) and are restored on re-seed — without them a
+        # respawned FTRL rank silently degrades to a warm restart: its
+        # per-coordinate learning rates reset to the aggressive t=0
+        # schedule and every L1 dual is forgotten.
+        self._ftrl = group._args["optimizer"] == "ftrl"
+        self._opt_z: np.ndarray | None = None
+        self._opt_n: np.ndarray | None = None
         self._respawns = [0] * group.num_servers
         self._needs_reseed: set[int] = set()
         self._stop = threading.Event()
@@ -424,6 +446,9 @@ class ServerSupervisor:
     def _try_snapshot_inner(self) -> None:
         if self._snapshot is None:
             self._snapshot = np.zeros(self._group.dim, np.float32)
+        if self._ftrl and self._opt_z is None:
+            self._opt_z = np.zeros(self._group.dim, np.float32)
+            self._opt_n = np.zeros(self._group.dim, np.float32)
         for r in range(self._group.num_servers):
             try:
                 with self._probe_rank(r) as kv:
@@ -446,6 +471,17 @@ class ServerSupervisor:
                     vals = kv.pull()
                     lo, hi = self._group.key_range(r)
                     self._snapshot[lo:hi] = vals
+                    if self._ftrl:
+                        # same cycle, not atomic with the weight pull:
+                        # updates landing between the two pulls make z/n
+                        # marginally newer than w — FTRL re-derives w
+                        # from z on the next touch of each coordinate,
+                        # so the inconsistency self-heals per coordinate
+                        # (the same bounded-staleness class the
+                        # snapshot itself already accepts)
+                        z, n = kv.pull_opt_state()
+                        self._opt_z[lo:hi] = z
+                        self._opt_n[lo:hi] = n
                     # The counter was read BEFORE the pull, so it may
                     # undercount what the pull captured — the safe
                     # direction (worst case: one redundant re-pull next
@@ -472,6 +508,15 @@ class ServerSupervisor:
         try:
             with self._probe_rank(rank) as kv:
                 kv.push_init(vals, force=True)
+                if self._ftrl and self._snap_valid[rank]:
+                    # restore the FTRL accumulators captured with this
+                    # slice — the respawn keeps its per-coordinate
+                    # learning-rate schedule and L1 duals instead of
+                    # degrading to a warm restart.  (seeded-zeros case:
+                    # a fresh server's z/n are already zeros.)
+                    kv.push_init_opt_state(self._opt_z[lo:hi],
+                                           self._opt_n[lo:hi],
+                                           force=True)
         except Exception as e:
             # retried next poll (_needs_reseed): an unseeded-but-alive
             # server would otherwise install the first gradient push AS

@@ -35,6 +35,7 @@ import types
 import jax
 import numpy as np
 
+from distlr_tpu.compress import GradientAccumulator
 from distlr_tpu.config import Config
 from distlr_tpu.data import DataIter
 from distlr_tpu.data.iterator import SparseDataIter
@@ -92,6 +93,15 @@ _RESTARTS = get_registry().counter(
     "distlr_ps_worker_restarts_total",
     "PS workers rebuilt in place after a failure (max_restarts path)",
 )
+#: Current AdaBatch span of each PS worker (batches per push) — moves
+#: on the growth schedule, so a dashboard shows the push-traffic divisor
+#: next to the push-byte compression ratio it multiplies.
+_ACCUM_K = get_registry().gauge(
+    "distlr_train_accum_batches",
+    "current AdaBatch accumulation span of the PS worker loop "
+    "(batches per push)",
+    labelnames=("rank",),
+)
 
 
 # Below this many per-batch elements (param_dim * batch), the gradient
@@ -121,14 +131,18 @@ def ps_retry_policy(cfg: Config) -> RetryPolicy | None:
     signal and must stay fail-fast (the barrier cannot be retried
     without mixing gradients across rounds).
     """
-    if cfg.sync_mode or cfg.ps_retry_attempts <= 0:
+    if cfg.sync_mode:
         return None
-    return RetryPolicy(
-        attempts=cfg.ps_retry_attempts,
-        backoff_ms=cfg.ps_retry_backoff_ms,
-        backoff_max_ms=cfg.ps_retry_backoff_max_ms,
-        deadline_s=cfg.ps_retry_deadline_s,
-    )
+    return RetryPolicy.from_config(cfg)
+
+
+def server_optimizer(cfg: Config) -> str:
+    """The update rule the server group actually runs: ``signsgd``
+    compression replaces the rule wholesale (1-bit votes through any
+    other optimizer would be sign-mean, not majority vote), otherwise
+    the configured ``ps_optimizer`` — shared by local spawns and
+    ``launch ps-server`` so the two deployment shapes cannot diverge."""
+    return "signsgd" if cfg.ps_compress == "signsgd" else cfg.ps_optimizer
 
 
 def ps_compute_device(cfg: Config, rows: int | None = None):
@@ -466,6 +480,9 @@ class PSWorker:
             hosts, self._param_dim(), client_id=rank,
             timeout_ms=cfg.ps_timeout_ms, sync_group=cfg.sync_mode,
             retry=ps_retry_policy(cfg),
+            # negotiated gradient wire codec (dense f32 when the group
+            # doesn't advertise it — KVWorker logs the fallback)
+            compress=cfg.ps_compress,
         )
         self._hosts = hosts
         # Push-clock probe for the pushes-behind staleness histogram
@@ -698,8 +715,49 @@ class PSWorker:
             json.dump({"epoch": epoch, "attempt": self._sidecar_attempt}, f)
         os.replace(tmp, sidecar)
 
+    def _flush_keyed_accum(self, accum: GradientAccumulator,
+                           vpk: int) -> None:
+        """Push one keyed accumulation span (mean gradient over the
+        span's touched rows).  A span whose gradients cancelled to exact
+        zeros still pushes an EMPTY keyed frame in sync mode — the BSP
+        "present" vote peers' deferred replies are waiting on."""
+        res = accum.flush_keyed(vpk)
+        if res is None:
+            return  # empty span (no batches) — symmetric across workers
+        rows, vals = res
+        if rows.size == 0 and not self.cfg.sync_mode:
+            return
+        with trace_phase("push"):
+            self.kv.wait(self.kv.push(vals, keys=rows, vals_per_key=vpk))
+
+    def _flush_dense_accum(self, accum: GradientAccumulator) -> None:
+        """Push one dense accumulation span (mean gradient)."""
+        g = accum.flush_dense()
+        if g is None:
+            return
+        if not self.cfg.sync_mode:
+            _STALENESS.labels(rank=self.rank).set(
+                time.perf_counter() - self._w_time)
+            self._record_pushes_behind(self._w_pushes)
+        with trace_phase("push"):
+            self.kv.wait(self.kv.push(g))
+
     def _run_epochs(self, start_epoch, w0, train, test, ckpt, *, eval_fn, save):
         cfg = self.cfg
+
+        # AdaBatch local accumulation (--accum-start/--accum-max): push
+        # the span's MEAN every k batches, k growing on the schedule —
+        # divides push traffic by k on top of the wire codec's ratio.
+        # Spans flush at epoch end too (partial), so epochs stay
+        # self-contained for eval and BSP workers stay in lockstep.
+        accum = None
+        if cfg.ps_accum_max > 1:
+            accum = GradientAccumulator(
+                self._param_dim(), start=cfg.ps_accum_start,
+                growth=cfg.ps_accum_growth,
+                growth_every=cfg.ps_accum_growth_every,
+                max_k=cfg.ps_accum_max,
+                gauge=_ACCUM_K.labels(rank=str(self.rank)))
 
         sparse = cfg.model in ("sparse_lr", "sparse_softmax")
         blocked = cfg.model == "blocked_lr"
@@ -807,10 +865,48 @@ class PSWorker:
                         _STALENESS.labels(rank=self.rank).set(
                             time.perf_counter() - t_pull)
                         self._record_pushes_behind(p0)
-                    with trace_phase("push"):
-                        self.kv.wait(self.kv.push(g, keys=keys,
-                                                  vals_per_key=vpk))
+                    if accum is not None:
+                        # accumulate at the batch's own key granularity;
+                        # the flush unions the span's touched rows into
+                        # ONE keyed frame (deduped keys = fewer keyed
+                        # bytes on top of the k-fold frequency cut)
+                        if vpk > 1:
+                            accum.add_rows(keys, g, vpk)
+                        else:
+                            accum.add_at(keys, g)
+                        if accum.ready:
+                            self._flush_keyed_accum(accum, vpk)
+                    else:
+                        with trace_phase("push"):
+                            self.kv.wait(self.kv.push(g, keys=keys,
+                                                      vals_per_key=vpk))
                     self.timer.stop(int(b[-1].sum()))
+                if accum is not None:
+                    self._flush_keyed_accum(accum, vpk)
+            elif accum is not None:
+                # Dense + AdaBatch accumulation: pull once per span,
+                # compute k batches against the span's weights, push the
+                # mean (one PS round per span — in sync mode the BSP
+                # round IS per span, workers in lockstep on the shared
+                # schedule).  The fused/pipelined dense protocols are
+                # bypassed: the span already removes k-1 of every k
+                # round trips, which is the same wall-clock win
+                # pipelining buys, without overlapping state.
+                for X, y, mask in train:
+                    self.timer.start()
+                    if accum.batches == 0:
+                        with trace_phase("pull"):
+                            self._w_cache = self.kv.pull()
+                        self._w_time = time.perf_counter()
+                        self._w_pushes = (None if cfg.sync_mode
+                                          else self._sample_push_clock())
+                    with trace_phase("compute"):
+                        g = compute_g(self._w_cache, X, y, mask)
+                    accum.add(g)
+                    if accum.ready:
+                        self._flush_dense_accum(accum)
+                    self.timer.stop(int(mask.sum()))
+                self._flush_dense_accum(accum)
             elif not cfg.ps_pipeline:
                 # Reference-faithful serialized protocol: two blocking
                 # round trips per batch (src/lr.cc:116-132).
@@ -1180,7 +1276,7 @@ def run_ps_local(cfg: Config, *, eval_fn=None, save=False, resume=False,
         sync=cfg.sync_mode,
         last_gradient=bool(cfg.sync_last_gradient),
         via_chaos=via_chaos,
-        optimizer=cfg.ps_optimizer,
+        optimizer=server_optimizer(cfg),
         ftrl_alpha=cfg.ftrl_alpha,
         ftrl_beta=cfg.ftrl_beta,
         ftrl_l1=cfg.ftrl_l1,

@@ -783,3 +783,80 @@ class TestChaosSoakFull:
         # every potentially-lost push is accounted, never re-issued
         assert (_counter_total("distlr_ps_push_outcome_unknown_total")
                 >= unknown_before)
+
+
+# ---------------------------------------------------------------------------
+# adaptive retry backoff (ISSUE 7 satellite)
+# ---------------------------------------------------------------------------
+
+class TestAdaptiveBackoff:
+    def test_fault_rate_tracker_scales_and_decays(self):
+        from distlr_tpu.ps import FaultRateTracker
+
+        tr = FaultRateTracker(window_s=10.0, max_scale=8.0)
+        assert tr.scale(now=0.0) == 1.0
+        for t in (1.0, 2.0, 3.0, 4.0):
+            tr.record(now=t)
+        # 1 + 0.5 * faults-in-window
+        assert tr.scale(now=5.0) == 3.0
+        # saturates at max_scale under a storm
+        for t in np.linspace(5.0, 6.0, 30):
+            tr.record(now=float(t))
+        assert tr.scale(now=6.0) == 8.0
+        # quiet window: old faults age out, scale decays to the base
+        assert tr.scale(now=17.0) == 1.0
+
+    def test_fault_rate_tracker_validation(self):
+        from distlr_tpu.ps import FaultRateTracker
+
+        with pytest.raises(ValueError, match="window_s"):
+            FaultRateTracker(window_s=0)
+        with pytest.raises(ValueError, match="max_scale"):
+            FaultRateTracker(max_scale=0.5)
+
+    def test_backoff_scale_multiplies_base_under_cap(self):
+        import random
+
+        pol = RetryPolicy(attempts=5, backoff_ms=100, backoff_max_ms=400,
+                          jitter=0.0)
+        rng = random.Random(0)
+        assert pol.backoff_s(0, rng) == pytest.approx(0.1)
+        assert pol.backoff_s(0, rng, scale=2.0) == pytest.approx(0.2)
+        # the cap applies AFTER scaling: adaptivity saturates, never
+        # exceeds the configured ceiling
+        assert pol.backoff_s(1, rng, scale=8.0) == pytest.approx(0.4)
+        with pytest.raises(ValueError, match="adaptive_window_s"):
+            RetryPolicy(adaptive_window_s=0)
+        with pytest.raises(ValueError, match="adaptive_max_scale"):
+            RetryPolicy(adaptive_max_scale=0.9)
+
+    def test_from_config_plumbs_adaptive_flag(self):
+        from distlr_tpu.config import Config
+
+        pol = RetryPolicy.from_config(Config(ps_retry_attempts=3,
+                                             ps_retry_adaptive=True))
+        assert pol is not None and pol.adaptive is True
+        pol = RetryPolicy.from_config(Config(ps_retry_attempts=3))
+        assert pol is not None and pol.adaptive is False
+        assert RetryPolicy.from_config(Config(ps_retry_attempts=0)) is None
+
+    def test_adaptive_worker_records_faults_through_chaos(self):
+        """An adaptive worker crossing injected resets records its
+        faults (the scale input) while still recovering in place."""
+        plan = parse_plan({"faults": [
+            {"kind": "reset", "after_ops": 3},
+        ]})
+        with ServerGroup(1, 1, dim=32, sync=False) as g:
+            with ChaosFabric(g.direct_hosts, plan) as fab:
+                kv = KVWorker(fab.hosts, 32, timeout_ms=2000,
+                              sync_group=False,
+                              retry=RetryPolicy(attempts=6, backoff_ms=10,
+                                                adaptive=True))
+                assert kv._fault_rate is not None
+                kv.push_init(np.zeros(32, np.float32))
+                for _ in range(4):
+                    kv.pull()
+                w = kv.pull()
+                kv.close()
+            np.testing.assert_array_equal(w, np.zeros(32))
+            assert len(kv._fault_rate._faults) >= 1

@@ -601,3 +601,140 @@ class TestClosedLoopEndToEnd:
         plan = parse_plan(CHAOS_PLAN)
         _run_closed_loop(tmp_path, chaos=plan, retry_attempts=4,
                          deadline_s=120.0)
+
+
+# ---------------------------------------------------------------------------
+# multi-worker shard claiming (ISSUE 7 satellite: the .claim protocol)
+# ---------------------------------------------------------------------------
+
+class TestMultiWorkerClaim:
+    def _write_shards(self, shard_dir, n_shards, rows_per=20, seed=0):
+        rng = np.random.default_rng(seed)
+        w_true = np.where(np.arange(D) % 2 == 0, 1.0,
+                          -1.0).astype(np.float32)
+        X, y = _make_rows(n_shards * rows_per, w_true, rng)
+        os.makedirs(shard_dir, exist_ok=True)
+        for s in range(n_shards):
+            with open(os.path.join(shard_dir, f"shard-{s:06d}.libsvm"),
+                      "w") as f:
+                for i in range(s * rows_per, (s + 1) * rows_per):
+                    f.write(f"{y[i]} {_libsvm(X[i])}\n")
+
+    def test_two_workers_consume_each_shard_exactly_once(self, tmp_path):
+        """N `launch online` processes sharing one shard dir: the
+        atomic `.claim` rename gives every shard exactly one owner —
+        no shard trains twice, none is stranded."""
+        shard_dir = str(tmp_path / "shards")
+        self._write_shards(shard_dir, 8)
+        cfg = Config(model="binary_lr", num_feature_dim=D, batch_size=20,
+                     l2_c=0.0, sync_mode=False, learning_rate=0.5)
+        with ServerGroup(1, 2, D, sync=False, learning_rate=0.5) as sg:
+            trainers = [
+                OnlineTrainer(cfg, sg.hosts, shard_dir, worker_id=i,
+                              poll_interval_s=0.02)
+                for i in range(2)
+            ]
+            stats = [None, None]
+
+            def run(i):
+                stats[i] = trainers[i].run(idle_exit_s=0.6)
+
+            threads = [threading.Thread(target=run, args=(i,), daemon=True)
+                       for i in range(2)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=30)
+                assert not t.is_alive()
+            for tr in trainers:
+                tr.close()
+        assert stats[0]["shards_consumed"] + stats[1]["shards_consumed"] == 8
+        names = sorted(os.listdir(shard_dir))
+        assert len([n for n in names if n.endswith(".done")]) == 8
+        assert not [n for n in names if n.endswith((".libsvm", ".claim"))]
+        # every example trained exactly once across the pair
+        assert stats[0]["examples"] + stats[1]["examples"] == 8 * 20
+
+    def test_claim_is_exclusive(self, tmp_path):
+        shard_dir = str(tmp_path / "shards")
+        self._write_shards(shard_dir, 1)
+        cfg = Config(model="binary_lr", num_feature_dim=D, batch_size=20,
+                     l2_c=0.0, sync_mode=False, learning_rate=0.5)
+        path = os.path.join(shard_dir, "shard-000000.libsvm")
+        with ServerGroup(1, 1, D, sync=False) as sg:
+            tr = OnlineTrainer(cfg, sg.hosts, shard_dir,
+                               poll_interval_s=0.02)
+            claimed = tr._claim(path)
+            assert claimed == path + ".claim"
+            assert os.path.exists(claimed)
+            # a raced second claim (same worker or a peer) loses cleanly
+            assert tr._claim(path) is None
+            tr.close()
+
+    def test_stale_claim_reclaimed_and_consumed(self, tmp_path):
+        """A worker that died mid-shard leaves a `.claim` nobody owns:
+        after claim_stale_s it returns to the pool and a live worker
+        finishes it."""
+        shard_dir = str(tmp_path / "shards")
+        self._write_shards(shard_dir, 1)
+        path = os.path.join(shard_dir, "shard-000000.libsvm")
+        orphan = path + ".claim"
+        os.rename(path, orphan)
+        old = time.time() - 3600.0
+        os.utime(orphan, (old, old))  # the dead owner's claim time
+        cfg = Config(model="binary_lr", num_feature_dim=D, batch_size=20,
+                     l2_c=0.0, sync_mode=False, learning_rate=0.5)
+        with ServerGroup(1, 1, D, sync=False) as sg:
+            tr = OnlineTrainer(cfg, sg.hosts, shard_dir,
+                               poll_interval_s=0.02, claim_stale_s=0.5)
+            stats = tr.run(max_shards=1, idle_exit_s=10.0)
+            tr.close()
+        assert stats["shards_consumed"] == 1
+        assert os.path.exists(path + ".done")
+        assert not os.path.exists(orphan)
+
+    def test_stale_claim_reclaimed_under_load(self, tmp_path):
+        """Reclamation must not wait for an idle cycle: under sustained
+        traffic `pending` never drains, but a dead peer's orphaned
+        claim still re-pools on the next poll (regression: reclaim used
+        to run only when the scan came back empty)."""
+        shard_dir = str(tmp_path / "shards")
+        self._write_shards(shard_dir, 2)
+        path = os.path.join(shard_dir, "shard-000000.libsvm")
+        orphan = path + ".claim"
+        os.rename(path, orphan)
+        old = time.time() - 3600.0
+        os.utime(orphan, (old, old))
+        cfg = Config(model="binary_lr", num_feature_dim=D, batch_size=20,
+                     l2_c=0.0, sync_mode=False, learning_rate=0.5)
+        with ServerGroup(1, 1, D, sync=False) as sg:
+            tr = OnlineTrainer(cfg, sg.hosts, shard_dir,
+                               poll_interval_s=0.02, claim_stale_s=0.5)
+            # one shard consumed and out: with shard-000001 still
+            # pending the loop never goes idle, yet the orphan must
+            # already be back in the pool (or consumed as that shard)
+            tr.run(max_shards=1, idle_exit_s=10.0)
+            tr.close()
+        assert not os.path.exists(orphan)
+
+    def test_fresh_claim_not_reclaimed(self, tmp_path):
+        """A claim younger than claim_stale_s belongs to a live peer —
+        hands off."""
+        shard_dir = str(tmp_path / "shards")
+        self._write_shards(shard_dir, 1)
+        path = os.path.join(shard_dir, "shard-000000.libsvm")
+        os.rename(path, path + ".claim")  # fresh mtime = just claimed
+        cfg = Config(model="binary_lr", num_feature_dim=D, batch_size=20,
+                     l2_c=0.0, sync_mode=False, learning_rate=0.5)
+        with ServerGroup(1, 1, D, sync=False) as sg:
+            tr = OnlineTrainer(cfg, sg.hosts, shard_dir,
+                               poll_interval_s=0.02, claim_stale_s=300.0)
+            stats = tr.run(idle_exit_s=0.3)
+            tr.close()
+        assert stats["shards_consumed"] == 0
+        assert os.path.exists(path + ".claim")
+
+    def test_worker_id_validated(self, tmp_path):
+        cfg = Config(model="binary_lr", num_feature_dim=D)
+        with pytest.raises(ValueError, match="worker_id"):
+            OnlineTrainer(cfg, "127.0.0.1:1", str(tmp_path), worker_id=-1)
