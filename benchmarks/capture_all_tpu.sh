@@ -79,6 +79,18 @@ DISTLR_METRICS_SNAPSHOT="benchmarks/capture_logs/fleet/snapshots/compress-0.json
   && echo "bench_compress ok" \
   || echo "bench_compress failed (non-fatal; artifact not refreshed)"
 
+echo "== bench_trace.py (distributed tracing: overhead + merged trace + flight dump; best-effort) =="
+# Distributed-tracing row (ISSUE 8): serve-QPS overhead at the default
+# sample rate (<5% bound), plus ONE sampled merged Chrome trace of the
+# full closed loop (router -> engine -> feedback -> online trainer ->
+# native FTRL server spans, clock-aligned) and one flight-recorder dump
+# banked under capture_logs/trace/ next to the fleet snapshot.
+DISTLR_METRICS_SNAPSHOT="benchmarks/capture_logs/fleet/snapshots/trace-0.json" \
+  timeout 900 python -u benchmarks/bench_trace.py \
+  > benchmarks/capture_logs/bench_trace.json \
+  && echo "bench_trace ok (merged trace -> benchmarks/capture_logs/trace/merged_trace.json)" \
+  || echo "bench_trace failed (non-fatal; artifact not refreshed)"
+
 echo "== bank the fleet metrics snapshot (merged view; best-effort) =="
 # Federates every snapshot banked into the window's fleet dir (today:
 # bench.py; any --obs-run-dir'd process that joins a future window rides

@@ -47,6 +47,7 @@ import time
 
 from distlr_tpu.chaos.plan import FaultPlan, FaultSpec
 from distlr_tpu.compress import codecs
+from distlr_tpu.obs import dtrace
 from distlr_tpu.obs.registry import get_registry
 from distlr_tpu.utils.logging import get_logger
 
@@ -85,8 +86,12 @@ _MAGIC = 0xD157C0DE
 _OP_PUSH, _OP_PUSHPULL = 1, 7
 #: flags fields the framing depends on (kv_protocol.h): bits 4-5 carry
 #: the gradient codec of a push-class value payload, bit 6 marks an
-#: opt-state op (2x vals per key)
-_CODEC_SHIFT, _CODEC_MASK, _OPT_STATE = 4, 0x30, 64
+#: opt-state op (2x vals per key), bit 7 a 16-byte trace trailer after
+#: the header (whose trace_id the fault events record — "this retry was
+#: caused by fault #3" readable straight off the merged trace)
+_CODEC_SHIFT, _CODEC_MASK, _OPT_STATE, _TRACED = 4, 0x30, 64, 0x80
+_TRACE_FRAME = struct.Struct("<QQ")
+_OP_HELLO = 5
 _CODEC_NAMES = {v: k for k, v in codecs.CODEC_IDS.items()}
 
 
@@ -305,6 +310,21 @@ class ChaosLink:
                     up.sendall(header)
                     self._relay_raw(down, up, severed)
                     break
+                # trace trailer (kv_protocol.h kTraced): 16 bytes after
+                # the header on every op but kHello (whose flag only
+                # asks for a clock in the reply) — misframing it would
+                # degrade the whole stream to a raw relay, silently
+                # disabling op-offset faults for exactly the traced runs
+                trailer = b""
+                trace_id = None
+                if flags & _TRACED and op != _OP_HELLO:
+                    trailer = self._read_exact(down, _TRACE_FRAME.size,
+                                               severed)
+                    if trailer is None:
+                        break
+                    trace_id = _TRACE_FRAME.unpack(trailer)[0]
+                trace_kv = ({"trace": f"{trace_id:016x}"}
+                            if trace_id is not None else {})
                 vpk = max(aux, 1) if op in (_OP_PUSH, _OP_PUSHPULL) else 1
                 payload_len = num_keys * 8
                 if op in (_OP_PUSH, _OP_PUSHPULL):
@@ -314,7 +334,7 @@ class ChaosLink:
                     payload = self._read_exact(down, payload_len, severed)
                     if payload is None:
                         break
-                frame = header + payload
+                frame = header + trailer + payload
 
                 self._stall_while_partitioned(severed)
                 if self._stop.is_set() or severed.is_set():
@@ -357,7 +377,8 @@ class ChaosLink:
                                   op_index)
                         ms += f.jitter_ms * (2.0 * u - 1.0)
                     self._fabric.record(self.link, "delay", fault=f.index,
-                                        op=op_index, ms=round(ms, 3))
+                                        op=op_index, ms=round(ms, 3),
+                                        **trace_kv)
                     _FAULTS.labels(kind="delay", link=link).inc()
                     _DELAY_MS.labels(link=link).inc(ms)
                     # sliced like the stall/throttle waits: a multi-second
@@ -378,7 +399,7 @@ class ChaosLink:
                         except OSError:
                             pass
                     self._fabric.record(self.link, "reset", fault=f.index,
-                                        byte=f.after_bytes)
+                                        byte=f.after_bytes, **trace_kv)
                     _FAULTS.labels(kind="reset", link=link).inc()
                     self._sever(down, up, severed, hard=True)
                     return
@@ -406,7 +427,8 @@ class ChaosLink:
                 if after_reset is not None:
                     self._fabric.record(self.link, "reset",
                                         fault=after_reset.index,
-                                        op=after_reset.after_ops)
+                                        op=after_reset.after_ops,
+                                        **trace_kv)
                     _FAULTS.labels(kind="reset", link=link).inc()
                     self._sever(down, up, severed, hard=False)
                     return
@@ -543,6 +565,11 @@ class ChaosFabric:
         return time.monotonic() - self.started_at
 
     def record(self, link: int, kind: str, **detail) -> None:
+        # wall-clock twin for the merged timeline: when this process is
+        # dtrace-configured, every fault also lands as an instant on the
+        # affected link's track (journal-only; the deterministic event
+        # log below stays wall-clock-free and byte-comparable)
+        dtrace.instant(f"chaos.{kind}", tags={"link": link, **detail})
         with self._events_lock:
             if len(self._events) < _MAX_EVENTS:
                 self._events.append(

@@ -260,6 +260,14 @@ class Config:
     # Write the run's phase spans as Chrome trace-event JSON here at the
     # end of the command (loadable in Perfetto / chrome://tracing).
     obs_trace_path: str | None = None
+    # Distributed-trace sampling rate (distlr_tpu.obs.dtrace): the
+    # fraction of minted traces whose spans are journaled to
+    # <obs_run_dir>/spans/ and propagated across the serve line protocol
+    # and the KV wire.  Tracing arms only when obs_run_dir is set (the
+    # journals need the rendezvous dir); 0 disables propagation entirely
+    # and leaves the KV wire byte-identical to the pre-trace protocol.
+    # Unsampled traces still feed the in-memory flight-recorder ring.
+    trace_sample: float = 0.01
 
     # ---- serving (launch serve / distlr_tpu.serve) ----
     # Port 0 = OS-assigned ephemeral (announced as "SERVING host:port").
@@ -526,6 +534,9 @@ class Config:
                 "feedback_drift_block and feedback_drift_threshold must "
                 f"be positive, got {self.feedback_drift_block}/"
                 f"{self.feedback_drift_threshold}")
+        if not 0.0 <= self.trace_sample <= 1.0:
+            raise ValueError(
+                f"trace_sample must be in [0, 1], got {self.trace_sample}")
         if not 0 <= self.route_port < 1 << 16:
             raise ValueError(
                 f"route_port must be in [0, 65536), got {self.route_port}")

@@ -28,12 +28,14 @@ Edge cases, all regression-tested (tests/test_feedback.py):
 
 from __future__ import annotations
 
+import json
 import os
 import random
 import re
 import threading
 import time
 
+from distlr_tpu.obs import dtrace
 from distlr_tpu.obs.registry import get_registry
 from distlr_tpu.feedback.spool import FeedbackSpool, SpoolRecord, drop
 
@@ -101,7 +103,8 @@ class LabelJoiner:
         #: recently joined rids (bounded, insertion-ordered) — the
         #: duplicate-label detector
         self._recent: dict[str, None] = {}
-        self._buffer: list[str] = []
+        #: pending shard lines: (text, trace ids or None, rid or None)
+        self._buffer: list[tuple[str, tuple[int, int] | None, str | None]] = []
         # resume AFTER any shard a previous run left behind (consumed or
         # not) — restarting at 0 would os.replace-clobber unconsumed work
         self._shard_seq = self._next_shard_seq(out_dir)
@@ -111,13 +114,20 @@ class LabelJoiner:
 
     @staticmethod
     def _next_shard_seq(out_dir: str) -> int:
+        # .claim (a shard some online trainer currently owns — it may be
+        # reclaimed back to its original name) and orphaned .trace
+        # sidecars count too: reusing their sequence number would
+        # os.replace-clobber a reclaimed unconsumed shard, or attribute
+        # a new shard to a previous run's traces
         seq = 0
         try:
             names = os.listdir(out_dir)
         except OSError:
             return 0
         for name in names:
-            m = re.match(r"shard-(\d+)\.libsvm(\.done)?$", name)
+            m = re.match(
+                r"shard-(\d+)\.libsvm(\.done|\.claim|\.trace(\.done)?)?$",
+                name)
             if m:
                 seq = max(seq, int(m.group(1)) + 1)
         return seq
@@ -160,19 +170,32 @@ class LabelJoiner:
     # -- the join ----------------------------------------------------------
     def _join_locked(self, rid: str, y: int, rec: SpoolRecord, *,
                      now: float) -> None:
-        _JOIN_DELAY.observe(max(0.0, now - rec.ts))
+        delay = max(0.0, now - rec.ts)
+        _JOIN_DELAY.observe(delay)
         self._remember_locked(rid)
         self.joined += 1
         _JOINED.inc()
-        self._emit_locked(y, rec.line)
+        trace = rec.trace
+        if trace is not None:
+            # continue the scoring request's distributed trace: the join
+            # span parents under the feedback.spool span, and its child
+            # ids ride the shard sidecar to the online trainer
+            ctx = dtrace.TraceContext(trace[0], trace[1], True)
+            with dtrace.span("feedback.join",
+                             tags={"delay_s": round(delay, 3), "y": int(y)},
+                             ctx=ctx) as sp:
+                trace = (sp.ctx.trace_id, sp.ctx.span_id)
+        self._emit_locked(y, rec.line, trace, rid=rid)
 
     def _remember_locked(self, rid: str) -> None:
         self._recent[rid] = None
         while len(self._recent) > self._recent_cap:
             del self._recent[next(iter(self._recent))]
 
-    def _emit_locked(self, y: int, line: str) -> None:
-        self._buffer.append(f"{int(y)} {line}")
+    def _emit_locked(self, y: int, line: str,
+                     trace: tuple[int, int] | None = None,
+                     rid: str | None = None) -> None:
+        self._buffer.append((f"{int(y)} {line}", trace, rid))
         if len(self._buffer) >= self.shard_records:
             self._write_shard_locked()
 
@@ -181,10 +204,33 @@ class LabelJoiner:
             return
         path = os.path.join(self.out_dir,
                             f"shard-{self._shard_seq:06d}.libsvm")
+        # trace sidecar first, shard second: the rename that makes the
+        # shard claimable must find the sidecar already in place (the
+        # trainer reads it at claim time)
+        side = f"{path}.trace"
+        if any(tr is not None for _, tr, _r in self._buffer):
+            stmp = f"{side}.tmp"
+            with open(stmp, "w") as f:
+                json.dump([None if tr is None else f"{tr[0]:016x}/{tr[1]:016x}"
+                           for _, tr, _r in self._buffer], f)
+            os.replace(stmp, side)
+        elif os.path.exists(side):
+            # a crash between sidecar and shard write left an orphan; a
+            # same-numbered traceless shard must not inherit it
+            try:
+                os.unlink(side)
+            except OSError:
+                pass
         tmp = f"{path}.tmp"
         with open(tmp, "w") as f:
-            f.write("\n".join(self._buffer) + "\n")
+            f.write("\n".join(text for text, _tr, _r in self._buffer) + "\n")
         os.replace(tmp, path)  # atomic: the trainer never sees a torn shard
+        # tombstone AFTER the shard is durable: a crash in between
+        # replays the record and at worst re-joins a re-arriving label
+        # (deduped in-session by _recent) — never silently drops one
+        for _text, _tr, rid in self._buffer:
+            if rid is not None:
+                self.spool.mark_joined(rid)
         self._shard_seq += 1
         self._buffer.clear()
         self.shards_written += 1
@@ -204,7 +250,7 @@ class LabelJoiner:
                 if self.negative_rate and self._rng.random() < self.negative_rate:
                     self.negatives += 1
                     _NEGATIVE.inc()
-                    self._emit_locked(0, rec.line)
+                    self._emit_locked(0, rec.line, rec.trace)
                 else:
                     drop("expired")
             stale = [rid for rid, (_, ts) in self._pending.items()

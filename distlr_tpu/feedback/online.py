@@ -37,6 +37,7 @@ online push would block forever in the deferred-reply barrier.
 
 from __future__ import annotations
 
+import json
 import os
 import threading
 import time
@@ -44,6 +45,7 @@ import time
 import numpy as np
 
 from distlr_tpu.config import Config
+from distlr_tpu.obs import dtrace
 from distlr_tpu.obs.registry import get_registry
 from distlr_tpu.utils.logging import get_logger
 
@@ -252,8 +254,42 @@ class OnlineTrainer:
             log.warning("online[%d]: reclaimed stale claim %s (owner "
                         "presumed dead)", self.worker_id, nm)
 
+    @staticmethod
+    def _sidecar_path(path: str) -> str:
+        """Trace sidecar of a shard (written by the joiner before the
+        shard became visible).  ``path`` may be the claimed name — the
+        sidecar always lives next to the ORIGINAL shard name."""
+        if path.endswith(".claim"):
+            path = path[:-len(".claim")]
+        return path + ".trace"
+
+    def _shard_traces(self, path: str) -> list:
+        """Distinct trace contexts the shard's records carried, in
+        first-appearance order ([] = untraced shard / no sidecar)."""
+        try:
+            with open(self._sidecar_path(path)) as f:
+                tokens = json.load(f)
+        except (OSError, ValueError):
+            return []
+        out, seen = [], set()
+        for tok in tokens:
+            if not tok or tok in seen:
+                continue
+            seen.add(tok)
+            try:
+                out.append(dtrace.parse_token(tok))
+            except ValueError:
+                continue
+        return out
+
     def consume_shard(self, path: str) -> int:
-        """Train over one joined shard; returns examples consumed."""
+        """Train over one joined shard; returns examples consumed.
+
+        Distributed tracing: the consume interval runs under the FIRST
+        trace the shard carried (so this shard's flush pushes — and the
+        servers' apply spans under them — chain back to that request's
+        score->label->join timeline), and is retrospectively attributed
+        to every OTHER trace in the shard's sidecar."""
         from distlr_tpu.data.hashing import csr_to_padded_coo  # noqa: PLC0415
         from distlr_tpu.data.libsvm import parse_libsvm_lines  # noqa: PLC0415
 
@@ -261,29 +297,43 @@ class OnlineTrainer:
             lines = [ln for ln in f.read().splitlines() if ln.strip()]
         if not lines:
             return 0
+        traces = self._shard_traces(path)
+        shard = os.path.basename(path)
         cfg = self.cfg
         B = cfg.batch_size if cfg.batch_size > 0 else 256
         n = 0
-        if cfg.model == "sparse_lr":
-            (row_ptr, cols, vals), y = parse_libsvm_lines(
-                lines, cfg.num_feature_dim, dense=False)
-            pc, pv = csr_to_padded_coo(row_ptr, cols, vals,
-                                       nnz_max=cfg.nnz_max)
-            for lo in range(0, len(y), B):
-                self._sparse_batch(pc[lo:lo + B], pv[lo:lo + B],
-                                   y[lo:lo + B])
-                if self._accum.ready:
-                    self._flush_push()
-                n += len(y[lo:lo + B])
-        else:
-            X, y = parse_libsvm_lines(
-                lines, cfg.num_feature_dim, dense=True,
-                multiclass=self._num_classes is not None)
-            for lo in range(0, len(y), B):
-                self._dense_batch(X[lo:lo + B], y[lo:lo + B])
-                if self._accum.ready:
-                    self._flush_push()
-                n += len(y[lo:lo + B])
+        t0_wall, t0 = time.time(), time.monotonic()
+        with dtrace.use(traces[0] if traces else None), dtrace.span(
+                "online.consume",
+                tags={"shard": shard, "records": len(lines),
+                      "worker": self.worker_id}):
+            if cfg.model == "sparse_lr":
+                (row_ptr, cols, vals), y = parse_libsvm_lines(
+                    lines, cfg.num_feature_dim, dense=False)
+                pc, pv = csr_to_padded_coo(row_ptr, cols, vals,
+                                           nnz_max=cfg.nnz_max)
+                for lo in range(0, len(y), B):
+                    self._sparse_batch(pc[lo:lo + B], pv[lo:lo + B],
+                                       y[lo:lo + B])
+                    if self._accum.ready:
+                        self._flush_push()
+                    n += len(y[lo:lo + B])
+            else:
+                X, y = parse_libsvm_lines(
+                    lines, cfg.num_feature_dim, dense=True,
+                    multiclass=self._num_classes is not None)
+                for lo in range(0, len(y), B):
+                    self._dense_batch(X[lo:lo + B], y[lo:lo + B])
+                    if self._accum.ready:
+                        self._flush_push()
+                    n += len(y[lo:lo + B])
+        dur = time.monotonic() - t0
+        for ctx in traces[1:]:
+            # the other traces coalesced into this shard each get the
+            # same interval attributed (ring + journal), so "where did
+            # my label go" has an answer for every request
+            dtrace.record_span("online.consume", ctx, t0_wall, dur,
+                               tags={"shard": shard, "shared": True})
         self.shards_consumed += 1
         _SHARDS_CONSUMED.inc()
         return n
@@ -335,9 +385,13 @@ class OnlineTrainer:
                         os.path.basename(path))
                     continue
                 # consumed shards step aside (audit trail kept), so the
-                # scan and the lag gauge only ever see fresh work
+                # scan and the lag gauge only ever see fresh work; the
+                # trace sidecar retires with its shard
                 try:
                     os.replace(claimed, path + ".done")
+                    side = self._sidecar_path(path)
+                    if os.path.exists(side):
+                        os.replace(side, side + ".done")
                 except OSError:
                     # our claim outlived claim_stale_s and a peer
                     # reclaimed it mid-consume: the shard may train

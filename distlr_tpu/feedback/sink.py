@@ -31,6 +31,7 @@ from distlr_tpu.feedback.spool import (
     per_row_keys,
     strip_label,
 )
+from distlr_tpu.obs import dtrace
 
 
 class FeedbackSink:
@@ -42,10 +43,15 @@ class FeedbackSink:
                  shard_records: int = 1024, tracker=None,
                  drift_block: int = 512, drift_threshold: float = 0.25,
                  tick_interval_s: float = 0.5, idle_flush_s: float = 5.0,
-                 seed: int = 0):
+                 seed: int = 0, replay: bool = True):
         self.model = model
         self.spool = FeedbackSpool(spool_dir, capacity=capacity,
                                    tracker=tracker)
+        if replay:
+            # rebuild the joinable set from a previous run's journal:
+            # labels arriving across a serve restart join their real
+            # impression instead of only ever negative-sampling
+            self.spool.replay(window_s=window_s)
         self.joiner = LabelJoiner(self.spool, shard_dir, window_s=window_s,
                                   negative_rate=negative_rate,
                                   shard_records=shard_records, seed=seed)
@@ -61,23 +67,37 @@ class FeedbackSink:
 
     # -- serve-side entry points ------------------------------------------
     def scored(self, lines: list[str], rows: tuple, scores, *,
-               version: int, ids: list[str | None] | None = None) -> None:
+               version: int, ids: list[str | None] | None = None,
+               trace: tuple[int, int] | None = None) -> None:
         """Journal one scored batch.  ``lines`` are the raw request
         lines (label token optional — stripped here), ``rows`` the
         engine's encoded feature leaves for the SAME batch, ``scores``
         the served scores.  ``ids[i] = None`` auto-assigns an id; such
         rows can never be positively labeled but still feed the drift
-        detector and the negative-sampling pool."""
+        detector and the negative-sampling pool.
+
+        ``trace``: the scoring request's sampled distributed-trace
+        ``(trace_id, span_id)`` — the spool entry remembers it, so a
+        label arriving minutes later (or across a restart, via the
+        journal) continues the ORIGINATING request's trace through
+        join -> shard -> online push -> server apply."""
         now = time.time()
         keys = per_row_keys(self.model, rows)
-        for i, line in enumerate(lines):
-            rid = ids[i] if ids is not None and ids[i] is not None \
-                else f"auto-{next(self._auto_ids)}"
-            self.joiner.scored(SpoolRecord(
-                rid=str(rid), ts=now, line=strip_label(line),
-                score=float(scores[i]), version=int(version),
-                keys=keys[i] if i < len(keys) else None,
-            ))
+        ctx = (dtrace.TraceContext(trace[0], trace[1], True)
+               if trace is not None else None)
+        with dtrace.span("feedback.spool", tags={"rows": len(lines)},
+                         ctx=ctx) as sp:
+            tr = ((sp.ctx.trace_id, sp.ctx.span_id)
+                  if sp is not None and sp.ctx.sampled else None)
+            for i, line in enumerate(lines):
+                rid = ids[i] if ids is not None and ids[i] is not None \
+                    else f"auto-{next(self._auto_ids)}"
+                self.joiner.scored(SpoolRecord(
+                    rid=str(rid), ts=now, line=strip_label(line),
+                    score=float(scores[i]), version=int(version),
+                    keys=keys[i] if i < len(keys) else None,
+                    trace=tr,
+                ))
         self.drift.observe(scores)
 
     def label(self, rid: str, y: int) -> str:

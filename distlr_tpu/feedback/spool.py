@@ -72,6 +72,9 @@ class SpoolRecord:
     version: int               # engine weights version that scored it
     #: PS row keys the request touched (importance input); None = unknown
     keys: np.ndarray | None = None
+    #: distributed-trace (trace_id, span_id) of the scoring request's
+    #: feedback.spool span — the delayed-label join continues it
+    trace: tuple[int, int] | None = None
 
 
 class FeedbackSpool:
@@ -118,12 +121,25 @@ class FeedbackSpool:
         self._seg_file = None
         self.spooled = 0
         self.evicted = 0
+        self.replayed = 0
 
     # -- journal ----------------------------------------------------------
     def _seg_path(self, index: int) -> str:
         return os.path.join(self.directory, f"spool-{index:06d}.jsonl")
 
     def _journal_locked(self, rec: SpoolRecord) -> None:
+        doc = {
+            "id": rec.rid, "ts": round(rec.ts, 3), "line": rec.line,
+            "score": round(rec.score, 6), "version": rec.version,
+        }
+        if rec.trace is not None:
+            # the trace rides the journal so a label joined AFTER a
+            # restart (replay) still continues the original request's
+            # distributed trace
+            doc["trace"] = f"{rec.trace[0]:016x}/{rec.trace[1]:016x}"
+        self._journal_line_locked(doc)
+
+    def _journal_line_locked(self, doc: dict) -> None:
         if self._seg_file is None or self._seg_count >= self.segment_records:
             if self._seg_file is not None:
                 self._seg_file.close()
@@ -136,11 +152,74 @@ class FeedbackSpool:
                     os.unlink(self._seg_path(old))
                 except OSError:
                     pass  # already rotated away (restart) — bound holds
-        self._seg_file.write(json.dumps({
-            "id": rec.rid, "ts": round(rec.ts, 3), "line": rec.line,
-            "score": round(rec.score, 6), "version": rec.version,
-        }) + "\n")
+        self._seg_file.write(json.dumps(doc) + "\n")
         self._seg_count += 1
+
+    def mark_joined(self, rid: str) -> None:
+        """Journal a join tombstone: replay after a restart must not
+        resurrect an already-joined request (a re-arriving label would
+        re-emit the example and bias the positive rate)."""
+        with self._lock:
+            self._journal_line_locked({"joined": rid})
+
+    def replay(self, *, window_s: float, now: float | None = None) -> int:
+        """Rebuild the in-memory joinable set from the on-disk journal
+        (a previous run's segments): every journaled record still inside
+        the join window and not tombstoned as joined becomes joinable
+        again, so labels that arrive ACROSS a serve restart join their
+        real impression instead of negative-sampling.  Touched keys are
+        not journaled, so replayed records carry ``keys=None`` (they
+        evict first under pressure — the honest default).  Returns the
+        number of records restored."""
+        now = time.time() if now is None else now
+        cutoff = now - float(window_s)
+        segs = sorted(
+            int(m.group(1)) for name in os.listdir(self.directory)
+            if (m := re.match(r"spool-(\d+)\.jsonl$", name)))
+        recovered: dict[str, SpoolRecord] = {}
+        for idx in segs:
+            try:
+                with open(self._seg_path(idx)) as f:
+                    lines = f.read().splitlines()
+            except OSError:
+                continue
+            for raw in lines:
+                try:
+                    doc = json.loads(raw)
+                except ValueError:
+                    continue  # torn tail line of a crashed run
+                if "joined" in doc:
+                    recovered.pop(str(doc["joined"]), None)
+                    continue
+                if doc.get("ts", 0.0) < cutoff:
+                    continue
+                trace = None
+                tok = doc.get("trace")
+                if tok:
+                    try:
+                        tid, _, sid = tok.partition("/")
+                        trace = (int(tid, 16), int(sid, 16))
+                    except ValueError:
+                        pass
+                rec = SpoolRecord(
+                    rid=str(doc["id"]), ts=float(doc["ts"]),
+                    line=str(doc.get("line", "")),
+                    score=float(doc.get("score", 0.0)),
+                    version=int(doc.get("version", 0)), trace=trace)
+                recovered[rec.rid] = rec
+        with self._lock:
+            n = 0
+            for rid, rec in recovered.items():
+                if rid in self._records:
+                    continue
+                self._records[rid] = rec
+                n += 1
+                if len(self._records) > self.capacity:
+                    self._evict_one_locked()
+            self.replayed += n
+            size = len(self._records)
+        _SPOOL_SIZE.set(size)
+        return n
 
     # -- importance -------------------------------------------------------
     def _importances(self, window: list[SpoolRecord]) -> list[float]:
@@ -230,6 +309,7 @@ class FeedbackSpool:
                 "capacity": self.capacity,
                 "spooled": self.spooled,
                 "evicted": self.evicted,
+                "replayed": self.replayed,
                 "journal_segment": self._seg_index,
             }
 

@@ -60,9 +60,19 @@ def _obs_scope(cfg: Config, role: str | None = None, rank: int = 0):
     the endpoint (defaulting to an ephemeral port when no explicit
     ``--metrics-port`` was given) is published as
     ``<run_dir>/endpoints/<role>-<rank>.json`` for ``launch obs-agg``
-    to discover and federate."""
+    to discover and federate — and distributed tracing arms
+    (:mod:`distlr_tpu.obs.dtrace`): sampled spans journal to
+    ``<run_dir>/spans/<role>-<rank>.jsonl`` for ``launch trace-agg``,
+    and the flight-recorder ring dumps to ``<run_dir>/flightrec/``
+    when the aggregator trips an alert (or ``launch flightrec``
+    triggers on demand)."""
     server = None
     endpoint = None
+    if cfg.obs_run_dir and role is not None:
+        from distlr_tpu.obs import dtrace  # noqa: PLC0415
+
+        dtrace.configure(cfg.obs_run_dir.split(os.pathsep)[0], role, rank,
+                         sample=cfg.trace_sample)
     port = cfg.obs_metrics_port
     if port is None and cfg.obs_run_dir and role is not None:
         port = 0  # joining a fleet implies a scrape endpoint
@@ -87,6 +97,10 @@ def _obs_scope(cfg: Config, role: str | None = None, rank: int = 0):
 
             path = get_tracer().dump_chrome_trace(cfg.obs_trace_path)
             log.info("phase trace -> %s (load in Perfetto)", path)
+        if cfg.obs_run_dir and role is not None:
+            from distlr_tpu.obs import dtrace  # noqa: PLC0415
+
+            dtrace.flush()
         if server is not None:
             server.stop()
         if endpoint is not None:
@@ -163,6 +177,13 @@ def _add_config_flags(p: argparse.ArgumentParser) -> None:
     p.add_argument("--trace-path", dest="obs_trace_path",
                    help="write per-phase Chrome trace-event JSON here at "
                    "the end of the run (open in Perfetto)")
+    p.add_argument("--trace-sample", dest="trace_sample", type=float,
+                   help="distributed-trace sampling rate in [0, 1] "
+                   "(default 0.01): the fraction of requests/ops whose "
+                   "spans journal to <obs-run-dir>/spans/ and propagate "
+                   "across the serve protocol and the KV wire; armed only "
+                   "with --obs-run-dir.  0 = off — byte-identical KV "
+                   "wire; the in-memory flight-recorder ring still runs")
     p.add_argument("--resume", action="store_true")
     p.add_argument("--num-workers", dest="num_workers", type=int)
     p.add_argument("--num-servers", dest="num_servers", type=int)
@@ -277,6 +298,7 @@ def _config_from_args(args: argparse.Namespace) -> Config:
             "ps_optimizer", "ftrl_alpha", "ftrl_beta", "ftrl_l1", "ftrl_l2",
             "ps_compress", "ps_accum_start", "ps_accum_growth",
             "ps_accum_growth_every", "ps_accum_max", "ps_retry_adaptive",
+            "trace_sample",
         }
     }
     if isinstance(overrides.get("obs_run_dir"), list):
@@ -826,6 +848,11 @@ def cmd_ps_server(args: argparse.Namespace) -> int:
         ftrl_beta=cfg.ftrl_beta,
         ftrl_l1=cfg.ftrl_l1,
         ftrl_l2=cfg.ftrl_l2,
+        # distributed tracing (ISSUE 8): hosted server ranks journal
+        # their per-handler spans next to the Python ranks' journals
+        trace_journal_dir=(
+            os.path.join(cfg.obs_run_dir.split(os.pathsep)[0], "spans")
+            if cfg.obs_run_dir and cfg.trace_sample > 0 else None),
     )
     try:
         with _obs_scope(cfg, "ps-server", _obs_rank(args)), group:
@@ -920,6 +947,60 @@ def cmd_obs_agg(args: argparse.Namespace) -> int:
             # leave cleanly so `launch top` gets the "start obs-agg
             # first" error instead of polling a dead endpoint
             os.unlink(endpoint)
+    return 0
+
+
+def cmd_trace_agg(args: argparse.Namespace) -> int:
+    """Merge every rank's distributed-trace span journal
+    (``<run_dir>/spans/*.jsonl`` — Python processes AND native
+    ``distlr_kv_server`` ranks, one schema) into a single Chrome/
+    Perfetto trace-event file, with per-journal process naming,
+    clock-skew alignment from the kHello clock probes, and the chaos
+    proxy's fault instants interleaved.  Jax-free, like obs-agg."""
+    from distlr_tpu.obs import dtrace  # noqa: PLC0415
+
+    cfg = _config_from_args(args)
+    if not cfg.obs_run_dir:
+        print("error: trace-agg needs --obs-run-dir (the run dir whose "
+              "spans/ journals to merge; repeatable)", file=sys.stderr)
+        return 2
+    run_dirs = cfg.obs_run_dir.split(os.pathsep)
+    doc = dtrace.write_merged_trace(run_dirs, args.out)
+    meta = doc["otherData"]
+    if not meta["journals"]:
+        print(f"error: no span journals under "
+              f"{', '.join(os.path.join(d, 'spans') for d in run_dirs)} — "
+              "did the fleet run with --obs-run-dir and a non-zero "
+              "--trace-sample?", file=sys.stderr)
+        return 1
+    # Scriptable contract, like METRICS/SERVING/HOSTS.
+    print(f"TRACE {args.out} journals={len(meta['journals'])} "
+          f"spans={meta['spans']} traces={len(meta['trace_ids'])}",
+          flush=True)
+    log.info("merged trace -> %s (load in Perfetto); journals: %s",
+             args.out, ", ".join(meta["journals"]))
+    return 0
+
+
+def cmd_flightrec(args: argparse.Namespace) -> int:
+    """Trigger an on-demand flight-recorder dump: every process
+    configured on the run dir (``--obs-run-dir`` at launch) writes its
+    in-memory ring of recent spans/events — sampled or not — to
+    ``<run_dir>/flightrec/<role>-<rank>-<seq>.json`` within one watcher
+    poll (~0.25 s).  The alert-triggered path is automatic (obs-agg
+    drops the same trigger when a ``distlr_alert_*`` gauge fires); this
+    verb is the manual twin for live debugging."""
+    from distlr_tpu.obs import dtrace  # noqa: PLC0415
+
+    cfg = _config_from_args(args)
+    if not cfg.obs_run_dir:
+        print("error: flightrec needs --obs-run-dir", file=sys.stderr)
+        return 2
+    for d in cfg.obs_run_dir.split(os.pathsep):
+        path = dtrace.trigger(d, alert=args.reason)
+        print(f"FLIGHTREC {path}", flush=True)
+    log.info("flight-recorder trigger dropped; processes dump within "
+             "one watcher poll")
     return 0
 
 
@@ -1235,6 +1316,30 @@ def main(argv=None) -> int:
                    help="with --once: write the merged fleet registry here "
                    "(.json = JSON snapshot, else Prometheus text)")
     a.set_defaults(fn=cmd_obs_agg)
+
+    ta = sub.add_parser(
+        "trace-agg",
+        help="merge every rank's distributed-trace span journal "
+             "(Python + native KV servers) into one Chrome/Perfetto "
+             "trace with clock-skew alignment and chaos-fault markers",
+    )
+    _add_config_flags(ta)
+    ta.add_argument("--out", default="merged_trace.json",
+                    help="output Chrome trace-event JSON path (default "
+                    "merged_trace.json; open in Perfetto)")
+    ta.set_defaults(fn=cmd_trace_agg)
+
+    fr = sub.add_parser(
+        "flightrec",
+        help="trigger an on-demand flight-recorder dump: every process "
+             "on the run dir writes its ring of recent (even unsampled) "
+             "spans to <run_dir>/flightrec/",
+    )
+    _add_config_flags(fr)
+    fr.add_argument("--reason", default="manual",
+                    help="reason string recorded in the trigger + dumps "
+                    "(default 'manual')")
+    fr.set_defaults(fn=cmd_flightrec)
 
     t = sub.add_parser(
         "top",

@@ -20,6 +20,12 @@ Metric namespace (see README "Observability" for the full table):
 * ``distlr_fleet_*`` / ``distlr_alert_*`` — fleet-scrape meta-series
   and derived alert gauges (:mod:`distlr_tpu.obs.federate`, served by
   ``launch obs-agg`` and rendered live by ``launch top``)
+* ``distlr_trace_*``      — distributed-trace span/journal/flight-
+  recorder accounting (:mod:`distlr_tpu.obs.dtrace`, merged by
+  ``launch trace-agg``)
+
+The complete generated reference is ``docs/METRICS.md``
+(:mod:`distlr_tpu.obs.metrics_doc`; a tier-1 lint keeps it in sync).
 """
 
 from distlr_tpu.obs.exporters import (  # noqa: F401

@@ -647,6 +647,10 @@ class FleetScraper:
         self._states: dict[tuple[str, int], _RankState] = {}
         self._conflicts: dict[str, int] = {}
         self._collision_warned: set[tuple[str, int]] = set()
+        #: alert instances firing at the last scrape — the flight
+        #: recorder triggers on the not-firing -> firing EDGE only, so a
+        #: persistently-red fleet dumps once per incident, not per cycle
+        self._alerts_firing: set[str] = set()
         self._lock = threading.Lock()
         self._merged = MetricsRegistry()
         self._fleet: dict = {"updated": None, "run_dir": run_dir,
@@ -760,12 +764,39 @@ class FleetScraper:
         self._write_meta_series(reg, rank_ages)
         alerts = evaluate_alerts(reg, thresholds=self.thresholds,
                                  rank_ages=rank_ages)
+        self._maybe_trigger_flightrec(alerts)
         fleet = self._build_fleet_json(rank_ages, alerts)
         with self._lock:
             self._merged = reg
             self._fleet = fleet
         self.scrapes += 1
         return reg
+
+    def _maybe_trigger_flightrec(self, alerts: list[dict]) -> None:
+        """Drop the flight-recorder trigger into every run dir when any
+        ``distlr_alert_*`` instance TRANSITIONS to firing: each process
+        configured on the dir dumps its ring of the seconds *before*
+        the alert (:mod:`distlr_tpu.obs.dtrace`) — exactly the context
+        a sampled-only journal would have discarded."""
+        from distlr_tpu.obs import dtrace  # noqa: PLC0415  (stdlib-only)
+
+        now_firing = {
+            a["name"] + json.dumps(a.get("labels", {}), sort_keys=True)
+            for a in alerts if a.get("firing")
+        }
+        new = now_firing - self._alerts_firing
+        self._alerts_firing = now_firing
+        if not new:
+            return
+        reason = ",".join(sorted({k.split("{", 1)[0] for k in new}))
+        log.warning("alert(s) newly firing (%s); triggering flight-"
+                    "recorder dumps", reason)
+        for d in self.run_dirs:
+            try:
+                dtrace.trigger(d, alert=reason)
+            except OSError as e:
+                log.warning("flight-recorder trigger in %s failed: %s",
+                            d, e)
 
     def _rank_state_name(self, st: _RankState, age: float) -> str:
         if st.up:
@@ -882,6 +913,14 @@ class FleetScraper:
                         _snap_sum(snap, "distlr_route_shed_total"))
                     row["replicas_up"] = int(
                         _snap_sum(snap, "distlr_route_replica_up"))
+                    # end-to-end serve latency as the client sees it
+                    # (admission -> reply, retries included): `launch
+                    # top` renders these next to the windowed req/s
+                    p = _snap_hist_percentiles(
+                        snap, "distlr_route_request_seconds", (0.5, 0.99))
+                    if p is not None:
+                        row["route_p50_ms"] = round(p[0] * 1e3, 3)
+                        row["route_p99_ms"] = round(p[1] * 1e3, 3)
             ranks.append(row)
         states = [r["state"] for r in ranks]
         return {

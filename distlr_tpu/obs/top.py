@@ -28,9 +28,9 @@ _STATE_COLOR = {"up": _GREEN, "stale": _YELLOW, "down": _RED}
 
 _COLUMNS = (
     ("role", 9), ("rank", 4), ("state", 6), ("steps", 8),
-    ("samples/s", 10), ("req/s", 8), ("push/s", 8), ("step p50", 9),
-    ("pull p50/p99", 13), ("push p50/p99", 13), ("stale s", 8),
-    ("stale pushes", 13),
+    ("samples/s", 10), ("req/s", 8), ("push/s", 8), ("e2e p50/p99", 13),
+    ("step p50", 9), ("pull p50/p99", 13), ("push p50/p99", 13),
+    ("stale s", 8), ("stale pushes", 13),
 )
 
 
@@ -115,6 +115,9 @@ def _rank_cells(r: dict, rates: dict | None = None) -> list[str]:
         str(r.get("state", "?")),
         _num(r.get("steps"), "{:d}"), _num(r.get("samples_per_s")),
         _num(rr.get("req_s")), _num(rr.get("push_s")),
+        # e2e serve latency: the routing tier's admission-to-reply
+        # histogram (the number a user-facing SLO is stated against)
+        _pair(r.get("route_p50_ms"), r.get("route_p99_ms")),
         _ms(r.get("step_p50_ms")),
         _pair(r.get("pull_p50_ms"), r.get("pull_p99_ms")),
         _pair(r.get("push_p50_ms"), r.get("push_p99_ms")),

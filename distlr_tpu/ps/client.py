@@ -16,6 +16,7 @@ import time
 
 import numpy as np
 
+from distlr_tpu.obs import dtrace
 from distlr_tpu.obs.registry import family_total, get_registry
 from distlr_tpu.ps.build import build_native, client_lib
 from distlr_tpu.utils.logging import get_logger
@@ -341,6 +342,14 @@ def _load():
         lib.kv_op_delivery_began.argtypes = [ctypes.c_void_p]
         lib.kv_negotiate_codec.restype = ctypes.c_int
         lib.kv_negotiate_codec.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.kv_negotiate_trace.restype = ctypes.c_int
+        lib.kv_negotiate_trace.argtypes = [ctypes.c_void_p]
+        lib.kv_set_trace.restype = ctypes.c_int
+        lib.kv_set_trace.argtypes = [
+            ctypes.c_void_p, ctypes.c_uint64, ctypes.c_uint64,
+        ]
+        lib.kv_clock_offset.restype = ctypes.c_double
+        lib.kv_clock_offset.argtypes = [ctypes.c_void_p, ctypes.c_uint32]
         lib.kv_last_wire_sent.restype = ctypes.c_uint64
         lib.kv_last_wire_sent.argtypes = [ctypes.c_void_p]
         lib.kv_pull_opt_state.restype = ctypes.c_int
@@ -370,7 +379,7 @@ class KVWorker:
     def __init__(self, hosts: str, dim: int, client_id: int = 0, *,
                  timeout_ms: int = 0, sync_group: bool = True,
                  retry: RetryPolicy | None = None,
-                 compress: str = "none"):
+                 compress: str = "none", trace: bool | None = None):
         from distlr_tpu.compress import CODEC_IDS  # noqa: PLC0415  (cycle-free, numpy-only)
 
         if compress not in CODEC_IDS:
@@ -403,6 +412,22 @@ class KVWorker:
         #: downgrade the operator explicitly asked to see).
         self.compress_active: str | None = None
         self._codec_id = CODEC_IDS[compress]
+        #: ask for distributed-trace stamping (ISSUE 8): when True the
+        #: kHello handshake additionally checks kCapTrace, and ops
+        #: issued under a SAMPLED dtrace context carry the 16-byte
+        #: trace trailer (plus a client-side ``ps.<op>`` span).  False
+        #: (and the ``--trace-sample 0`` path) negotiates nothing and
+        #: leaves the wire byte-identical.  The default ``None`` follows
+        #: the process: tracing armed (``dtrace.configure`` ran with a
+        #: non-zero sample) => negotiate — so trainers, serving pulls,
+        #: and the online trainer all participate without per-site
+        #: wiring, and untraced processes stay wire-identical.
+        if trace is None:
+            trace = dtrace.is_configured() and dtrace.sample_rate() > 0
+        self._trace = bool(trace)
+        #: whether every server of the group parses trace trailers
+        #: (re-derived on every reconnect, like compress_active)
+        self.trace_active = False
         # one-time sparse-gradient sanity check on the first sign push
         self._sign_zero_checked = False
         # dense-default row encoding under compression (lazy): (keys, vpk)
@@ -450,6 +475,26 @@ class KVWorker:
                 self.compress_active = active
             else:
                 self.compress_active = "none"
+            if self._trace:
+                got = lib.kv_negotiate_trace(h)
+                if got < 0:
+                    raise OSError("trace negotiation failed: "
+                                  + lib.kv_last_error(h).decode())
+                if not got and not self.trace_active:
+                    log.info(
+                        "KV group at %s predates trace propagation; "
+                        "degrading to client-only spans", self._hosts)
+                self.trace_active = got == 1
+                if self.trace_active:
+                    hosts = self._hosts.split(",")
+                    for s in range(self.num_servers):
+                        # the hello doubles as a clock probe: journal
+                        # each server's offset so trace-agg can align
+                        # its span journal onto this host's clock
+                        dtrace.record_clock(
+                            hosts[s], lib.kv_clock_offset(h, s))
+            else:
+                self.trace_active = False
         except Exception:
             lib.kv_close(h)
             raise
@@ -576,6 +621,29 @@ class KVWorker:
         semantics — see :meth:`_run_with_retry`."""
         return self._run_with_retry(op, fn, idempotent=False,
                                     on_failure=on_unknown)
+
+    @contextlib.contextmanager
+    def _trace_op(self, op: str):
+        """Distributed-trace hook around one KV op: when a SAMPLED
+        dtrace context is current and the group negotiated kCapTrace,
+        record a client-side ``ps.<op>`` span (its duration includes
+        any retry backoff — exactly the wall this op cost its caller)
+        and stamp the native handle so the request frames carry the
+        trace trailer and the server's handler span parents under this
+        one.  The stamp is one-shot and consumed by the FIRST attempt;
+        a retry re-issue goes unstamped rather than mis-attributing a
+        later op.  With no context (or trace off): zero work, zero
+        wire delta."""
+        ctx = dtrace.current()
+        if ctx is None or not ctx.sampled:
+            yield
+            return
+        with dtrace.span(f"ps.{op}", tags={"servers": self.num_servers}) as sp:
+            if self.trace_active:
+                # pre-trace groups skip the stamp: client-only spans —
+                # the mixed-fleet degradation, never a desync
+                self._lib.kv_set_trace(self._h, ctx.trace_id, sp.span_id)
+            yield
 
     def set_timeout(self, timeout_ms: int) -> None:
         """Receive timeout for every op; 0 = block forever (reference
@@ -727,7 +795,8 @@ class KVWorker:
                 _account_push_bytes(raw, self._lib.kv_last_wire_sent(self._h))
                 return ts
 
-        return self._push_with_retry("push", _issue)
+        with self._trace_op("push"):
+            return self._push_with_retry("push", _issue)
 
     def push_init(self, vals: np.ndarray, keys: np.ndarray | None = None,
                   *, force: bool = False) -> int:
@@ -789,9 +858,10 @@ class KVWorker:
         # Unknown push outcome: the gradient is lost-or-applied-once
         # (counted), and the PULL half is re-issued idempotently so the
         # caller still gets current weights for the same keys.
-        return self._push_with_retry(
-            "push_pull", _issue,
-            on_unknown=lambda: self.pull(keys=keys, vals_per_key=vpk))
+        with self._trace_op("push_pull"):
+            return self._push_with_retry(
+                "push_pull", _issue,
+                on_unknown=lambda: self.pull(keys=keys, vals_per_key=vpk))
 
     def pull(self, keys: np.ndarray | None = None,
              *, vals_per_key: int = 1) -> np.ndarray:
@@ -812,7 +882,8 @@ class KVWorker:
                 self._check(ts, "pull")
             return out
 
-        return self._with_retry("pull", _issue)
+        with self._trace_op("pull"):
+            return self._with_retry("pull", _issue)
 
     def pull_chunked(self, keys: np.ndarray | None = None, *,
                      vals_per_key: int = 1,

@@ -80,6 +80,19 @@ struct Client {
   // 0 = dense f32.  Set ONLY by kv_negotiate_codec after the kHello
   // capability handshake proved every server decodes it.
   uint8_t codec = 0;
+  // Distributed-trace capability (kv_protocol.h kTraced/kCapTrace):
+  // set ONLY by kv_negotiate_trace after every server advertised it.
+  bool trace_ok = false;
+  // One-shot trace stamp (kv_set_trace): the NEXT op's request frames
+  // carry this TraceFrame trailer, then it clears — attribution is
+  // per-op, and a stale stamp must never bleed onto an untraced op.
+  uint64_t trace_id = 0;
+  uint64_t trace_span = 0;
+  // Estimated per-server clock offset (server wall clock minus this
+  // host's, seconds; assumes a symmetric hello round trip), measured by
+  // kv_negotiate_trace — trace-agg shifts server-journal timestamps by
+  // it so cross-host spans line up.
+  std::vector<double> clock_offsets;
   // Request bytes (headers + keys + value payload, summed over servers)
   // the most recent op put on the wire — the honest numerator/
   // denominator for the push-byte compression-ratio accounting.
@@ -235,6 +248,14 @@ int RoundTrip(Client* c, Op op, const Key* keys, const float* vals,
   const uint32_t ts = c->next_ts++;
   auto slices = SliceByRange(*c, keys, n, vpk);
 
+  // One-shot trace stamp (kv_set_trace): consumed by THIS op whether it
+  // succeeds or fails — a retry re-issue goes unstamped rather than
+  // risking a stale stamp attributing a later op to the wrong trace.
+  const TraceFrame tf{c->trace_id, c->trace_span};
+  const bool traced = c->trace_ok && tf.trace_id != 0;
+  c->trace_id = 0;
+  c->trace_span = 0;
+
   // A PUSH visits EVERY server even when its key slice is empty: in sync
   // mode the server releases the BSP barrier only after num_workers
   // pushes, so a keyed (sparse) push that skipped an untouched server
@@ -260,8 +281,8 @@ int RoundTrip(Client* c, Op op, const Key* keys, const float* vals,
   const uint8_t codec =
       (is_push && c->codec && !(flags & (kInitPush | kOptState)))
           ? c->codec : 0;
-  const uint8_t send_flags =
-      static_cast<uint8_t>(flags | (codec << kCodecShift));
+  const uint8_t send_flags = static_cast<uint8_t>(
+      flags | (codec << kCodecShift) | (traced ? kTraced : 0));
   std::vector<std::vector<Key>> local_keys(c->servers.size());
   std::vector<uint8_t> coded;
   for (size_t s = 0; s < c->servers.size(); ++s) {
@@ -290,6 +311,7 @@ int RoundTrip(Client* c, Op op, const Key* keys, const float* vals,
       }
     }
     if (!WriteFull(fd, &h, sizeof(h), &c->op_delivery_began) ||
+        (traced && !WriteFull(fd, &tf, sizeof(tf), &c->op_delivery_began)) ||
         (h.num_keys && !WriteFull(fd, lk.data(), lk.size() * sizeof(Key),
                                   &c->op_delivery_began)) ||
         (is_push && h.num_keys &&
@@ -298,7 +320,8 @@ int RoundTrip(Client* c, Op op, const Key* keys, const float* vals,
       snprintf(c->err, sizeof(c->err), "send to server %zu failed", s);
       return -1;
     }
-    c->wire_sent += sizeof(h) + lk.size() * sizeof(Key) +
+    c->wire_sent += sizeof(h) + (traced ? sizeof(tf) : 0) +
+                    lk.size() * sizeof(Key) +
                     (is_push && h.num_keys ? payload_bytes : 0);
   }
   // Every request frame left intact; any failure from here on is on the
@@ -574,6 +597,105 @@ int kv_negotiate_codec(void* handle, int want) {
 // value payload over all servers) — the compression-ratio denominator.
 uint64_t kv_last_wire_sent(void* handle) {
   return static_cast<distlr::Client*>(handle)->wire_sent;
+}
+
+// --- distributed-trace negotiation (kv_protocol.h kCapTrace).  Sends a
+// kHello with the kTraced flag to every server: a trace-capable server
+// answers [caps, its wall clock] (4 Val slots); a legacy or
+// --compress=0 server answers the empty frame, read as "no
+// capabilities".  Returns 1 when EVERY server parses kTraced trailers
+// (subsequent stamped ops carry them), 0 on graceful fallback
+// (client-only spans — the mixed-fleet degradation), -1 on transport
+// failure.  The hello round trip doubles as a clock-skew probe: the
+// estimated per-server offset (server minus client, symmetric-RTT
+// assumption) is kept for kv_clock_offset.
+static double WallNowS() {
+  timeval tv{};
+  gettimeofday(&tv, nullptr);
+  return static_cast<double>(tv.tv_sec) + 1e-6 * tv.tv_usec;
+}
+
+int kv_negotiate_trace(void* handle) {
+  auto* c = static_cast<distlr::Client*>(handle);
+  c->timed_out = false;
+  if (c->poisoned) {
+    snprintf(c->err, sizeof(c->err),
+             "connection poisoned by an earlier receive failure; "
+             "reconnect (kv_connect) before issuing more ops");
+    return -1;
+  }
+  c->trace_ok = false;
+  c->clock_offsets.assign(c->servers.size(), 0.0);
+  uint64_t caps = ~0ull;
+  for (size_t s = 0; s < c->servers.size(); ++s) {
+    const uint32_t ts = c->next_ts++;
+    distlr::MsgHeader h{distlr::kMagic,
+                        static_cast<uint8_t>(distlr::Op::kHello),
+                        distlr::kTraced, 0, c->client_id, ts, 0};
+    const int fd = c->servers[s].fd;
+    const double t0 = WallNowS();
+    // kTraced on a kHello carries NO trailer: the flag here only asks
+    // the server to include its clock in the reply (kv_protocol.h).
+    if (!distlr::WriteFull(fd, &h, sizeof(h))) {
+      c->poisoned = true;
+      snprintf(c->err, sizeof(c->err), "hello to server %zu failed", s);
+      return -1;
+    }
+    distlr::MsgHeader rh{};
+    errno = 0;
+    if (!distlr::ReadFull(fd, &rh, sizeof(rh))) {
+      c->poisoned = true;
+      c->timed_out = errno == EAGAIN || errno == EWOULDBLOCK;
+      snprintf(c->err, sizeof(c->err), "no hello reply from server %zu", s);
+      return -1;
+    }
+    if (rh.magic != distlr::kMagic || !(rh.flags & distlr::kResponse) ||
+        rh.timestamp != ts ||
+        (rh.num_keys != 0 && rh.num_keys != 2 && rh.num_keys != 4)) {
+      c->poisoned = true;
+      snprintf(c->err, sizeof(c->err), "bad hello reply from server %zu", s);
+      return -1;
+    }
+    uint64_t mask = 0;  // legacy empty reply: no capabilities
+    if (rh.num_keys) {
+      double d[2] = {0.0, 0.0};
+      if (!distlr::ReadFull(fd, d, rh.num_keys * sizeof(distlr::Val))) {
+        c->poisoned = true;
+        snprintf(c->err, sizeof(c->err),
+                 "short hello reply from server %zu", s);
+        return -1;
+      }
+      mask = static_cast<uint64_t>(d[0]);
+      if (rh.num_keys == 4) {
+        const double t1 = WallNowS();
+        // symmetric-RTT estimate: the server stamped d[1] roughly at
+        // the round trip's midpoint
+        c->clock_offsets[s] = d[1] - (t0 + (t1 - t0) / 2.0);
+      }
+    }
+    caps &= mask;
+  }
+  c->trace_ok = (caps & distlr::kCapTrace) != 0;
+  return c->trace_ok ? 1 : 0;
+}
+
+// Stamp the NEXT op with a trace context (one-shot; no-op until
+// kv_negotiate_trace returned 1).  span_id should be the caller's
+// client-side op span so the server's handler span parents under it.
+int kv_set_trace(void* handle, uint64_t trace_id, uint64_t span_id) {
+  auto* c = static_cast<distlr::Client*>(handle);
+  c->trace_id = trace_id;
+  c->trace_span = span_id;
+  return 0;
+}
+
+// Estimated clock offset of one server (server wall clock minus this
+// host's, seconds) from the last kv_negotiate_trace; 0.0 when never
+// negotiated or the server predates the clock probe.
+double kv_clock_offset(void* handle, uint32_t server) {
+  auto* c = static_cast<distlr::Client*>(handle);
+  if (server >= c->clock_offsets.size()) return 0.0;
+  return c->clock_offsets[server];
 }
 
 // --- FTRL opt-state snapshot/restore (kOptState, kv_protocol.h).

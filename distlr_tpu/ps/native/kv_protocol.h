@@ -113,7 +113,33 @@ enum Flags : uint8_t {
   // valid with kInitPush on the push side — optimizer state has no
   // gradient semantics to merge.
   kOptState = 64,
+  // Bit 7: the request frame carries a 16-byte TraceFrame (trace_id,
+  // span_id — Dapper-style distributed-trace propagation) immediately
+  // after the header, BEFORE the keys.  Landed additively like
+  // vals_per_key and the codec bits: the server strips it at the
+  // parsing layer and (when --trace_journal is set) logs a per-handler
+  // span joined to the client's span — every downstream handler sees
+  // exactly the frame an untraced client would have sent.  A client may
+  // set this bit ONLY after the kHello capability handshake proved
+  // every server of the group parses it (kCapTrace): an un-negotiated
+  // trailer against a pre-trace server would desynchronize the stream
+  // (16 bytes misread as keys).  Responses never carry the trailer
+  // (Respond clears the bit), and ops with no sampled trace context
+  // are wire-byte-identical to the pre-trace protocol.
+  kTraced = 128,
 };
+
+// Trace-context trailer of a kTraced request frame.  span_id is the
+// CLIENT-side op span: the server's handler span (logged to its span
+// journal) parents itself under it, which is what stitches the
+// cross-process timeline together in `launch trace-agg`.
+#pragma pack(push, 1)
+struct TraceFrame {
+  uint64_t trace_id;
+  uint64_t span_id;
+};
+#pragma pack(pop)
+static_assert(sizeof(TraceFrame) == 16, "TraceFrame must be 16 bytes");
 
 // --- gradient wire codecs (the Flags bits 4-5 field) -------------------
 //
@@ -230,6 +256,14 @@ inline void DecodeGrad(uint8_t codec, const uint8_t* in, uint64_t n,
 // update rule would be sign-mean, not the paper's majority vote.
 constexpr uint64_t kCapCodecInt8 = 1ull << kCodecInt8;
 constexpr uint64_t kCapCodecSign = 1ull << kCodecSign;
+// The server parses kTraced frames (the 16-byte TraceFrame trailer).
+// Advertised by every capability-aware server; a kHello request that
+// itself sets kTraced additionally asks for the server's wall clock in
+// the reply (4 Val slots: [caps f64, unix-seconds f64]) — the clock-
+// skew probe `launch trace-agg` aligns cross-host span timelines with.
+// Plain kHello requests keep the 2-slot reply, so pre-trace clients
+// never see a frame shape they cannot parse.
+constexpr uint64_t kCapTrace = 1ull << 8;
 
 #pragma pack(push, 1)
 struct MsgHeader {

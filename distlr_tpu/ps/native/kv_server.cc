@@ -29,6 +29,8 @@
 //                    [--sync=1] [--last_gradient=0] [--bind_any=0]
 //                    [--optimizer=sgd] [--ftrl_alpha=0.1] [--ftrl_beta=1]
 //                    [--ftrl_l1=0] [--ftrl_l2=0] [--compress=1]
+//                    [--trace_journal=<path>]  (per-handler span JSONL for
+//                                               `launch trace-agg`)
 //
 // --optimizer selects the server-side update rule applied to incoming
 // gradients (the pluggable point the lr flag already parameterized):
@@ -76,6 +78,7 @@
 #include <netinet/in.h>
 #include <netinet/tcp.h>
 #include <sys/socket.h>
+#include <sys/time.h>
 #include <unistd.h>
 
 #include <algorithm>
@@ -121,15 +124,26 @@ struct FtrlParams {
 // signSGD aggregation path, the third peer of sgd/ftrl.
 enum class Opt : uint8_t { kSgd, kFtrl, kSign };
 
+//: span-journal entry cap (--trace_journal): a runaway sampled stream
+//: must bound disk growth; drops are counted and reported at exit.
+constexpr uint64_t kMaxTraceSpans = 200000;
+
+inline double WallNowS() {
+  timeval tv{};
+  gettimeofday(&tv, nullptr);
+  return static_cast<double>(tv.tv_sec) + 1e-6 * tv.tv_usec;
+}
+
 class KVServer {
  public:
   KVServer(int port, int num_workers, uint64_t dim, float lr, bool sync,
            bool last_gradient, bool bind_any, uint64_t max_dim,
-           Opt opt, FtrlParams ftrl_params, bool compress)
+           Opt opt, FtrlParams ftrl_params, bool compress,
+           std::string trace_journal)
       : port_(port), num_workers_(num_workers), lr_(lr), sync_(sync),
         last_gradient_(last_gradient), bind_any_(bind_any),
         max_dim_(max_dim), opt_(opt), fp_(ftrl_params),
-        compress_(compress) {
+        compress_(compress), trace_journal_(std::move(trace_journal)) {
     weights_.resize(dim, 0.0f);
     if (opt_ == Opt::kFtrl) {
       z_.resize(dim, 0.0f);
@@ -142,6 +156,16 @@ class KVServer {
     // failed write on that connection (handled by DropConnection), not
     // SIGPIPE-kill the whole server group member.
     signal(SIGPIPE, SIG_IGN);
+    // ServerGroup.stop() terminates ranks with SIGTERM; the span
+    // journal batches flushes, so the default immediate-death action
+    // would strand up to 63 buffered spans of a short run.  Flush every
+    // stream, then exit with the conventional 143.  (fflush is not
+    // strictly async-signal-safe; worst case is a torn tail line, which
+    // every journal reader already skips.)
+    signal(SIGTERM, [](int) {
+      fflush(nullptr);
+      _exit(143);
+    });
     listen_fd_ = socket(AF_INET, SOCK_STREAM, 0);
     if (listen_fd_ < 0) { perror("socket"); return 1; }
     int one = 1;
@@ -165,6 +189,23 @@ class KVServer {
     // alternative to picking a "free" port up front).
     printf("PORT %d\n", port_);
     fflush(stdout);
+    if (!trace_journal_.empty()) {
+      trace_f_ = fopen(trace_journal_.c_str(), "a");
+      if (trace_f_ == nullptr) {
+        fprintf(stderr, "[distlr_kv_server] cannot open --trace_journal=%s; "
+                "handler spans will not be recorded\n",
+                trace_journal_.c_str());
+      } else {
+        // meta line: names this journal's listen address so trace-agg
+        // can pair it with client-measured clock offsets (kHello probe)
+        fprintf(trace_f_,
+                "{\"type\":\"meta\",\"role\":\"kvserver\",\"listen\":"
+                "\"%s:%d\",\"pid\":%d,\"optimizer\":\"%s\"}\n",
+                bind_any_ ? "0.0.0.0" : "127.0.0.1", port_, getpid(),
+                OptName());
+        fflush(trace_f_);
+      }
+    }
     fprintf(stderr, "[distlr_kv_server] listening on %s:%d "
             "(workers=%d dim=%zu sync=%d optimizer=%s lr=%g compress=%d)\n",
             bind_any_ ? "0.0.0.0" : "127.0.0.1", port_, num_workers_,
@@ -190,6 +231,16 @@ class KVServer {
     }
     for (auto& t : conns) t.join();
     close(listen_fd_);
+    if (trace_f_ != nullptr) {
+      if (trace_dropped_) {
+        fprintf(stderr, "[distlr_kv_server] span journal hit its %llu-"
+                "entry cap; %llu spans dropped\n",
+                (unsigned long long)kMaxTraceSpans,
+                (unsigned long long)trace_dropped_);
+      }
+      fclose(trace_f_);
+      trace_f_ = nullptr;
+    }
     return 0;
   }
 
@@ -265,6 +316,17 @@ class KVServer {
       MsgHeader h{};
       if (!ReadFull(fd, &h, sizeof(h)) || h.magic != kMagic) break;
       const Op op = static_cast<Op>(h.op);
+      // Trace trailer (kv_protocol.h kTraced): stripped HERE, at the
+      // parsing layer — like vpk expansion and codec decode, so every
+      // handler sees exactly the frame an untraced client sent.  A
+      // kHello never carries the trailer (its kTraced flag only asks
+      // for a clock in the reply).
+      TraceFrame tf{};
+      const bool traced =
+          (h.flags & kTraced) != 0 && op != Op::kHello;
+      if (traced && !ReadFull(fd, &tf, sizeof(tf))) break;
+      const double tr_t0 = traced ? WallNowS() : 0.0;
+      double tr_decoded = tr_t0;
       // vals_per_key (kv_protocol.h): each key addresses vpk consecutive
       // flat slots starting at key*vpk.  Expansion happens HERE, at the
       // parsing layer, so every handler below (merge, barrier release,
@@ -367,16 +429,27 @@ class KVServer {
         } else if (!ReadChunked(fd, vals, opt_state ? 2 * n_flat : n_flat)) {
           break;
         }
+        if (traced) tr_decoded = WallNowS();
         if (opt_state) {
           HandleOptStatePush(fd, hf, *use_keys, vals, max_key);
         } else {
           HandlePush(fd, hf, *use_keys, vals, max_key, op == Op::kPushPull);
         }
+        if (traced) {
+          TraceLog(op == Op::kPushPull ? "kv.push_pull" : "kv.push", tf,
+                   tr_t0, tr_decoded, WallNowS(), n_flat, codec,
+                   h.client_id);
+        }
       } else if (op == Op::kPull) {
+        if (traced) tr_decoded = WallNowS();
         if (h.flags & kOptState) {
           HandleOptStatePull(fd, hf, *use_keys, max_key);
         } else {
           HandlePull(fd, hf, *use_keys, max_key);
+        }
+        if (traced) {
+          TraceLog("kv.pull", tf, tr_t0, tr_decoded, WallNowS(), n_flat,
+                   kCodecNone, h.client_id);
         }
       } else if (op == Op::kBarrier) {
         HandleBarrier(fd, h);
@@ -414,7 +487,9 @@ class KVServer {
   }
 
   void Respond(int fd, MsgHeader h, const Val* vals, uint64_t nvals) {
-    h.flags |= kResponse;
+    // responses never carry the trace trailer — drop the request's bit
+    // so the echoed header describes the frame actually sent
+    h.flags = static_cast<uint8_t>((h.flags | kResponse) & ~kTraced);
     h.num_keys = nvals;
     // Responses carry vals only (keys are implied by the request).
     WriteFull(fd, &h, sizeof(h));
@@ -438,14 +513,72 @@ class KVServer {
       Respond(fd, h, nullptr, 0);
       return;
     }
-    uint64_t mask = kCapCodecInt8;
+    uint64_t mask = kCapCodecInt8 | kCapTrace;
     // sign votes only mean majority-vote through the signsgd kernel;
     // any other optimizer would apply sign-mean, so don't offer it
     if (opt_ == Opt::kSign) mask |= kCapCodecSign;
     const double d = static_cast<double>(mask);
+    if (h.flags & kTraced) {
+      // trace-negotiating hello: include this server's wall clock (the
+      // cross-host clock-skew probe trace-agg aligns journals with)
+      double pair[2] = {d, WallNowS()};
+      Val out[4];
+      std::memcpy(out, pair, sizeof(pair));
+      Respond(fd, h, out, 4);
+      return;
+    }
     Val out[2];
     std::memcpy(out, &d, sizeof(d));
     Respond(fd, h, out, 2);
+  }
+
+  const char* OptName() const {
+    return opt_ == Opt::kFtrl ? "ftrl"
+           : opt_ == Opt::kSign ? "signsgd" : "sgd";
+  }
+
+  // --- span journal (--trace_journal): one JSONL line per traced
+  // keyed op, same schema as the Python side's span journals
+  // (distlr_tpu/obs/dtrace.py) so `launch trace-agg` parses both with
+  // one reader.  The handler span parents under the CLIENT's stamped
+  // op span; decode_us/apply_us break the recv→decode→apply(+reply)
+  // pipeline down (for a deferred sync push, "apply" is the merge —
+  // the reply is the BSP barrier and rides the releasing push).  Cap +
+  // drop counter: a runaway sampled stream bounds disk, loudly. ---
+  void TraceLog(const char* name, const TraceFrame& tf, double t0,
+                double t_decoded, double t_done, uint64_t n_flat,
+                uint8_t codec, uint32_t client_id) {
+    std::lock_guard<std::mutex> lk(trace_mu_);
+    if (trace_f_ == nullptr) return;
+    if (trace_logged_ >= kMaxTraceSpans) {
+      ++trace_dropped_;
+      return;
+    }
+    ++trace_logged_;
+    const uint64_t sid =
+        (static_cast<uint64_t>(getpid()) << 32) ^ ++trace_seq_;
+    const char* codec_name =
+        codec == kCodecInt8 ? "int8" : codec == kCodecSign ? "sign" : "none";
+    fprintf(trace_f_,
+            "{\"type\":\"span\",\"name\":\"%s\",\"trace\":\"%016llx\","
+            "\"span\":\"%016llx\",\"parent\":\"%016llx\",\"ts\":%.1f,"
+            "\"dur\":%.1f,\"tid\":%d,\"args\":{\"op\":\"%s\","
+            "\"codec\":\"%s\",\"optimizer\":\"%s\",\"sync\":%d,"
+            "\"vals\":%llu,\"client_id\":%u,\"decode_us\":%.1f,"
+            "\"apply_us\":%.1f}}\n",
+            name, (unsigned long long)tf.trace_id, (unsigned long long)sid,
+            (unsigned long long)tf.span_id, t0 * 1e6, (t_done - t0) * 1e6,
+            getpid(), name, codec_name, OptName(), sync_ ? 1 : 0,
+            (unsigned long long)n_flat, client_id,
+            (t_decoded - t0) * 1e6, (t_done - t_decoded) * 1e6);
+    // batched flush, mirroring the Python journal: a per-span fflush
+    // under trace_mu_ serializes every handler thread on disk I/O at
+    // full sampling; readers tolerate a torn/missing tail, and fclose
+    // at shutdown flushes the rest
+    if (++trace_unflushed_ >= 64) {
+      fflush(trace_f_);
+      trace_unflushed_ = 0;
+    }
   }
 
   void EnsureCapacity(Key max_key) {
@@ -825,6 +958,13 @@ class KVServer {
   Opt opt_;
   FtrlParams fp_;
   bool compress_;
+  std::string trace_journal_;
+  FILE* trace_f_ = nullptr;
+  std::mutex trace_mu_;
+  uint64_t trace_seq_ = 0;
+  uint64_t trace_logged_ = 0;
+  uint64_t trace_dropped_ = 0;
+  uint64_t trace_unflushed_ = 0;
   int listen_fd_ = -1;
   std::atomic<bool> shutdown_{false};
   std::vector<int> active_fds_;
@@ -927,8 +1067,14 @@ int main(int argc, char** argv) {
     return 2;
   }
   const bool compress = Arg(argc, argv, "compress", 1) != 0;
+  // Span journal for distributed tracing (kv_protocol.h kTraced): one
+  // JSONL file of per-handler spans, merged cross-process by
+  // `launch trace-agg`.  Empty (the default) = no journal; traced
+  // frames are still parsed either way.
+  const std::string trace_journal = ArgS(argc, argv, "trace_journal", "");
   distlr::KVServer server(port, num_workers, static_cast<uint64_t>(dim),
                           static_cast<float>(lr), sync, last_gradient,
-                          bind_any, max_dim, opt, fp, compress);
+                          bind_any, max_dim, opt, fp, compress,
+                          trace_journal);
   return server.Run();
 }

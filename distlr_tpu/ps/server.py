@@ -8,6 +8,7 @@ Replaces the reference launcher's server-spawning half
 
 from __future__ import annotations
 
+import os
 import subprocess
 import threading
 import time
@@ -84,6 +85,7 @@ class ServerGroup:
         ftrl_l1: float = 0.0,
         ftrl_l2: float = 0.0,
         compress: bool = True,
+        trace_journal_dir: str | None = None,
     ):
         if optimizer not in ("sgd", "ftrl", "signsgd"):
             raise ValueError(
@@ -135,6 +137,12 @@ class ServerGroup:
             # capabilities and answers kHello like a pre-codec binary —
             # how the graceful-fallback tests simulate an old server
             compress=bool(compress),
+            # distributed tracing (ISSUE 8): when set, each rank logs
+            # per-handler spans for trace-stamped ops to
+            # <dir>/kvserver-<rank>.jsonl — the native half of the span
+            # journals `launch trace-agg` merges.  None keeps the spawn
+            # command line byte-identical to every earlier round's.
+            trace_journal_dir=trace_journal_dir,
         )
         # serializes respawn() against stop() (supervisor thread vs
         # teardown) and marks teardown so a racing respawn becomes a no-op
@@ -191,6 +199,11 @@ class ServerGroup:
         if not self._args["compress"]:
             # non-default only: default spawns stay byte-identical
             cmd.append("--compress=0")
+        if self._args["trace_journal_dir"]:
+            d = self._args["trace_journal_dir"]
+            os.makedirs(d, exist_ok=True)
+            cmd.append("--trace_journal="
+                       + os.path.join(d, f"kvserver-{rank}.jsonl"))
         proc = subprocess.Popen(cmd, stdout=subprocess.PIPE, text=True)
         # The server prints "PORT <n>" once listening; blocking on that
         # line doubles as the readiness wait.

@@ -23,6 +23,7 @@ from concurrent.futures import Future
 
 import numpy as np
 
+from distlr_tpu.obs import dtrace
 from distlr_tpu.obs.registry import get_registry
 from distlr_tpu.obs.tracing import trace_phase
 
@@ -86,7 +87,8 @@ class MicroBatcher:
         self.max_batch_size = int(max_batch_size)
         self.max_wait_s = float(max_wait_ms) / 1000.0
         self._cv = threading.Condition()
-        self._pending: list[tuple[tuple[np.ndarray, ...], Future, float]] = []
+        #: (rows, future, enqueue time, submitter's TraceContext or None)
+        self._pending: list[tuple[tuple[np.ndarray, ...], Future, float, object]] = []
         self._pending_rows = 0
         self._closed = False
         # occupancy stats
@@ -101,7 +103,12 @@ class MicroBatcher:
         self._thread.start()
 
     # -- client side -------------------------------------------------------
-    def submit(self, rows: tuple[np.ndarray, ...]) -> Future:
+    def submit(self, rows: tuple[np.ndarray, ...], ctx=None) -> Future:
+        """``ctx``: the submitting request's
+        :class:`~distlr_tpu.obs.dtrace.TraceContext` (optional) — the
+        flush that scores this request records its ``serve.batch`` span
+        under the first sampled context it coalesced, so a distributed
+        trace reaches through the cross-connection batch boundary."""
         fut: Future = Future()
         n = rows[0].shape[0]
         if n == 0:
@@ -110,7 +117,7 @@ class MicroBatcher:
         with self._cv:
             if self._closed:
                 raise RuntimeError("batcher is closed")
-            self._pending.append((rows, fut, time.monotonic()))
+            self._pending.append((rows, fut, time.monotonic(), ctx))
             self._pending_rows += n
             self._cv.notify()
         return fut
@@ -152,8 +159,17 @@ class MicroBatcher:
             leaf_lists = [req[0] for req in taken]
             futures = [req[1] for req in taken]
             counts = [rows[0].shape[0] for rows in leaf_lists]
+            # the flush's distributed-trace span joins the FIRST sampled
+            # context it coalesced (a batch serves many traces; Perfetto
+            # still shows the queue-wait gap under each request's own
+            # serve.score span)
+            ctx = next((req[3] for req in taken
+                        if req[3] is not None and req[3].sampled), None)
             try:
-                with trace_phase("serve_score"):
+                with trace_phase("serve_score"), dtrace.span(
+                        "serve.batch",
+                        tags={"requests": len(taken), "rows": sum(counts)},
+                        ctx=ctx):
                     merged = (
                         leaf_lists[0] if len(leaf_lists) == 1
                         else _merge_leaves(leaf_lists)

@@ -36,6 +36,7 @@ import numpy as np
 
 from distlr_tpu.config import Config
 from distlr_tpu.models import get_model
+from distlr_tpu.obs import dtrace
 from distlr_tpu.obs.registry import get_registry
 from distlr_tpu.obs.tracing import trace_phase
 
@@ -202,7 +203,12 @@ class ScoringEngine:
         if n == 0:
             return np.empty(0, np.int32), np.empty(0, np.float32)
         labels_out, scores_out = [], []
-        with _SCORE_SECONDS.time():
+        # the infer span nests under the batcher's serve.batch span (the
+        # flush thread's current context); direct callers with no
+        # context pay nothing
+        with _SCORE_SECONDS.time(), dtrace.span(
+                "serve.infer",
+                tags={"rows": n, "version": self.weights_version}):
             for lo in range(0, n, self.max_batch_size):
                 chunk = tuple(leaf[lo:lo + self.max_batch_size] for leaf in rows)
                 lab, sc = self._score_bucket(chunk)

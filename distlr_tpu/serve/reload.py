@@ -40,6 +40,7 @@ import time
 
 import numpy as np
 
+from distlr_tpu.obs import dtrace
 from distlr_tpu.obs.registry import get_registry
 from distlr_tpu.utils.logging import get_logger
 
@@ -184,8 +185,15 @@ class LivePSWatcher:
             self.kv.reconnect()
             self._needs_reconnect = False
             self._check_init = True
+        # each poll is its own distributed-trace root (deterministically
+        # sampled, like requests), so the hot-reload leg — serving pulls
+        # and the servers' kv.pull handler spans — shows up on the
+        # merged timeline next to the request and feedback tracks
+        ctx = dtrace.new_trace()
         try:
-            return self._poll_inner()
+            with dtrace.use(ctx), dtrace.span(
+                    "serve.reload", tags={"hosts": self.hosts}):
+                return self._poll_inner()
         except OSError:
             self._needs_reconnect = True
             raise

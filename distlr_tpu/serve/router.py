@@ -49,6 +49,7 @@ import socketserver
 import threading
 import time
 
+from distlr_tpu.obs import dtrace
 from distlr_tpu.obs.registry import get_registry
 from distlr_tpu.utils.logging import get_logger
 
@@ -484,10 +485,48 @@ class ScoringRouter:
 
     # -- request path ------------------------------------------------------
     def handle_line(self, line: str) -> str:
+        """One routed line.  Scoring requests mint (or join, via an
+        incoming ``TRACE <tid>/<sid>`` prefix from a parent router or a
+        traced client) a distributed-trace context; sampled contexts are
+        forwarded to the chosen replica as the same additive prefix, so
+        one trace follows the request through router -> engine -> (via
+        the feedback loop) the PS wire.  LABEL lines continue their
+        REQUEST's trace at the scoring replica instead of minting one,
+        and replies never carry the prefix."""
         if line == "STATS":
             return json.dumps(self.stats())
         if line.startswith("LABEL ") or line == "LABEL":
             return self._broadcast_label(line)
+        ctx = None
+        if line.startswith("TRACE "):
+            parts = line.split(" ", 2)
+            if len(parts) != 3:
+                self._errors_c.inc()
+                return "ERR TRACE: need TRACE <trace_id>/<span_id> <line>"
+            try:
+                ctx = dtrace.parse_token(parts[1])
+            except ValueError as e:
+                self._errors_c.inc()
+                return f"ERR TRACE: {e}"
+            line = parts[2]
+        else:
+            ctx = dtrace.new_trace()  # None until dtrace.configure ran
+        if ctx is None:
+            return self._route_line(line)
+        with dtrace.use(ctx), dtrace.span(
+                "route.request",
+                tags={"listener": f"{self.host}:{self.port}"}) as sp:
+            reply = self._route_line(line)
+            if reply.startswith("ERR "):
+                sp.tags["error"] = reply.split(":", 1)[0]
+            return reply
+
+    def _route_line(self, line: str) -> str:
+        # sampled context -> the replica exchange carries the additive
+        # prefix (the replica strips it; retries resend it verbatim —
+        # scores are idempotent and the span ids do not change)
+        tok = dtrace.token()
+        wire = f"TRACE {tok} {line}" if tok else line
         t0 = time.monotonic()
         excluded: list[_Replica] = []
         last_err = "no healthy replica in rotation"
@@ -516,7 +555,7 @@ class ScoringRouter:
                 # error, not a retry
                 self._retries_c.inc()
             try:
-                reply = rep.exchange(line)
+                reply = rep.exchange(wire)
             except Exception as e:  # noqa: BLE001 — any transport failure
                 last_err = f"{type(e).__name__}: {e}"
                 shed_only = False

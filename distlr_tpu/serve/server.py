@@ -43,6 +43,7 @@ import time
 
 import numpy as np
 
+from distlr_tpu.obs import dtrace
 from distlr_tpu.obs.registry import get_registry
 from distlr_tpu.serve.batcher import MicroBatcher
 from distlr_tpu.train.metrics import MetricsLogger
@@ -155,18 +156,24 @@ class ScoringServer:
             self._active_conns.discard(conn)
 
     def _score_lines(self, lines: list[str], ids: list | None = None):
-        rows = self.engine.encode_lines(lines)
+        with dtrace.span("serve.encode", tags={"rows": len(lines)}):
+            rows = self.engine.encode_lines(lines)
         if self.hot_tracker is not None:
             self.hot_tracker.observe(self.engine.row_keys(rows))
         # version read BEFORE scoring: a swap racing the batch means the
         # journal attributes at most one version early, never one that
         # did not exist when the request entered
         version = self.engine.weights_version
-        labels, scores = self.batcher.submit(rows).result()
+        # the score span covers microbatch queue wait + the engine call;
+        # the batcher's own serve.batch span (under the same trace)
+        # isolates the engine half, so queue time reads as the gap
+        with dtrace.span("serve.score"):
+            labels, scores = self.batcher.submit(
+                rows, ctx=dtrace.current()).result()
         labels, scores = np.asarray(labels), np.asarray(scores)
         if self.feedback is not None:
             self.feedback.scored(lines, rows, scores, version=version,
-                                 ids=ids)
+                                 ids=ids, trace=dtrace.current_ids())
         return labels, scores
 
     def _handle_label(self, line: str) -> str:
@@ -183,6 +190,35 @@ class ScoringServer:
         return f"OK {self.feedback.label(parts[1], int(y))}"
 
     def handle_line(self, line: str) -> str:
+        """One request line -> one reply line.  An additive ``TRACE
+        <tid>/<sid> <line>`` prefix (minted by the router, or by any
+        traced client) joins this request to a distributed trace; a
+        server reached directly mints its own root for scoring lines.
+        Replies never carry the prefix — clients see identical bytes."""
+        ctx = None
+        if line.startswith("TRACE "):
+            parts = line.split(" ", 2)
+            if len(parts) != 3:
+                self._errors_c.inc()
+                return "ERR TRACE: need TRACE <trace_id>/<span_id> <line>"
+            try:
+                ctx = dtrace.parse_token(parts[1])
+            except ValueError as e:
+                self._errors_c.inc()
+                return f"ERR TRACE: {e}"
+            line = parts[2]
+        elif line != "STATS" and not line.startswith("LABEL"):
+            # LABEL lines continue their REQUEST's trace via the spool
+            # record instead of minting a second trace per label
+            ctx = dtrace.new_trace()
+        if ctx is None:
+            return self._handle_request(line)
+        with dtrace.use(ctx), dtrace.span(
+                "serve.request",
+                tags={"listener": f"{self.host}:{self.port}"}):
+            return self._handle_request(line)
+
+    def _handle_request(self, line: str) -> str:
         t0 = time.monotonic()
         try:
             if line == "STATS":

@@ -41,6 +41,7 @@ from distlr_tpu.data import DataIter
 from distlr_tpu.data.iterator import SparseDataIter
 from distlr_tpu.data.sharding import part_name
 from distlr_tpu.models import get_model
+from distlr_tpu.obs import dtrace
 from distlr_tpu.obs.registry import COUNT_BUCKETS, get_registry
 from distlr_tpu.obs.tracing import trace_phase
 from distlr_tpu.ps import KVWorker, RetryPolicy, ServerGroup
@@ -102,6 +103,43 @@ _ACCUM_K = get_registry().gauge(
     "(batches per push)",
     labelnames=("rank",),
 )
+
+
+class _StepTrace:
+    """StepTimer proxy that puts each ``start()``/``stop()`` bracket —
+    one training batch, in every loop variant — under its own
+    distributed-trace root (:mod:`distlr_tpu.obs.dtrace`).  Sampled
+    steps get a ``train.step`` span whose KV pulls/pushes carry the
+    trace trailer, so the server-side apply is causally linked to the
+    pull that staled it on the ``trace-agg`` timeline.  With tracing
+    unconfigured, ``new_trace()`` is None and each step pays one
+    function call."""
+
+    def __init__(self, timer: StepTimer, rank: int):
+        self._timer = timer
+        self._rank = rank
+        self._scope: contextlib.ExitStack | None = None
+
+    def start(self) -> None:
+        if self._scope is not None:  # an exception ended the last step
+            self._scope.close()
+        self._timer.start()
+        ctx = dtrace.new_trace()
+        if ctx is not None:
+            scope = contextlib.ExitStack()
+            scope.enter_context(dtrace.use(ctx))
+            scope.enter_context(
+                dtrace.span("train.step", tags={"rank": self._rank}))
+            self._scope = scope
+
+    def stop(self, n: int):
+        if self._scope is not None:
+            self._scope.close()
+            self._scope = None
+        return self._timer.stop(n)
+
+    def __getattr__(self, name):
+        return getattr(self._timer, name)
 
 
 # Below this many per-batch elements (param_dim * batch), the gradient
@@ -510,7 +548,12 @@ class PSWorker:
         # Registry-backed step accounting; "ps" counters are cumulative
         # across the process's worker threads (Hogwild runs several),
         # while each worker's throughput gauge is its own instance.
-        self.timer = StepTimer(loop="ps", instance=str(rank))
+        # _StepTrace additionally puts each start()/stop() bracket under
+        # its own distributed-trace root (sampled per cfg.trace_sample),
+        # so the step's pull/push KV ops — and their server-side apply
+        # spans — land on the merged trace-agg timeline.
+        self.timer = _StepTrace(StepTimer(loop="ps", instance=str(rank)),
+                                rank)
         self.final_weights: np.ndarray | None = None
         self._barrier_base = 0
         self._sidecar_attempt = 0
@@ -972,7 +1015,11 @@ class PSWorker:
                             self._w_cache = fut.result()
                         self._w_time = time.perf_counter()
                         self._w_pushes = self._sample_push_clock()
-                    fut = self._comm_pool().submit(self.kv.push_pull, g)
+                    # the step's dtrace context rides along explicitly:
+                    # the comm thread is a different thread, and the
+                    # fused op belongs to the step that SUBMITTED it
+                    fut = self._comm_pool().submit(
+                        self._traced_push_pull, g, dtrace.current())
                     self.timer.stop(int(mask.sum()))
                 if fut is not None:
                     with trace_phase("push"):
@@ -1121,6 +1168,13 @@ class PSWorker:
                 max_workers=1, thread_name_prefix=f"ps-comm-{self.rank}"
             )
         return self._comm
+
+    def _traced_push_pull(self, g, ctx):
+        """Comm-thread half of the pipelined fused op: re-install the
+        submitting step's distributed-trace context (thread-local, so it
+        doesn't cross the executor by itself) before issuing."""
+        with dtrace.use(ctx):
+            return self.kv.push_pull(g)
 
     def close(self, *, wait: bool = True):
         self._drop_push_probe()
@@ -1281,6 +1335,12 @@ def run_ps_local(cfg: Config, *, eval_fn=None, save=False, resume=False,
         ftrl_beta=cfg.ftrl_beta,
         ftrl_l1=cfg.ftrl_l1,
         ftrl_l2=cfg.ftrl_l2,
+        # distributed tracing (ISSUE 8): locally spawned server ranks
+        # journal their handler spans into the run dir's spans/ next to
+        # the Python ranks' journals, so `launch trace-agg` sees both
+        trace_journal_dir=(
+            os.path.join(cfg.obs_run_dir.split(os.pathsep)[0], "spans")
+            if cfg.obs_run_dir and cfg.trace_sample > 0 else None),
     )
     with contextlib.ExitStack() as stack:
         stack.enter_context(group)

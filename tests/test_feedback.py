@@ -738,3 +738,92 @@ class TestMultiWorkerClaim:
         cfg = Config(model="binary_lr", num_feature_dim=D)
         with pytest.raises(ValueError, match="worker_id"):
             OnlineTrainer(cfg, "127.0.0.1:1", str(tmp_path), worker_id=-1)
+
+
+# ---------------------------------------------------------------------------
+# spool journal replay across a serve restart (ISSUE 8 satellite)
+# ---------------------------------------------------------------------------
+
+class TestSpoolReplay:
+    def _sink(self, tmp_path, **kw):
+        kw.setdefault("model", "binary_lr")
+        kw.setdefault("window_s", 30.0)
+        kw.setdefault("shard_records", 4)
+        return FeedbackSink(str(tmp_path / "spool"), str(tmp_path / "shards"),
+                            **kw)
+
+    def _score_one(self, sink, rid, line="3:1 5:1"):
+        sink.scored([line], (np.zeros((1, D), np.float32),),
+                    np.array([0.5]), version=1, ids=[rid])
+
+    def test_label_across_restart_joins(self, tmp_path):
+        """The ROADMAP follow-on: pre-replay, a label arriving after a
+        serve restart could only negative-sample — now it joins the
+        journaled impression."""
+        sink1 = self._sink(tmp_path)
+        self._score_one(sink1, "survivor")
+        sink1.stop()
+        # "restart": a brand-new sink over the same directories
+        sink2 = self._sink(tmp_path)
+        assert sink2.spool.stats()["replayed"] == 1
+        assert sink2.label("survivor", 1) == "joined"
+        sink2.joiner.flush()
+        shards = [n for n in os.listdir(tmp_path / "shards")
+                  if n.endswith(".libsvm")]
+        assert shards, "joined example never emitted"
+        with open(tmp_path / "shards" / sorted(shards)[-1]) as f:
+            assert f.read().splitlines()[-1].startswith("1 ")
+        sink2.stop()
+
+    def test_joined_requests_not_resurrected(self, tmp_path):
+        """The join tombstone: a request joined BEFORE the restart must
+        not re-join after it (double-counted click)."""
+        sink1 = self._sink(tmp_path)
+        self._score_one(sink1, "already-joined")
+        assert sink1.label("already-joined", 1) == "joined"
+        sink1.stop()
+        sink2 = self._sink(tmp_path)
+        assert sink2.spool.stats()["replayed"] == 0
+        assert sink2.label("already-joined", 1) != "joined"
+        sink2.stop()
+
+    def test_expired_records_not_replayed(self, tmp_path):
+        sink1 = self._sink(tmp_path, window_s=0.2)
+        self._score_one(sink1, "too-old")
+        sink1.stop()
+        time.sleep(0.3)  # past the join window while "down"
+        sink2 = self._sink(tmp_path, window_s=0.2)
+        assert sink2.spool.stats()["replayed"] == 0
+        assert sink2.label("too-old", 1) == "pending"
+        sink2.stop()
+
+    def test_replay_respects_capacity(self, tmp_path):
+        sink1 = self._sink(tmp_path)
+        for i in range(8):
+            self._score_one(sink1, f"r{i}")
+        sink1.stop()
+        sink2 = self._sink(tmp_path, capacity=3)
+        st = sink2.spool.stats()
+        assert st["size"] == 3  # bounded, newest kept (FIFO eviction)
+        assert sink2.label("r7", 1) == "joined"
+        sink2.stop()
+
+    def test_replay_carries_trace_context(self, tmp_path):
+        """A label across a restart still continues the original
+        request's distributed trace (the journal carries the ids)."""
+        from distlr_tpu.obs import dtrace
+
+        try:
+            dtrace.configure(str(tmp_path / "run"), "serve", 0, sample=1.0)
+            sink1 = self._sink(tmp_path)
+            ctx = dtrace.new_trace()
+            with dtrace.use(ctx):
+                self._score_one(sink1, "traced")
+            sink1.stop()
+            sink2 = self._sink(tmp_path)
+            rec = sink2.spool._records["traced"]
+            assert rec.trace is not None
+            assert rec.trace[0] == ctx.trace_id
+            sink2.stop()
+        finally:
+            dtrace.reset_for_tests()

@@ -1,0 +1,44 @@
+"""Metrics-reference lint (ISSUE 8 satellite): ``docs/METRICS.md`` must
+name every ``distlr_*`` series the code can emit, and must not carry
+stale entries — the drift guard for a namespace that has grown every PR.
+"""
+
+import os
+
+from distlr_tpu.obs import metrics_doc
+
+
+class TestMetricsDoc:
+    def test_doc_exists(self):
+        assert os.path.exists(metrics_doc.doc_path()), (
+            "docs/METRICS.md missing — run "
+            "`python -m distlr_tpu.obs.metrics_doc`")
+
+    def test_no_drift_between_code_and_doc(self):
+        problems = metrics_doc.check()
+        assert not problems, (
+            "metric namespace drift (regenerate with `python -m "
+            "distlr_tpu.obs.metrics_doc`):\n" + "\n".join(problems))
+
+    def test_scan_sees_known_series(self):
+        """The static scan must actually find the long-lived families —
+        an over-eager filter passing test_no_drift vacuously would be
+        worse than no lint."""
+        names = {r.name for r in metrics_doc.collect_registrations()}
+        for expected in (
+            "distlr_ps_client_ops_total",
+            "distlr_train_staleness_pushes",
+            "distlr_serve_request_seconds",
+            "distlr_route_requests_total",
+            "distlr_feedback_joined_total",
+            "distlr_chaos_faults_total",
+            "distlr_trace_spans_total",
+        ):
+            assert expected in names, expected
+
+    def test_doc_table_carries_help_text(self):
+        with open(metrics_doc.doc_path()) as f:
+            text = f.read()
+        # one concrete row sanity-checks the rendering end of the
+        # generator (name + kind + meaning columns intact)
+        assert "`distlr_ps_retries_total` | counter" in text
