@@ -55,6 +55,41 @@ def resilience_snapshot() -> dict:
     }
 
 
+def _profile_top_n() -> int:
+    """Parsed DISTLR_PROFILE_TOP: frames requested, 0 = off (both
+    unset and an explicit 0/garbage — '0 disables' matches the
+    --prof-hz 0 convention)."""
+    try:
+        return max(0, int(os.environ.get("DISTLR_PROFILE_TOP", "0") or 0))
+    except ValueError:
+        return 0
+
+
+def maybe_arm_profiler() -> None:
+    """Optional continuous-profiling of the bench itself (ISSUE 9):
+    ``DISTLR_PROFILE_TOP=<N>`` (N > 0) arms the journal-less stack
+    sampler at the default rate; the row then carries a
+    ``profile_top_frames`` snapshot (see :func:`profile_snapshot`)
+    naming where the measurement's own CPU went — the cheap answer to
+    "was this row bound by the workload or by the harness"."""
+    if _profile_top_n() > 0:
+        from distlr_tpu.obs import profile  # noqa: PLC0415
+
+        profile.configure(None, "bench", 0)
+
+
+def profile_snapshot() -> dict:
+    """Top self-time frames of this process's sampler since arming —
+    empty when DISTLR_PROFILE_TOP is unset/0, so default rows are
+    byte-stable."""
+    from distlr_tpu.obs import profile  # noqa: PLC0415
+
+    n = _profile_top_n()
+    if n <= 0 or not profile.is_configured():
+        return {}
+    return {"profile_top_frames": profile.top_frames(n)}
+
+
 def compression_snapshot() -> dict:
     """Push-byte accounting of THIS process's registry at read time
     (ISSUE 7): raw = dense-f32-equivalent bytes of every delivered
@@ -495,6 +530,7 @@ def main():
     # probe fallback, JSON schema, phase_breakdown — is the real path;
     # the rates are meaningless and the LKG artifact is never touched).
     smoke = "--smoke" in sys.argv
+    maybe_arm_profiler()
     # Probe the default backend in a killable subprocess: a wedged TPU
     # tunnel hangs forever on any in-process backend touch (round-1
     # BENCH artifact was lost to exactly this).  The probe retries across
@@ -634,6 +670,9 @@ def main():
         # headline, meaningful for any sub-run that pushed to a PS —
         # benchmarks/bench_compress.py measures the codecs head-on
         **compression_snapshot(),
+        # optional DISTLR_PROFILE_TOP=<N> sampler snapshot: top self-
+        # time frames of the bench process itself (absent by default)
+        **profile_snapshot(),
         **subs,
     }
     if smoke:

@@ -38,6 +38,15 @@ from distlr_tpu.obs.tracing import get_tracer, trace_phase  # noqa: E402
 from distlr_tpu.utils.backend import force_cpu, probe_default_backend_ex  # noqa: E402
 
 
+def _profile_snapshot() -> dict:
+    """Optional DISTLR_PROFILE_TOP=<N> sampler snapshot (see
+    bench.profile_snapshot); empty — and the row byte-stable — when
+    unset."""
+    from bench import profile_snapshot  # noqa: PLC0415
+
+    return profile_snapshot()
+
+
 def _resilience() -> dict:
     """Fault-cost counter snapshot (see bench.resilience_snapshot): a
     serve bench that fought a flaky PS link records what it cost."""
@@ -227,6 +236,9 @@ def main() -> int:
     args = ap.parse_args()
     if args.smoke:
         args.quick = True
+    from bench import maybe_arm_profiler  # noqa: PLC0415
+
+    maybe_arm_profiler()
 
     status, probed = probe_default_backend_ex(
         float(os.environ.get("DISTLR_PROBE_TIMEOUT_S", "60")))
@@ -312,6 +324,7 @@ def main() -> int:
         # disjoint partition of wall clock.
         "phase_breakdown": {"phases": phases},
         "resilience": _resilience(),
+        **_profile_snapshot(),
         **subs,
     }
     print(json.dumps(row))

@@ -91,6 +91,19 @@ DISTLR_METRICS_SNAPSHOT="benchmarks/capture_logs/fleet/snapshots/trace-0.json" \
   && echo "bench_trace ok (merged trace -> benchmarks/capture_logs/trace/merged_trace.json)" \
   || echo "bench_trace failed (non-fatal; artifact not refreshed)"
 
+echo "== bench_prof.py (continuous profiling: overhead + fleet flamegraph; best-effort) =="
+# Continuous-profiling row (ISSUE 9): serve-QPS overhead at the default
+# ~19 Hz sampling rate (<3% bound, drift-cancelling paired slices),
+# plus ONE merged fleet flamegraph of a real multi-process closed loop
+# (router + engine + online trainer + native kv_server CPU windows as
+# separate tracks) banked under capture_logs/prof/ — collapsed-stack
+# for flamegraph.pl/inferno and a speedscope.app JSON.
+DISTLR_METRICS_SNAPSHOT="benchmarks/capture_logs/fleet/snapshots/prof-0.json" \
+  timeout 900 python -u benchmarks/bench_prof.py \
+  > benchmarks/capture_logs/bench_prof.json \
+  && echo "bench_prof ok (fleet flamegraph -> benchmarks/capture_logs/prof/fleet_profile.collapsed)" \
+  || echo "bench_prof failed (non-fatal; artifact not refreshed)"
+
 echo "== bank the fleet metrics snapshot (merged view; best-effort) =="
 # Federates every snapshot banked into the window's fleet dir (today:
 # bench.py; any --obs-run-dir'd process that joins a future window rides

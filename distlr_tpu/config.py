@@ -268,6 +268,17 @@ class Config:
     # and leaves the KV wire byte-identical to the pre-trace protocol.
     # Unsampled traces still feed the in-memory flight-recorder ring.
     trace_sample: float = 0.01
+    # Continuous profiling (distlr_tpu.obs.profile): always-on sampling
+    # rate of the per-process stack profiler, armed (like tracing) only
+    # when obs_run_dir is set — windows journal to
+    # <obs_run_dir>/profiles/<role>-<rank>.jsonl, and an alert edge (or
+    # `launch profrec`) bursts the rate once per incident.  0 disables
+    # the profiler entirely.  ~19 Hz is deliberately off the round
+    # numbers: a rate sharing a period with a 10/20/100 Hz loop would
+    # alias and report one frame as the whole workload.
+    prof_hz: float = 19.0
+    # Seconds of aggregation per journaled profile window.
+    prof_window_s: float = 10.0
 
     # ---- serving (launch serve / distlr_tpu.serve) ----
     # Port 0 = OS-assigned ephemeral (announced as "SERVING host:port").
@@ -537,6 +548,13 @@ class Config:
         if not 0.0 <= self.trace_sample <= 1.0:
             raise ValueError(
                 f"trace_sample must be in [0, 1], got {self.trace_sample}")
+        if self.prof_hz < 0:
+            raise ValueError(
+                f"prof_hz must be >= 0 (0 = profiler off), got "
+                f"{self.prof_hz}")
+        if self.prof_window_s <= 0:
+            raise ValueError(
+                f"prof_window_s must be positive, got {self.prof_window_s}")
         if not 0 <= self.route_port < 1 << 16:
             raise ValueError(
                 f"route_port must be in [0, 65536), got {self.route_port}")

@@ -68,11 +68,24 @@ def _obs_scope(cfg: Config, role: str | None = None, rank: int = 0):
     triggers on demand)."""
     server = None
     endpoint = None
+    prof_armed = False
     if cfg.obs_run_dir and role is not None:
         from distlr_tpu.obs import dtrace  # noqa: PLC0415
 
         dtrace.configure(cfg.obs_run_dir.split(os.pathsep)[0], role, rank,
                          sample=cfg.trace_sample)
+        if cfg.prof_hz > 0:
+            # continuous profiling (ISSUE 9): always-on sampling at the
+            # cheap default rate, bursting once per alert incident (the
+            # flight recorder's trigger) or `launch profrec`; windows
+            # journal to <run_dir>/profiles/<role>-<rank>.jsonl for
+            # `launch prof-agg`
+            from distlr_tpu.obs import profile  # noqa: PLC0415
+
+            profile.configure(cfg.obs_run_dir.split(os.pathsep)[0], role,
+                              rank, hz=cfg.prof_hz,
+                              window_s=cfg.prof_window_s)
+            prof_armed = True
     port = cfg.obs_metrics_port
     if port is None and cfg.obs_run_dir and role is not None:
         port = 0  # joining a fleet implies a scrape endpoint
@@ -101,6 +114,10 @@ def _obs_scope(cfg: Config, role: str | None = None, rank: int = 0):
             from distlr_tpu.obs import dtrace  # noqa: PLC0415
 
             dtrace.flush()
+        if prof_armed:
+            from distlr_tpu.obs import profile  # noqa: PLC0415
+
+            profile.stop()  # flushes the final partial window
         if server is not None:
             server.stop()
         if endpoint is not None:
@@ -184,6 +201,16 @@ def _add_config_flags(p: argparse.ArgumentParser) -> None:
                    "across the serve protocol and the KV wire; armed only "
                    "with --obs-run-dir.  0 = off — byte-identical KV "
                    "wire; the in-memory flight-recorder ring still runs")
+    p.add_argument("--prof-hz", dest="prof_hz", type=float,
+                   help="continuous-profiling sampling rate (default 19; "
+                   "0 = profiler off): a daemon thread folds every "
+                   "thread's stack into <obs-run-dir>/profiles/ windows, "
+                   "tagged by the innermost dtrace span, bursting to "
+                   "high Hz once per alert incident (or `launch "
+                   "profrec`); armed only with --obs-run-dir")
+    p.add_argument("--prof-window", dest="prof_window_s", type=float,
+                   help="seconds of aggregation per journaled profile "
+                   "window (default 10)")
     p.add_argument("--resume", action="store_true")
     p.add_argument("--num-workers", dest="num_workers", type=int)
     p.add_argument("--num-servers", dest="num_servers", type=int)
@@ -298,7 +325,7 @@ def _config_from_args(args: argparse.Namespace) -> Config:
             "ps_optimizer", "ftrl_alpha", "ftrl_beta", "ftrl_l1", "ftrl_l2",
             "ps_compress", "ps_accum_start", "ps_accum_growth",
             "ps_accum_growth_every", "ps_accum_max", "ps_retry_adaptive",
-            "trace_sample",
+            "trace_sample", "prof_hz", "prof_window_s",
         }
     }
     if isinstance(overrides.get("obs_run_dir"), list):
@@ -853,6 +880,12 @@ def cmd_ps_server(args: argparse.Namespace) -> int:
         trace_journal_dir=(
             os.path.join(cfg.obs_run_dir.split(os.pathsep)[0], "spans")
             if cfg.obs_run_dir and cfg.trace_sample > 0 else None),
+        # continuous profiling (ISSUE 9): hosted ranks journal per-
+        # handler thread-CPU windows next to the Python samplers'
+        prof_journal_dir=(
+            os.path.join(cfg.obs_run_dir.split(os.pathsep)[0], "profiles")
+            if cfg.obs_run_dir and cfg.prof_hz > 0 else None),
+        prof_window_s=cfg.prof_window_s,
     )
     try:
         with _obs_scope(cfg, "ps-server", _obs_rank(args)), group:
@@ -982,6 +1015,62 @@ def cmd_trace_agg(args: argparse.Namespace) -> int:
     return 0
 
 
+def cmd_prof_agg(args: argparse.Namespace) -> int:
+    """Merge every rank's continuous-profiling journal
+    (``<run_dir>/profiles/*.jsonl`` — Python samplers AND native
+    ``distlr_kv_server`` per-handler CPU windows, one schema) into a
+    fleet-wide collapsed-stack file (``flamegraph.pl``/inferno input,
+    track-prefixed) plus a speedscope-compatible JSON with one track
+    per ``<role>-<rank>`` journal.  Jax-free, like obs-agg/trace-agg."""
+    from distlr_tpu.obs import profile  # noqa: PLC0415
+
+    cfg = _config_from_args(args)
+    if not cfg.obs_run_dir:
+        print("error: prof-agg needs --obs-run-dir (the run dir whose "
+              "profiles/ journals to merge; repeatable)", file=sys.stderr)
+        return 2
+    run_dirs = cfg.obs_run_dir.split(os.pathsep)
+    tracks = profile.merge_run_dirs(run_dirs)
+    if not tracks:
+        print(f"error: no profile journals under "
+              f"{', '.join(os.path.join(d, 'profiles') for d in run_dirs)}"
+              " — did the fleet run with --obs-run-dir and a non-zero "
+              "--prof-hz?", file=sys.stderr)
+        return 1
+    collapsed = args.out + ".collapsed"
+    speedscope = args.out + ".speedscope.json"
+    n_lines = profile.write_collapsed(tracks, collapsed)
+    profile.write_speedscope(tracks, speedscope)
+    samples = sum(t["samples"] for t in tracks.values())
+    # Scriptable contract, like METRICS/SERVING/HOSTS/TRACE.
+    print(f"PROF {args.out} tracks={len(tracks)} stacks={n_lines} "
+          f"samples={samples}", flush=True)
+    log.info("fleet profile -> %s (flamegraph.pl/inferno) + %s "
+             "(speedscope.app); tracks: %s",
+             collapsed, speedscope, ", ".join(sorted(tracks)))
+    return 0
+
+
+def cmd_profrec(args: argparse.Namespace) -> int:
+    """Trigger an on-demand profile burst: every sampler configured on
+    the run dir switches to high-Hz capture once and journals exactly
+    one burst window — the profiler-only twin of ``launch flightrec``
+    (alert incidents trigger both automatically, under one incident
+    sequence number)."""
+    from distlr_tpu.obs import profile  # noqa: PLC0415
+
+    cfg = _config_from_args(args)
+    if not cfg.obs_run_dir:
+        print("error: profrec needs --obs-run-dir", file=sys.stderr)
+        return 2
+    for d in cfg.obs_run_dir.split(os.pathsep):
+        path = profile.trigger(d, reason=args.reason)
+        print(f"PROFREC {path}", flush=True)
+    log.info("profile-burst trigger dropped; samplers burst within one "
+             "watcher poll")
+    return 0
+
+
 def cmd_flightrec(args: argparse.Namespace) -> int:
     """Trigger an on-demand flight-recorder dump: every process
     configured on the run dir (``--obs-run-dir`` at launch) writes its
@@ -1007,8 +1096,15 @@ def cmd_flightrec(args: argparse.Namespace) -> int:
 def cmd_top(args: argparse.Namespace) -> int:
     """Live ANSI dashboard over the fleet scrape (`launch top`)."""
     from distlr_tpu.obs.federate import discover_endpoints  # noqa: PLC0415
-    from distlr_tpu.obs.top import run_top  # noqa: PLC0415
+    from distlr_tpu.obs.top import run_top, run_top_replay  # noqa: PLC0415
 
+    if args.replay:
+        # offline incident scrubbing: render the aggregator's banked
+        # scrape history (<run_dir>/history.jsonl) frame by frame —
+        # the metrics-timeline complement of the flight recorder
+        color = False if args.no_color else None
+        return run_top_replay(args.replay, interval=args.replay_interval,
+                              color=color, rate_window=args.rate_window)
     url = args.fleet
     if not url:
         if not args.obs_run_dir:
@@ -1329,6 +1425,32 @@ def main(argv=None) -> int:
                     "merged_trace.json; open in Perfetto)")
     ta.set_defaults(fn=cmd_trace_agg)
 
+    pa = sub.add_parser(
+        "prof-agg",
+        help="merge every rank's continuous-profiling journal (Python "
+             "samplers + native KV-server CPU windows) into a fleet "
+             "collapsed-stack file and a speedscope JSON, one track per "
+             "rank",
+    )
+    _add_config_flags(pa)
+    pa.add_argument("--out", default="fleet_profile",
+                    help="output stem: writes <out>.collapsed "
+                    "(flamegraph.pl/inferno) and <out>.speedscope.json "
+                    "(speedscope.app); default fleet_profile")
+    pa.set_defaults(fn=cmd_prof_agg)
+
+    pr = sub.add_parser(
+        "profrec",
+        help="trigger an on-demand profile burst: every sampler on the "
+             "run dir captures at high Hz once and journals one burst "
+             "window (the profiler-only twin of flightrec)",
+    )
+    _add_config_flags(pr)
+    pr.add_argument("--reason", default="manual",
+                    help="reason string recorded in the trigger + burst "
+                    "windows (default 'manual')")
+    pr.set_defaults(fn=cmd_profrec)
+
     fr = sub.add_parser(
         "flightrec",
         help="trigger an on-demand flight-recorder dump: every process "
@@ -1360,6 +1482,15 @@ def main(argv=None) -> int:
     t.add_argument("--rate-window", dest="rate_window", type=int, default=10,
                    help="frames of history behind the windowed req/s and "
                    "push/s columns (default 10 scrapes)")
+    t.add_argument("--replay", dest="replay",
+                   help="scrub a PAST incident offline: render this "
+                   "banked scrape history (<run_dir>/history.jsonl, "
+                   "written by the aggregator) frame by frame instead of "
+                   "polling a live fleet")
+    t.add_argument("--replay-interval", dest="replay_interval", type=float,
+                   default=0.0,
+                   help="seconds between replayed frames (default 0 = "
+                   "as fast as the terminal draws)")
     t.set_defaults(fn=cmd_top)
 
     args = parser.parse_args(argv)

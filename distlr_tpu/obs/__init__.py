@@ -23,6 +23,13 @@ Metric namespace (see README "Observability" for the full table):
 * ``distlr_trace_*``      — distributed-trace span/journal/flight-
   recorder accounting (:mod:`distlr_tpu.obs.dtrace`, merged by
   ``launch trace-agg``)
+* ``distlr_prof_*``       — continuous-profiling sampler/window/burst
+  accounting (:mod:`distlr_tpu.obs.profile`, merged by
+  ``launch prof-agg``)
+* ``distlr_jax_*``        — JAX runtime introspection: jit compile
+  counts + live device-buffer bytes (:mod:`distlr_tpu.obs.jaxrt`)
+* ``distlr_kv_server_*``  — native-server runtime mirrored from the
+  kStats probe (per-handler thread-CPU seconds)
 
 The complete generated reference is ``docs/METRICS.md``
 (:mod:`distlr_tpu.obs.metrics_doc`; a tier-1 lint keeps it in sync).

@@ -82,6 +82,44 @@ _FLIGHT_DUMPS = _reg.counter(
 #: per-process span-journal entry cap (the native server uses the same
 #: figure; a runaway sampled stream bounds disk, loudly)
 MAX_JOURNAL_SPANS = 200_000
+
+#: thread ident -> stack of active span NAMES, readable from OTHER
+#: threads (a threading.local cannot be) — what lets the sampling
+#: profiler (distlr_tpu.obs.profile) tag each stack sample with the
+#: innermost dtrace span running on the sampled thread, so flamegraphs
+#: split by serve.request vs train.step vs feedback.*.  Mutated only by
+#: the owning thread (list append/pop are atomic under the GIL); readers
+#: tolerate the race.
+_ACTIVE_NAMES: dict[int, list] = {}
+
+#: callables merged into every flight-recorder dump document —
+#: ``fn(reason, seq) -> dict`` — so sibling subsystems (the continuous
+#: profiler) can cross-reference their own incident artifacts from the
+#: flight dump without dtrace importing them.
+_FLIGHT_INFO: list = []
+
+
+def active_span_name(tid: int) -> str | None:
+    """Innermost active span name on thread ``tid`` (None when that
+    thread is outside every span).  Racy by design — a profiler reading
+    a thread mid-pop may see a just-closed span; one sample of drift is
+    noise at any sane sampling rate."""
+    try:
+        return _ACTIVE_NAMES[tid][-1]
+    except (KeyError, IndexError):
+        return None
+
+
+def register_flight_info(fn) -> None:
+    """Register a provider whose dict is merged into every flight dump
+    (idempotent per function object)."""
+    if fn not in _FLIGHT_INFO:
+        _FLIGHT_INFO.append(fn)
+
+
+def unregister_flight_info(fn) -> None:
+    with contextlib.suppress(ValueError):
+        _FLIGHT_INFO.remove(fn)
 #: flight-recorder ring capacity (spans + events kept per process)
 FLIGHT_CAPACITY = 4096
 #: flight-recorder trigger filename inside <run_dir>/flightrec/
@@ -242,6 +280,8 @@ class _Tracer:
             self._journal_unflushed = 0
             self._ring.clear()
         self._tls = threading.local()
+        _ACTIVE_NAMES.clear()
+        _FLIGHT_INFO.clear()
 
     # -- context stack -----------------------------------------------------
     def _stack(self) -> list:
@@ -309,9 +349,15 @@ class _Tracer:
         sp = Span(name, child, tags)
         st = self._stack()
         st.append(child)
+        tid = threading.get_ident()
+        names = _ACTIVE_NAMES.setdefault(tid, [])
+        names.append(name)
         try:
             yield sp
         finally:
+            names.pop()
+            if not names:
+                _ACTIVE_NAMES.pop(tid, None)
             st.pop()
             self._record(sp, parent.span_id or None)
 
@@ -486,6 +532,14 @@ class _Tracer:
             "reason": reason, "dumped_at": time.time(),
             "spans": [self._ring_doc(r) for r in list(self._ring)],
         }
+        for fn in list(_FLIGHT_INFO):
+            # cross-references from sibling subsystems (e.g. the
+            # continuous profiler names the incident's burst-window
+            # journal) — a broken provider must not lose the dump
+            try:
+                doc.update(fn(reason, seq) or {})
+            except Exception:  # noqa: BLE001
+                log.exception("flight-info provider %r failed", fn)
         tmp = f"{path}.{os.getpid()}.tmp"
         try:
             with open(tmp, "w") as f:

@@ -611,9 +611,19 @@ class _RankState:
     snapshot: dict | None = None
 
 
+#: fleet scrapes kept per history segment before rotation (two segments
+#: survive: ~2x this many scrapes of incident context on disk)
+HISTORY_MAX_LINES = 2000
+
+
 class FleetScraper:
     """Polls every discovered rank endpoint and maintains the merged
     fleet registry + the structured ``/fleet.json`` summary.
+
+    Every scrape also appends its ``/fleet.json`` document to a bounded
+    ``<first run_dir>/history.jsonl`` (one rotation kept), so ``launch
+    top --replay`` can scrub a past incident offline — the metrics-
+    timeline complement of the flight recorder's span rings.
 
     Duck-types the exporter's registry protocol (``prometheus_text()``
     / ``snapshot()``), so a :class:`distlr_tpu.obs.MetricsServer` can
@@ -623,7 +633,8 @@ class FleetScraper:
 
     def __init__(self, run_dir, *, interval_s: float = 2.0,
                  stale_after_s: float = 10.0, timeout_s: float = 2.0,
-                 thresholds: AlertThresholds | None = None):
+                 thresholds: AlertThresholds | None = None,
+                 history: bool = True):
         if interval_s <= 0:
             raise ValueError(f"interval_s must be positive, got {interval_s}")
         # Aggregation of aggregators: several run dirs (a list, or one
@@ -659,6 +670,9 @@ class FleetScraper:
         self._thread: threading.Thread | None = None
         self._pool: concurrent.futures.ThreadPoolExecutor | None = None
         self.scrapes = 0
+        self.history_path = (os.path.join(self.run_dirs[0], "history.jsonl")
+                             if history else None)
+        self._history_lines = self._count_history_lines()
 
     # -- exporter protocol (what MetricsServer calls) ---------------------
     @property
@@ -766,11 +780,39 @@ class FleetScraper:
                                  rank_ages=rank_ages)
         self._maybe_trigger_flightrec(alerts)
         fleet = self._build_fleet_json(rank_ages, alerts)
+        self._append_history(fleet)
         with self._lock:
             self._merged = reg
             self._fleet = fleet
         self.scrapes += 1
         return reg
+
+    # -- scrape history (the `launch top --replay` input) -----------------
+    def _count_history_lines(self) -> int:
+        if self.history_path is None:
+            return 0
+        try:
+            with open(self.history_path) as f:
+                return sum(1 for _ in f)
+        except OSError:
+            return 0
+
+    def _append_history(self, fleet: dict) -> None:
+        if self.history_path is None:
+            return
+        try:
+            if self._history_lines >= HISTORY_MAX_LINES:
+                # bounded: one rotation kept, like the feedback spool's
+                # journal segments — an always-on aggregator must never
+                # grow a run dir without limit
+                os.replace(self.history_path, self.history_path + ".1")
+                self._history_lines = 0
+            os.makedirs(os.path.dirname(self.history_path), exist_ok=True)
+            with open(self.history_path, "a") as f:
+                f.write(json.dumps(fleet) + "\n")
+            self._history_lines += 1
+        except OSError:
+            pass  # history is an extra; a full disk must not stop scraping
 
     def _maybe_trigger_flightrec(self, alerts: list[dict]) -> None:
         """Drop the flight-recorder trigger into every run dir when any
@@ -897,6 +939,16 @@ class FleetScraper:
                                   {"op": "push", "status": "ok"})
                         + _snap_sum(snap, "distlr_ps_client_ops_total",
                                     {"op": "push_pull", "status": "ok"}))
+                # JAX runtime introspection (obs.jaxrt): recompile count
+                # and live device-buffer footprint per engine/trainer
+                # rank — `launch top` renders these next to the rates
+                if snap.get("distlr_jax_compiles_total") is not None:
+                    row["jax_compiles"] = int(
+                        _snap_sum(snap, "distlr_jax_compiles_total"))
+                if snap.get("distlr_jax_device_buffer_bytes") is not None:
+                    b = _snap_max(snap, "distlr_jax_device_buffer_bytes")
+                    if b is not None:
+                        row["device_mb"] = round(b / 1e6, 2)
                 # feedback-loop ranks: joined-label and drift signals
                 if snap.get("distlr_feedback_joined_total") is not None:
                     row["feedback_joined"] = int(

@@ -158,6 +158,9 @@ _SECTIONS = (
     ("distlr_fleet_", "Fleet federation meta-series"),
     ("distlr_alert_", "Derived alert gauges"),
     ("distlr_trace_", "Distributed tracing"),
+    ("distlr_prof_", "Continuous profiling"),
+    ("distlr_jax_", "JAX runtime introspection"),
+    ("distlr_kv_server_", "Native KV-server runtime"),
     ("distlr_phase_", "Phase tracing"),
 )
 

@@ -30,7 +30,7 @@ _COLUMNS = (
     ("role", 9), ("rank", 4), ("state", 6), ("steps", 8),
     ("samples/s", 10), ("req/s", 8), ("push/s", 8), ("e2e p50/p99", 13),
     ("step p50", 9), ("pull p50/p99", 13), ("push p50/p99", 13),
-    ("stale s", 8), ("stale pushes", 13),
+    ("stale s", 8), ("stale pushes", 13), ("compiles", 8), ("dev MB", 8),
 )
 
 
@@ -123,6 +123,10 @@ def _rank_cells(r: dict, rates: dict | None = None) -> list[str]:
         _pair(r.get("push_p50_ms"), r.get("push_p99_ms")),
         _num(r.get("staleness_s"), "{:.3f}"),
         _pair(r.get("staleness_pushes_p50"), r.get("staleness_pushes_p99")),
+        # JAX runtime introspection: recompile count + live device-
+        # buffer footprint (engine/trainer ranks; '-' for jax-free roles)
+        _num(r.get("jax_compiles"), "{:d}"),
+        _num(r.get("device_mb")),
     ]
 
 
@@ -165,6 +169,52 @@ def render_fleet(fleet: dict, *, color: bool = True,
                         "running with --obs-run-dir?)", _DIM, color))
     body = "\n".join(lines) + "\n"
     return (CLEAR + body) if clear else body
+
+
+def run_top_replay(path: str, *, interval: float = 0.0,
+                   color: bool | None = None, out=None,
+                   rate_window: int = 10) -> int:
+    """Offline incident scrubbing (``launch top --replay``): render a
+    banked scrape history (``<run_dir>/history.jsonl``, one
+    ``/fleet.json`` document per line, written by the aggregator every
+    cycle) frame by frame.  Windowed req/s / push/s columns derive from
+    the REPLAYED timestamps, so rates read as they did live.  Returns a
+    shell-style exit code."""
+    out = out or sys.stdout
+    if color is None:
+        color = bool(getattr(out, "isatty", lambda: False)())
+    tracker = RateTracker(window=max(2, rate_window))
+    n = 0
+    try:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    fleet = json.loads(line)
+                except ValueError:
+                    continue  # torn tail line: skip, keep scrubbing
+                if n and interval > 0:
+                    time.sleep(interval)
+                frame = render_fleet(fleet, color=color, clear=color,
+                                     rates=tracker.update(fleet))
+                out.write(frame)
+                out.flush()
+                n += 1
+    except OSError as e:
+        out.write(f"cannot replay {path}: {e}\n")
+        return 1
+    except KeyboardInterrupt:
+        if color:
+            out.write(_RESET + "\n")
+        return 130
+    if n == 0:
+        out.write(f"no frames in {path} — did the aggregator run with "
+                  "history enabled?\n")
+        return 1
+    out.write(f"replayed {n} frames from {path}\n")
+    return 0
 
 
 def run_top(url: str, *, interval: float = 1.0,

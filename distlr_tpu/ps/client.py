@@ -125,6 +125,11 @@ def _observe_op(op: str, *, sent=0, received: int = 0):
         _BYTES_TOTAL.labels(op=op, direction="received").inc(received)
 
 #: Order of the counters a server stats probe returns (kv_protocol.h).
+#: The ``cpu_*`` tail is the continuous-profiling extension: cumulative
+#: per-handler THREAD CPU seconds (CLOCK_THREAD_CPUTIME_ID around each
+#: dispatch) — fractional, so they stay floats in the stats dict while
+#: the v1 counters stay ints.  A pre-extension server replies only the
+#: first six; the probe reports what arrived.
 STATS_FIELDS = (
     "dim",
     "initialized",
@@ -132,6 +137,10 @@ STATS_FIELDS = (
     "barrier_waiters",
     "total_pushes",
     "total_pulls",
+    "cpu_push_seconds",
+    "cpu_pull_seconds",
+    "cpu_stats_seconds",
+    "cpu_barrier_seconds",
 )
 
 
@@ -1059,7 +1068,10 @@ class KVWorker:
                 out.shape[0],
             )
             self._check(n, "stats")
-            return dict(zip(STATS_FIELDS, (int(v) for v in out[:n])))
+            return {
+                name: float(v) if name.startswith("cpu_") else int(v)
+                for name, v in zip(STATS_FIELDS, out[:n])
+            }
 
         return self._with_retry("stats", _issue)
 

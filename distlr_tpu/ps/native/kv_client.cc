@@ -785,8 +785,13 @@ int kv_stats(void* handle, uint32_t server, double* out, uint64_t n) {
     return -1;
   }
   const uint32_t ts = c->next_ts++;
+  // aux advertises how many stats this client accepts (kv_protocol.h):
+  // an extension-aware server replies that many; an old server ignores
+  // aux and sends the six v1 counters either way.
   distlr::MsgHeader h{distlr::kMagic, static_cast<uint8_t>(distlr::Op::kStats),
-                      distlr::kNone, 0, c->client_id, ts, 0};
+                      distlr::kNone,
+                      static_cast<uint16_t>(distlr::kStatsVals),
+                      c->client_id, ts, 0};
   const int fd = c->servers[server].fd;
   if (!distlr::WriteFull(fd, &h, sizeof(h))) {
     c->poisoned = true;
@@ -806,21 +811,25 @@ int kv_stats(void* handle, uint32_t server, double* out, uint64_t n) {
     }
     return -1;
   }
+  // Additive acceptance (kv_protocol.h): a reply carries at least the
+  // six v1 counters; newer servers append more (per-handler CPU).  Any
+  // even slot count in [2*v1, 2*64] frames correctly — read what we
+  // know, drain the rest, so mixed vintages keep probing.
   if (rh.magic != distlr::kMagic || !(rh.flags & distlr::kResponse) ||
-      rh.timestamp != ts || rh.num_keys != 2 * distlr::kStatsVals) {
+      rh.timestamp != ts || rh.num_keys < 2 * distlr::kStatsValsV1 ||
+      rh.num_keys % 2 != 0 || rh.num_keys > 2 * 64) {
     c->poisoned = true;
     snprintf(c->err, sizeof(c->err), "bad stats response from server %u", server);
     return -1;
   }
-  double stats[distlr::kStatsVals];
-  static_assert(sizeof(stats) == 2 * distlr::kStatsVals * sizeof(distlr::Val),
-                "stats payload layout");
-  if (!distlr::ReadFull(fd, stats, sizeof(stats))) {
+  const uint64_t avail = rh.num_keys / 2;
+  double stats[64];
+  if (!distlr::ReadFull(fd, stats, avail * sizeof(double))) {
     c->poisoned = true;
     snprintf(c->err, sizeof(c->err), "short stats response from server %u", server);
     return -1;
   }
-  const uint64_t k = std::min<uint64_t>(n, distlr::kStatsVals);
+  const uint64_t k = std::min<uint64_t>(n, avail);
   for (uint64_t i = 0; i < k; ++i) out[i] = stats[i];
   return static_cast<int>(k);
 }

@@ -66,15 +66,30 @@ enum class Op : uint8_t {
 };
 
 // kStats response payload, in order: dim, initialized,
-// pending_sync_pushes, barrier_waiters, total_pushes, total_pulls.
+// pending_sync_pushes, barrier_waiters, total_pushes, total_pulls,
+// then (since the continuous-profiling round) cumulative per-handler
+// THREAD CPU seconds — cpu_push_seconds, cpu_pull_seconds,
+// cpu_stats_seconds, cpu_barrier_seconds — measured with
+// CLOCK_THREAD_CPUTIME_ID around each handler dispatch (payload read +
+// decode + apply; blocked socket time never counts), so the Python
+// side can mirror them as distlr_kv_server_cpu_seconds{handler} and a
+// flamegraph's Python edge lines up with the C++ side.
 // Each counter is a float64 (f32 would silently freeze counters at
 // 2^24), transmitted as 2 Val slots via memcpy — so the response header
-// carries num_keys == 2 * kStatsVals.
+// carries num_keys == 2 * (stats replied).  Extension is ADDITIVE in
+// BOTH directions: the request's aux field advertises how many stats
+// the CLIENT accepts (0 from a pre-extension client — its aux was
+// always zero), and the server replies min(aux, kStatsVals) but never
+// fewer than the v1 six.  So an old client against a new server still
+// gets exactly the 6-slot reply its strict length check demands, and a
+// new client against an old server (which ignores aux and always sends
+// six) reads what arrived — mixed vintages keep probing.
 // The failure-detection hook the reference lacks entirely (SURVEY.md
 // §5.3: a dead worker deadlocks the sync barrier forever with no
 // diagnostic) — a supervisor polling kStats sees pending_sync_pushes
 // stuck below num_workers and can name the straggler condition.
-constexpr uint64_t kStatsVals = 6;
+constexpr uint64_t kStatsValsV1 = 6;
+constexpr uint64_t kStatsVals = 10;
 
 enum Flags : uint8_t {
   kNone = 0,

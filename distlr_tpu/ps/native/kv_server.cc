@@ -31,6 +31,10 @@
 //                    [--ftrl_l1=0] [--ftrl_l2=0] [--compress=1]
 //                    [--trace_journal=<path>]  (per-handler span JSONL for
 //                                               `launch trace-agg`)
+//                    [--prof_journal=<path>] [--prof_window=10]
+//                        (continuous-profiling windows: per-handler
+//                         thread-CPU deltas as "profwindow" JSONL lines,
+//                         the native half of `launch prof-agg`'s merge)
 //
 // --optimizer selects the server-side update rule applied to incoming
 // gradients (the pluggable point the lr flag already parameterized):
@@ -79,6 +83,7 @@
 #include <netinet/tcp.h>
 #include <sys/socket.h>
 #include <sys/time.h>
+#include <time.h>
 #include <unistd.h>
 
 #include <algorithm>
@@ -134,16 +139,34 @@ inline double WallNowS() {
   return static_cast<double>(tv.tv_sec) + 1e-6 * tv.tv_usec;
 }
 
+// Per-handler thread-CPU accounting slots (the kStats extension and
+// the --prof_journal windows share them).
+enum CpuSlot : int {
+  kCpuPush = 0,     // kPush / kPushPull / opt-state push
+  kCpuPull = 1,     // kPull (weights and opt-state)
+  kCpuStats = 2,    // kStats + kHello (control plane)
+  kCpuBarrier = 3,
+  kCpuSlots = 4,
+};
+
+class KVServer;
+// For the SIGTERM handler only (a capture-less lambda): the final
+// profile window must not be stranded by ServerGroup.stop()'s terminate.
+static KVServer* g_server = nullptr;
+
 class KVServer {
  public:
   KVServer(int port, int num_workers, uint64_t dim, float lr, bool sync,
            bool last_gradient, bool bind_any, uint64_t max_dim,
            Opt opt, FtrlParams ftrl_params, bool compress,
-           std::string trace_journal)
+           std::string trace_journal, std::string prof_journal,
+           double prof_window_s)
       : port_(port), num_workers_(num_workers), lr_(lr), sync_(sync),
         last_gradient_(last_gradient), bind_any_(bind_any),
         max_dim_(max_dim), opt_(opt), fp_(ftrl_params),
-        compress_(compress), trace_journal_(std::move(trace_journal)) {
+        compress_(compress), trace_journal_(std::move(trace_journal)),
+        prof_journal_(std::move(prof_journal)),
+        prof_window_s_(prof_window_s) {
     weights_.resize(dim, 0.0f);
     if (opt_ == Opt::kFtrl) {
       z_.resize(dim, 0.0f);
@@ -158,11 +181,15 @@ class KVServer {
     signal(SIGPIPE, SIG_IGN);
     // ServerGroup.stop() terminates ranks with SIGTERM; the span
     // journal batches flushes, so the default immediate-death action
-    // would strand up to 63 buffered spans of a short run.  Flush every
-    // stream, then exit with the conventional 143.  (fflush is not
-    // strictly async-signal-safe; worst case is a torn tail line, which
-    // every journal reader already skips.)
+    // would strand up to 63 buffered spans of a short run.  Write the
+    // profiler's final partial window (a short run may never see a full
+    // window elapse), flush every stream, then exit with the
+    // conventional 143.  (fprintf/fflush are not strictly
+    // async-signal-safe; worst case is a torn tail line, which every
+    // journal reader already skips.)
+    g_server = this;
     signal(SIGTERM, [](int) {
+      if (g_server != nullptr) g_server->ProfWriteWindow(true);
       fflush(nullptr);
       _exit(143);
     });
@@ -214,6 +241,18 @@ class KVServer {
             : opt_ == Opt::kSign ? "signsgd" : "sgd",
             lr_, compress_ ? 1 : 0);
     fflush(stderr);
+    std::thread prof_thread;
+    if (!prof_journal_.empty()) {
+      prof_f_ = fopen(prof_journal_.c_str(), "a");
+      if (prof_f_ == nullptr) {
+        fprintf(stderr, "[distlr_kv_server] cannot open --prof_journal=%s; "
+                "profile windows will not be recorded\n",
+                prof_journal_.c_str());
+      } else {
+        prof_t0_ = WallNowS();
+        prof_thread = std::thread(&KVServer::ProfLoop, this);
+      }
+    }
 
     std::vector<std::thread> conns;
     while (!shutdown_.load()) {
@@ -231,6 +270,12 @@ class KVServer {
     }
     for (auto& t : conns) t.join();
     close(listen_fd_);
+    if (prof_thread.joinable()) prof_thread.join();
+    if (prof_f_ != nullptr) {
+      ProfWriteWindow(true);  // final partial window of a clean shutdown
+      fclose(prof_f_);
+      prof_f_ = nullptr;
+    }
     if (trace_f_ != nullptr) {
       if (trace_dropped_) {
         fprintf(stderr, "[distlr_kv_server] span journal hit its %llu-"
@@ -316,6 +361,12 @@ class KVServer {
       MsgHeader h{};
       if (!ReadFull(fd, &h, sizeof(h)) || h.magic != kMagic) break;
       const Op op = static_cast<Op>(h.op);
+      // Per-handler thread CPU (kStats extension + --prof_journal):
+      // CLOCK_THREAD_CPUTIME_ID from here to the end of the dispatch
+      // covers payload read + decode + apply but never time blocked on
+      // the socket — the number a flamegraph's C++ edge should carry.
+      timespec cpu0{};
+      clock_gettime(CLOCK_THREAD_CPUTIME_ID, &cpu0);
       // Trace trailer (kv_protocol.h kTraced): stripped HERE, at the
       // parsing layer — like vpk expansion and codec decode, so every
       // handler sees exactly the frame an untraced client sent.  A
@@ -453,6 +504,9 @@ class KVServer {
         }
       } else if (op == Op::kBarrier) {
         HandleBarrier(fd, h);
+        // NB: a deferred sync barrier reply costs the RELEASING voter's
+        // thread the release loop; the accounting charges whoever burned
+        // the cycles, which is the truth a CPU profile wants.
       } else if (op == Op::kStats) {
         HandleStats(fd, h);
       } else if (op == Op::kHello) {
@@ -472,6 +526,32 @@ class KVServer {
         }
         break;
       }
+      AccumulateCpu(op, cpu0);
+    }
+  }
+
+  static int CpuSlotOf(Op op) {
+    switch (op) {
+      case Op::kPush:
+      case Op::kPushPull:
+        return kCpuPush;
+      case Op::kPull:
+        return kCpuPull;
+      case Op::kBarrier:
+        return kCpuBarrier;
+      default:  // kStats / kHello: the control plane
+        return kCpuStats;
+    }
+  }
+
+  void AccumulateCpu(Op op, const timespec& cpu0) {
+    timespec cpu1{};
+    clock_gettime(CLOCK_THREAD_CPUTIME_ID, &cpu1);
+    const int64_t ns = (cpu1.tv_sec - cpu0.tv_sec) * 1000000000LL +
+                       (cpu1.tv_nsec - cpu0.tv_nsec);
+    if (ns > 0) {
+      cpu_us_[CpuSlotOf(op)].fetch_add(static_cast<uint64_t>(ns) / 1000,
+                                       std::memory_order_relaxed);
     }
   }
 
@@ -887,7 +967,13 @@ class KVServer {
   // it works even while the sync barrier is wedged by a straggler. ---
   void HandleStats(int fd, const MsgHeader& h) {
     // float64 counters (f32 freezes at 2^24 pushes), shipped as 2 Val
-    // slots each — see kv_protocol.h.
+    // slots each — see kv_protocol.h.  The request's aux advertises how
+    // many stats the client accepts: a pre-extension client (aux 0)
+    // gets exactly the six v1 counters its strict length check demands.
+    const uint64_t want =
+        h.aux >= kStatsValsV1
+            ? std::min<uint64_t>(h.aux, kStatsVals)
+            : kStatsValsV1;
     double stats[kStatsVals];
     {
       std::lock_guard<std::mutex> lock(mu_);
@@ -900,10 +986,85 @@ class KVServer {
       stats[4] = static_cast<double>(n_push_);
       stats[5] = static_cast<double>(n_pull_);
     }
+    // per-handler thread-CPU seconds (the continuous-profiling
+    // extension; atomic — no mu_ needed)
+    for (int i = 0; i < kCpuSlots; ++i) {
+      stats[kStatsValsV1 + i] =
+          1e-6 * static_cast<double>(
+                     cpu_us_[i].load(std::memory_order_relaxed));
+    }
     Val out[2 * kStatsVals];
     std::memcpy(out, stats, sizeof(stats));
-    Respond(fd, h, out, 2 * kStatsVals);
+    Respond(fd, h, out, 2 * want);
   }
+
+  // --- continuous-profiling journal (--prof_journal): one JSONL
+  // "profwindow" line per --prof_window seconds, carrying the window's
+  // per-handler thread-CPU deltas as two-frame folded stacks
+  // ("kvserver;push": microseconds) — the same window schema the Python
+  // samplers journal (distlr_tpu/obs/profile.py), so `launch prof-agg`
+  // merges both with one reader and the fleet flamegraph carries the
+  // native ranks as their own tracks. ---
+  void ProfLoop() {
+    double elapsed = 0.0;
+    while (!shutdown_.load()) {
+      // 100ms slices so shutdown is prompt even with long windows
+      usleep(100 * 1000);
+      elapsed += 0.1;
+      if (elapsed + 1e-9 >= prof_window_s_) {
+        ProfWriteWindow(false);
+        elapsed = 0.0;
+      }
+    }
+  }
+
+ public:
+  // Public for the SIGTERM handler (final=true: a partial window is
+  // better than a stranded one; empty windows are skipped either way).
+  void ProfWriteWindow(bool final_flush) {
+    if (prof_f_ == nullptr) return;
+    static const char* kSlotNames[kCpuSlots] = {"push", "pull", "stats",
+                                                "barrier"};
+    uint64_t now_us[kCpuSlots];
+    uint64_t deltas[kCpuSlots];
+    uint64_t total = 0;
+    for (int i = 0; i < kCpuSlots; ++i) {
+      now_us[i] = cpu_us_[i].load(std::memory_order_relaxed);
+      // clamp, don't subtract blindly: a SIGTERM-handler flush racing
+      // the profiler thread can advance prof_last_us_ past this
+      // thread's older snapshot, and an underflowed u64 would journal
+      // as ~2^64 cpu_us of perfectly VALID JSON — dwarfing every real
+      // sample in the merged flamegraph (readers only skip torn lines)
+      deltas[i] = now_us[i] >= prof_last_us_[i]
+                      ? now_us[i] - prof_last_us_[i]
+                      : 0;
+      total += deltas[i];
+    }
+    if (total == 0) return;  // idle window: stay silent on disk
+    const double t1 = WallNowS();
+    std::string stacks;
+    for (int i = 0; i < kCpuSlots; ++i) {
+      const uint64_t d = deltas[i];
+      prof_last_us_[i] = now_us[i];
+      if (d == 0) continue;
+      char buf[96];
+      snprintf(buf, sizeof(buf), "%s\"kvserver;%s\":%llu",
+               stacks.empty() ? "" : ",", kSlotNames[i],
+               (unsigned long long)d);
+      stacks += buf;
+    }
+    fprintf(prof_f_,
+            "{\"type\":\"profwindow\",\"role\":\"kvserver\",\"pid\":%d,"
+            "\"kind\":\"%s\",\"t0\":%.3f,\"t1\":%.3f,\"unit\":\"cpu_us\","
+            "\"samples\":%llu,\"stacks\":{%s}}\n",
+            getpid(), final_flush ? "final" : "window",
+            prof_t0_ > 0.0 ? prof_t0_ : t1, t1,
+            (unsigned long long)total, stacks.c_str());
+    fflush(prof_f_);  // windows are rare; readers want them durable
+    prof_t0_ = t1;
+  }
+
+ private:
 
   // --- BARRIER: Postoffice::Barrier equivalent (src/main.cc:150),
   // counted per GENERATION id (h.aux; see kv_protocol.h).  A vote
@@ -959,6 +1120,16 @@ class KVServer {
   FtrlParams fp_;
   bool compress_;
   std::string trace_journal_;
+  std::string prof_journal_;
+  double prof_window_s_;
+  FILE* prof_f_ = nullptr;
+  // per-handler thread-CPU totals, microseconds (atomic: read by
+  // HandleStats and the profiler thread without mu_)
+  std::atomic<uint64_t> cpu_us_[kCpuSlots]{};
+  // profiler-thread-only window state (SIGTERM final flush races at
+  // worst into one torn line, which every journal reader skips)
+  uint64_t prof_last_us_[kCpuSlots] = {0, 0, 0, 0};
+  double prof_t0_ = 0.0;
   FILE* trace_f_ = nullptr;
   std::mutex trace_mu_;
   uint64_t trace_seq_ = 0;
@@ -1072,9 +1243,20 @@ int main(int argc, char** argv) {
   // `launch trace-agg`.  Empty (the default) = no journal; traced
   // frames are still parsed either way.
   const std::string trace_journal = ArgS(argc, argv, "trace_journal", "");
+  // Continuous-profiling journal (ISSUE 9): per-handler thread-CPU
+  // windows in the Python samplers' profwindow schema, merged by
+  // `launch prof-agg`.  Empty (the default) = no journal.
+  const std::string prof_journal = ArgS(argc, argv, "prof_journal", "");
+  const double prof_window = ArgF(argc, argv, "prof_window", 10.0);
+  if (prof_window <= 0.0) {
+    std::fprintf(stderr,
+                 "[distlr_kv_server] --prof_window must be positive "
+                 "(got %g)\n", prof_window);
+    return 2;
+  }
   distlr::KVServer server(port, num_workers, static_cast<uint64_t>(dim),
                           static_cast<float>(lr), sync, last_gradient,
                           bind_any, max_dim, opt, fp, compress,
-                          trace_journal);
+                          trace_journal, prof_journal, prof_window);
   return server.Run();
 }

@@ -40,6 +40,17 @@ _SERVER_STAT = _reg.gauge(
     "latest health-probe value of each native server kStats counter",
     labelnames=("rank", "stat"),
 )
+#: Per-handler thread-CPU seconds of the native server ranks, mirrored
+#: from the kStats CPU extension by every health() probe — the series a
+#: fleet flamegraph's Python edge lines up against the C++ side with.
+_SERVER_CPU = _reg.gauge(
+    "distlr_kv_server_cpu_seconds",
+    "cumulative per-handler thread CPU seconds inside the native KV "
+    "server (CLOCK_THREAD_CPUTIME_ID around each dispatch: payload "
+    "read + decode + apply, never socket wait), from the latest "
+    "health probe",
+    labelnames=("rank", "handler"),
+)
 _SUP_EVENTS = _reg.counter(
     "distlr_ps_supervisor_events_total",
     "supervisor audit-trail events (respawned/reseeded/seeded-zeros/"
@@ -86,6 +97,8 @@ class ServerGroup:
         ftrl_l2: float = 0.0,
         compress: bool = True,
         trace_journal_dir: str | None = None,
+        prof_journal_dir: str | None = None,
+        prof_window_s: float | None = None,
     ):
         if optimizer not in ("sgd", "ftrl", "signsgd"):
             raise ValueError(
@@ -143,6 +156,13 @@ class ServerGroup:
             # journals `launch trace-agg` merges.  None keeps the spawn
             # command line byte-identical to every earlier round's.
             trace_journal_dir=trace_journal_dir,
+            # continuous profiling (ISSUE 9): each rank journals per-
+            # handler thread-CPU windows to <dir>/kvserver-<rank>.jsonl
+            # in the Python samplers' profwindow schema — the native
+            # tracks of `launch prof-agg`'s fleet flamegraph.  None keeps
+            # the spawn command line byte-identical.
+            prof_journal_dir=prof_journal_dir,
+            prof_window_s=prof_window_s,
         )
         # serializes respawn() against stop() (supervisor thread vs
         # teardown) and marks teardown so a racing respawn becomes a no-op
@@ -204,6 +224,13 @@ class ServerGroup:
             os.makedirs(d, exist_ok=True)
             cmd.append("--trace_journal="
                        + os.path.join(d, f"kvserver-{rank}.jsonl"))
+        if self._args["prof_journal_dir"]:
+            d = self._args["prof_journal_dir"]
+            os.makedirs(d, exist_ok=True)
+            cmd.append("--prof_journal="
+                       + os.path.join(d, f"kvserver-{rank}.jsonl"))
+            if self._args["prof_window_s"] is not None:
+                cmd.append(f"--prof_window={self._args['prof_window_s']}")
         proc = subprocess.Popen(cmd, stdout=subprocess.PIPE, text=True)
         # The server prints "PORT <n>" once listening; blocking on that
         # line doubles as the readiness wait.
@@ -293,6 +320,11 @@ class ServerGroup:
         for rank, s in enumerate(stats):
             for name, val in s.items():
                 _SERVER_STAT.labels(rank=rank, stat=name).set(val)
+                if name.startswith("cpu_") and name.endswith("_seconds"):
+                    _SERVER_CPU.labels(
+                        rank=rank,
+                        handler=name[len("cpu_"):-len("_seconds")],
+                    ).set(val)
         return stats
 
     def global_pushes(self, *, timeout_ms: int = 2000) -> float:

@@ -36,7 +36,7 @@ import numpy as np
 
 from distlr_tpu.config import Config
 from distlr_tpu.models import get_model
-from distlr_tpu.obs import dtrace
+from distlr_tpu.obs import dtrace, jaxrt
 from distlr_tpu.obs.registry import get_registry
 from distlr_tpu.obs.tracing import trace_phase
 
@@ -97,11 +97,22 @@ _jit_score_plain = functools.partial(jax.jit, static_argnums=0)(_score_body)
 _jit_score = None
 
 
+_jit_score_probe = None
+
+
 def _resolve_jit_score():
-    global _jit_score
+    global _jit_score, _jit_score_probe
     if _jit_score is None:
-        _jit_score = (_jit_score_plain if jax.default_backend() == "cpu"
-                      else _jit_score_donating)
+        fn = (_jit_score_plain if jax.default_backend() == "cpu"
+              else _jit_score_donating)
+        # runtime introspection (obs.jaxrt): per-bucket compile counts —
+        # one probe for the process-shared scorer, so every engine's
+        # recompiles land in distlr_jax_compiles_total{site="serve.engine"}.
+        # Probe published BEFORE the fn: a second thread races past the
+        # None check only once _jit_score is set, by which point the
+        # probe it will tick already exists.
+        _jit_score_probe = jaxrt.JitCacheProbe(fn, "serve.engine")
+        _jit_score = fn
     return _jit_score
 
 
@@ -153,7 +164,12 @@ class ScoringEngine:
                 self._weights = w
                 self.weights_version += 1
                 _WEIGHT_SWAPS.inc()
-                return self.weights_version
+                version = self.weights_version
+        # the swap is when device residency actually changes (the old
+        # table frees once in-flight scores release it) — refresh the
+        # buffer gauges outside the lock
+        jaxrt.maybe_sample_device_bytes()
+        return version
 
     @property
     def has_weights(self) -> bool:
@@ -184,6 +200,9 @@ class ScoringEngine:
         w = self._weights  # atomic reference read — the swap point
         labels, scores = _resolve_jit_score()(
             self.model, w, self._pad_rows(rows, bucket))
+        # attribute any cache growth to the bucket that just ran — the
+        # "bucket B keeps recompiling" signal `launch top` surfaces
+        _jit_score_probe.tick(bucket)
         return np.asarray(labels)[:n], np.asarray(scores)[:n]
 
     def score(self, rows: tuple[np.ndarray, ...]) -> tuple[np.ndarray, np.ndarray]:

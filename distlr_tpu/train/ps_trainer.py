@@ -41,7 +41,7 @@ from distlr_tpu.data import DataIter
 from distlr_tpu.data.iterator import SparseDataIter
 from distlr_tpu.data.sharding import part_name
 from distlr_tpu.models import get_model
-from distlr_tpu.obs import dtrace
+from distlr_tpu.obs import dtrace, jaxrt
 from distlr_tpu.obs.registry import COUNT_BUCKETS, get_registry
 from distlr_tpu.obs.tracing import trace_phase
 from distlr_tpu.ps import KVWorker, RetryPolicy, ServerGroup
@@ -544,6 +544,15 @@ class PSWorker:
         else:
             self._grad_fn = _compiled_fns(self.model, cfg.l2_c, bool(cfg.l2_scale_by_batch))
             self._acc_fn = _compiled_acc(self.model)
+        # runtime introspection (obs.jaxrt): compile-cache probes for the
+        # jitted dense step/eval fns (sparse/blocked paths run numpy host
+        # math — nothing to probe); ticked at each epoch end
+        self._jit_probes = [
+            jaxrt.JitCacheProbe(fn, site)
+            for fn, site in ((self._grad_fn, "train.ps.grad"),
+                             (self._acc_fn, "train.ps.eval"))
+            if fn is not None
+        ]
         self.metrics = MetricsLogger()
         # Registry-backed step accounting; "ps" counters are cumulative
         # across the process's worker threads (Hogwild runs several),
@@ -1026,6 +1035,12 @@ class PSWorker:
                         self._w_cache = fut.result()
                     self._w_time = time.perf_counter()
                     self._w_pushes = self._sample_push_clock()
+            # runtime introspection (obs.jaxrt): fold this epoch's jit
+            # cache growth into distlr_jax_compiles_total and refresh
+            # the live device-buffer gauges (walk throttled process-wide)
+            for probe in self._jit_probes:
+                probe.tick()
+            jaxrt.maybe_sample_device_bytes()
             if (
                 self.rank == 0
                 and test is not None
@@ -1341,6 +1356,13 @@ def run_ps_local(cfg: Config, *, eval_fn=None, save=False, resume=False,
         trace_journal_dir=(
             os.path.join(cfg.obs_run_dir.split(os.pathsep)[0], "spans")
             if cfg.obs_run_dir and cfg.trace_sample > 0 else None),
+        # continuous profiling (ISSUE 9): locally spawned ranks journal
+        # per-handler thread-CPU windows into the run dir's profiles/
+        # next to the Python samplers', so `launch prof-agg` sees both
+        prof_journal_dir=(
+            os.path.join(cfg.obs_run_dir.split(os.pathsep)[0], "profiles")
+            if cfg.obs_run_dir and cfg.prof_hz > 0 else None),
+        prof_window_s=cfg.prof_window_s,
     )
     with contextlib.ExitStack() as stack:
         stack.enter_context(group)

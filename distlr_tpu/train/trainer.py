@@ -31,6 +31,7 @@ from distlr_tpu.config import Config
 from distlr_tpu.data import DataIter, parse_libsvm_file
 from distlr_tpu.data.sharding import part_name
 from distlr_tpu.models import get_model
+from distlr_tpu.obs import jaxrt
 from distlr_tpu.obs.tracing import trace_phase
 from distlr_tpu.parallel import (
     make_eval_step,
@@ -369,6 +370,14 @@ class Trainer:
             self._shard_weights = lambda w: jax.device_put(
                 w, jax.sharding.NamedSharding(self.mesh, jax.sharding.PartitionSpec())
             )
+        # runtime introspection (obs.jaxrt): compile-cache probes for the
+        # jitted step closures, ticked per epoch in fit() — a re-build
+        # (load-time quantization) re-baselines them
+        self._jit_probes = [
+            jaxrt.JitCacheProbe(fn, site)
+            for fn, site in ((self.train_step, "train.sync.step"),
+                             (self.eval_step, "train.sync.eval"))
+        ]
 
     def _quantize_features(self) -> None:
         """Convert loaded dense feature storage to ``cfg.feature_dtype``.
@@ -586,6 +595,11 @@ class Trainer:
                 ):
                     with trace_phase("checkpoint"):
                         ckpt.save(epoch + 1, self.weights, extra={"epoch": epoch + 1})
+                # runtime introspection (obs.jaxrt): epoch-end compile-
+                # cache deltas + throttled live device-buffer gauges
+                for probe in self._jit_probes:
+                    probe.tick()
+                jaxrt.maybe_sample_device_bytes()
 
             if ckpt is not None and epochs > start_epoch and ckpt.latest_step() != epochs:
                 with trace_phase("checkpoint"):
