@@ -104,6 +104,16 @@ DISTLR_METRICS_SNAPSHOT="benchmarks/capture_logs/fleet/snapshots/prof-0.json" \
   && echo "bench_prof ok (fleet flamegraph -> benchmarks/capture_logs/prof/fleet_profile.collapsed)" \
   || echo "bench_prof failed (non-fatal; artifact not refreshed)"
 
+echo "== bench_tenant.py (multi-tenant serving: N-model QPS + shadow overhead; best-effort) =="
+# Multi-tenant serving row (ISSUE 10): per-model QPS at N hosted model
+# versions behind one router vs the 1-model baseline, and the shadow-
+# mirror overhead at a 10% fraction (<5% bound, paired on/off slices).
+DISTLR_METRICS_SNAPSHOT="benchmarks/capture_logs/fleet/snapshots/tenant-0.json" \
+  timeout 900 python -u benchmarks/bench_tenant.py \
+  > benchmarks/capture_logs/bench_tenant.json \
+  && echo "bench_tenant ok" \
+  || echo "bench_tenant failed (non-fatal; artifact not refreshed)"
+
 echo "== bank the fleet metrics snapshot (merged view; best-effort) =="
 # Federates every snapshot banked into the window's fleet dir (today:
 # bench.py; any --obs-run-dir'd process that joins a future window rides

@@ -126,12 +126,25 @@ class _Severed(Exception):
 
 
 class ChaosLink:
-    """Fault-injecting proxy for one client->server link."""
+    """Fault-injecting proxy for one client->server link.
+
+    ``protocol`` selects the client->server framing: ``"kv"`` (the
+    native MsgHeader framing — PS links) or ``"serve"`` (the serving
+    tier's newline-delimited line protocol — router/engine links, the
+    ISSUE-10 satellite: one request LINE is one op, so ``after_ops``
+    reset faults and per-op delays mean the same thing to a routed
+    scoring request that they mean to a KV push, and router failover /
+    rollout-rollback claims get the same adversarial treatment the PS
+    client got)."""
 
     def __init__(self, link: int, upstream: tuple[str, int],
-                 plan: FaultPlan, fabric: "ChaosFabric"):
+                 plan: FaultPlan, fabric: "ChaosFabric", *,
+                 protocol: str = "kv"):
+        if protocol not in ("kv", "serve"):
+            raise ValueError(f"protocol must be kv|serve, got {protocol!r}")
         self.link = link
         self.upstream = upstream
+        self.protocol = protocol
         self._plan = plan
         self._fabric = fabric
         self._delay_faults = plan.for_link(link, "delay")
@@ -291,50 +304,103 @@ class ChaosLink:
             except OSError:
                 pass
 
+    def _read_line_frame(self, sock: socket.socket,
+                         severed: threading.Event,
+                         buf: bytearray) -> bytes | None:
+        """One serve-protocol frame: a newline-terminated request line
+        (newline included — byte offsets stay exact).  ``buf`` holds
+        the cross-read remainder."""
+        while True:
+            i = buf.find(b"\n")
+            if i >= 0:
+                frame = bytes(buf[:i + 1])
+                del buf[:i + 1]
+                return frame
+            if self._stop.is_set() or severed.is_set():
+                return None
+            try:
+                chunk = sock.recv(1 << 16)
+            except socket.timeout:
+                continue
+            except OSError:
+                return None
+            if not chunk:
+                return None  # EOF mid-line: no newline = no request
+            buf += chunk
+
+    @staticmethod
+    def _line_trace_id(frame: bytes) -> int | None:
+        """trace_id of a ``TRACE <tid>/<sid> ...`` serve line (the
+        router's additive prefix), None when untraced/unparseable."""
+        if not frame.startswith(b"TRACE "):
+            return None
+        parts = frame.split(b" ", 2)
+        if len(parts) < 3:
+            return None
+        tid = parts[1].split(b"/", 1)[0]
+        try:
+            return int(tid, 16)
+        except ValueError:
+            return None
+
     def _pump_c2s(self, down: socket.socket, up: socket.socket,
                   severed: threading.Event) -> None:
         """Framed client->server pump — all op-offset faults live here."""
         link = str(self.link)
+        linebuf = bytearray()  # serve-protocol cross-read remainder
         try:
             while not (self._stop.is_set() or severed.is_set()):
-                header = self._read_exact(down, _HEADER.size, severed)
-                if header is None:
-                    break
-                magic, op, flags, aux, _cid, _ts, num_keys = \
-                    _HEADER.unpack(header)
-                if magic != _MAGIC:
-                    # not KV framing (or stream corrupted upstream of
-                    # us): degrade to a raw relay for this connection
-                    log.warning("chaos link %s: non-KV frame; relaying raw",
-                                link)
-                    up.sendall(header)
-                    self._relay_raw(down, up, severed)
-                    break
-                # trace trailer (kv_protocol.h kTraced): 16 bytes after
-                # the header on every op but kHello (whose flag only
-                # asks for a clock in the reply) — misframing it would
-                # degrade the whole stream to a raw relay, silently
-                # disabling op-offset faults for exactly the traced runs
-                trailer = b""
-                trace_id = None
-                if flags & _TRACED and op != _OP_HELLO:
-                    trailer = self._read_exact(down, _TRACE_FRAME.size,
-                                               severed)
-                    if trailer is None:
+                if self.protocol == "serve":
+                    frame = self._read_line_frame(down, severed, linebuf)
+                    if frame is None:
                         break
-                    trace_id = _TRACE_FRAME.unpack(trailer)[0]
-                trace_kv = ({"trace": f"{trace_id:016x}"}
-                            if trace_id is not None else {})
-                vpk = max(aux, 1) if op in (_OP_PUSH, _OP_PUSHPULL) else 1
-                payload_len = num_keys * 8
-                if op in (_OP_PUSH, _OP_PUSHPULL):
-                    payload_len += _push_vals_bytes(flags, num_keys * vpk)
-                payload = b""
-                if payload_len:
-                    payload = self._read_exact(down, payload_len, severed)
-                    if payload is None:
+                    tid = self._line_trace_id(frame)
+                    trace_kv = ({"trace": f"{tid:016x}"}
+                                if tid is not None else {})
+                else:
+                    header = self._read_exact(down, _HEADER.size, severed)
+                    if header is None:
                         break
-                frame = header + trailer + payload
+                    magic, op, flags, aux, _cid, _ts, num_keys = \
+                        _HEADER.unpack(header)
+                    if magic != _MAGIC:
+                        # not KV framing (or stream corrupted upstream of
+                        # us): degrade to a raw relay for this connection
+                        log.warning(
+                            "chaos link %s: non-KV frame; relaying raw",
+                            link)
+                        up.sendall(header)
+                        self._relay_raw(down, up, severed)
+                        break
+                    # trace trailer (kv_protocol.h kTraced): 16 bytes
+                    # after the header on every op but kHello (whose flag
+                    # only asks for a clock in the reply) — misframing it
+                    # would degrade the whole stream to a raw relay,
+                    # silently disabling op-offset faults for exactly the
+                    # traced runs
+                    trailer = b""
+                    trace_id = None
+                    if flags & _TRACED and op != _OP_HELLO:
+                        trailer = self._read_exact(down, _TRACE_FRAME.size,
+                                                   severed)
+                        if trailer is None:
+                            break
+                        trace_id = _TRACE_FRAME.unpack(trailer)[0]
+                    trace_kv = ({"trace": f"{trace_id:016x}"}
+                                if trace_id is not None else {})
+                    vpk = (max(aux, 1)
+                           if op in (_OP_PUSH, _OP_PUSHPULL) else 1)
+                    payload_len = num_keys * 8
+                    if op in (_OP_PUSH, _OP_PUSHPULL):
+                        payload_len += _push_vals_bytes(flags,
+                                                        num_keys * vpk)
+                    payload = b""
+                    if payload_len:
+                        payload = self._read_exact(down, payload_len,
+                                                   severed)
+                        if payload is None:
+                            break
+                    frame = header + trailer + payload
 
                 self._stall_while_partitioned(severed)
                 if self._stop.is_set() or severed.is_set():
@@ -520,9 +586,13 @@ class ChaosFabric:
     ``upstreams`` is a ``host:port,host:port`` spec (server-rank order,
     the same format ``KVWorker`` takes) or a list of ``(host, port)``
     pairs.  Windows in the plan are relative to fabric construction.
+    ``protocol``: the links' client->server framing — ``"kv"`` (native
+    PS links, the default) or ``"serve"`` (the serving tier's line
+    protocol; see :class:`ChaosLink`).
     """
 
-    def __init__(self, upstreams, plan: FaultPlan, *, seed: int | None = None):
+    def __init__(self, upstreams, plan: FaultPlan, *, seed: int | None = None,
+                 protocol: str = "kv"):
         if seed is not None:
             plan = FaultPlan(faults=plan.faults, seed=int(seed))
         self.plan = plan
@@ -552,7 +622,7 @@ class ChaosFabric:
         #: this flag instead of silently diffing a truncated log
         self.events_truncated = False
         self.started_at = time.monotonic()
-        self.links = [ChaosLink(i, up, plan, self)
+        self.links = [ChaosLink(i, up, plan, self, protocol=protocol)
                       for i, up in enumerate(pairs)]
 
     @property

@@ -332,6 +332,19 @@ class Config:
     feedback_drift_block: int = 512
     feedback_drift_threshold: float = 0.25
 
+    # ---- multi-tenant serving (ISSUE 10) ----
+    # Model id this serving process's PRIMARY engine answers as: the
+    # tenant identity MODEL/@-addressed traffic selects, and the tag
+    # feedback spool records carry so online training stays per-tenant.
+    # "default" = pre-tenant behavior (unaddressed traffic, flat shards).
+    serve_model_id: str = "default"
+    # Per-tenant token-bucket admission quotas for `launch route`:
+    # "model=rate[:burst],..." (requests/s; burst defaults to 2*rate).
+    # A tenant over budget gets an explicit "ERR SHED tenant" reply and
+    # its own distlr_tenant_shed_total counter — distinct from the
+    # capacity sheds.  None = no quotas.
+    route_quota: str | None = None
+
     # ---- serving router (launch route / distlr_tpu.serve.router) ----
     # Port 0 = OS-assigned ephemeral (announced as "ROUTING host:port").
     route_port: int = 0
@@ -555,6 +568,11 @@ class Config:
         if self.prof_window_s <= 0:
             raise ValueError(
                 f"prof_window_s must be positive, got {self.prof_window_s}")
+        if (not self.serve_model_id
+                or any(c in self.serve_model_id for c in " \t@=,+")):
+            raise ValueError(
+                "serve_model_id must be non-empty without any of "
+                f"' @=,+', got {self.serve_model_id!r}")
         if not 0 <= self.route_port < 1 << 16:
             raise ValueError(
                 f"route_port must be in [0, 65536), got {self.route_port}")

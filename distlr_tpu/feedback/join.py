@@ -103,11 +103,18 @@ class LabelJoiner:
         #: recently joined rids (bounded, insertion-ordered) — the
         #: duplicate-label detector
         self._recent: dict[str, None] = {}
-        #: pending shard lines: (text, trace ids or None, rid or None)
-        self._buffer: list[tuple[str, tuple[int, int] | None, str | None]] = []
-        # resume AFTER any shard a previous run left behind (consumed or
-        # not) — restarting at 0 would os.replace-clobber unconsumed work
-        self._shard_seq = self._next_shard_seq(out_dir)
+        #: pending shard lines PER MODEL (multi-tenant serving: each
+        #: model version's examples emit into its own shard stream under
+        #: ``<out_dir>/<model>/``, so per-tenant online trainers watch
+        #: disjoint dirs; the ``None`` stream is the pre-tenant flat
+        #: layout).  Entries: (text, trace ids or None, rid or None).
+        self._buffers: dict[
+            str | None,
+            list[tuple[str, tuple[int, int] | None, str | None]]] = {}
+        # per-model shard sequence, resumed lazily AFTER any shard a
+        # previous run left behind (consumed or not) — restarting at 0
+        # would os.replace-clobber unconsumed work
+        self._seqs: dict[str | None, int] = {}
         self.joined = 0
         self.negatives = 0
         self.shards_written = 0
@@ -185,34 +192,45 @@ class LabelJoiner:
                              tags={"delay_s": round(delay, 3), "y": int(y)},
                              ctx=ctx) as sp:
                 trace = (sp.ctx.trace_id, sp.ctx.span_id)
-        self._emit_locked(y, rec.line, trace, rid=rid)
+        self._emit_locked(y, rec.line, trace, rid=rid, model=rec.model)
 
     def _remember_locked(self, rid: str) -> None:
         self._recent[rid] = None
         while len(self._recent) > self._recent_cap:
             del self._recent[next(iter(self._recent))]
 
+    def _model_dir(self, model: str | None) -> str:
+        return (self.out_dir if model is None
+                else os.path.join(self.out_dir, model))
+
     def _emit_locked(self, y: int, line: str,
                      trace: tuple[int, int] | None = None,
-                     rid: str | None = None) -> None:
-        self._buffer.append((f"{int(y)} {line}", trace, rid))
-        if len(self._buffer) >= self.shard_records:
-            self._write_shard_locked()
+                     rid: str | None = None,
+                     model: str | None = None) -> None:
+        buf = self._buffers.setdefault(model, [])
+        buf.append((f"{int(y)} {line}", trace, rid))
+        if len(buf) >= self.shard_records:
+            self._write_shard_locked(model)
 
-    def _write_shard_locked(self) -> None:
-        if not self._buffer:
+    def _write_shard_locked(self, model: str | None = None) -> None:
+        buffer = self._buffers.get(model)
+        if not buffer:
             return
-        path = os.path.join(self.out_dir,
-                            f"shard-{self._shard_seq:06d}.libsvm")
+        out_dir = self._model_dir(model)
+        seq = self._seqs.get(model)
+        if seq is None:
+            os.makedirs(out_dir, exist_ok=True)
+            seq = self._next_shard_seq(out_dir)
+        path = os.path.join(out_dir, f"shard-{seq:06d}.libsvm")
         # trace sidecar first, shard second: the rename that makes the
         # shard claimable must find the sidecar already in place (the
         # trainer reads it at claim time)
         side = f"{path}.trace"
-        if any(tr is not None for _, tr, _r in self._buffer):
+        if any(tr is not None for _, tr, _r in buffer):
             stmp = f"{side}.tmp"
             with open(stmp, "w") as f:
                 json.dump([None if tr is None else f"{tr[0]:016x}/{tr[1]:016x}"
-                           for _, tr, _r in self._buffer], f)
+                           for _, tr, _r in buffer], f)
             os.replace(stmp, side)
         elif os.path.exists(side):
             # a crash between sidecar and shard write left an orphan; a
@@ -223,16 +241,16 @@ class LabelJoiner:
                 pass
         tmp = f"{path}.tmp"
         with open(tmp, "w") as f:
-            f.write("\n".join(text for text, _tr, _r in self._buffer) + "\n")
+            f.write("\n".join(text for text, _tr, _r in buffer) + "\n")
         os.replace(tmp, path)  # atomic: the trainer never sees a torn shard
         # tombstone AFTER the shard is durable: a crash in between
         # replays the record and at worst re-joins a re-arriving label
         # (deduped in-session by _recent) — never silently drops one
-        for _text, _tr, rid in self._buffer:
+        for _text, _tr, rid in buffer:
             if rid is not None:
                 self.spool.mark_joined(rid)
-        self._shard_seq += 1
-        self._buffer.clear()
+        self._seqs[model] = seq + 1
+        buffer.clear()
         self.shards_written += 1
         _SHARDS.inc()
 
@@ -250,7 +268,8 @@ class LabelJoiner:
                 if self.negative_rate and self._rng.random() < self.negative_rate:
                     self.negatives += 1
                     _NEGATIVE.inc()
-                    self._emit_locked(0, rec.line, rec.trace)
+                    self._emit_locked(0, rec.line, rec.trace,
+                                      model=rec.model)
                 else:
                     drop("expired")
             stale = [rid for rid, (_, ts) in self._pending.items()
@@ -262,9 +281,11 @@ class LabelJoiner:
                 _PENDING_LABELS.set(len(self._pending))
 
     def flush(self) -> None:
-        """Force out a partial shard (shutdown, tests, idle flushes)."""
+        """Force out partial shards — every model's (shutdown, tests,
+        idle flushes)."""
         with self._lock:
-            self._write_shard_locked()
+            for model in list(self._buffers):
+                self._write_shard_locked(model)
 
     def stats(self) -> dict:
         with self._lock:
@@ -272,7 +293,7 @@ class LabelJoiner:
                 "joined": self.joined,
                 "negatives": self.negatives,
                 "pending_labels": len(self._pending),
-                "buffered": len(self._buffer),
+                "buffered": sum(len(b) for b in self._buffers.values()),
                 "shards_written": self.shards_written,
                 "window_s": self.window_s,
                 "negative_rate": self.negative_rate,

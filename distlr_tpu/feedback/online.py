@@ -75,9 +75,12 @@ _ACCUM_K = _reg.gauge(
     "current AdaBatch accumulation span: batches per push",
 )
 
-#: models the online loop supports (dense full-vector pushes, or keyed
-#: sparse pushes); blocked/sparse-softmax land with their trainer loops
-_SUPPORTED = ("binary_lr", "softmax", "sparse_lr")
+#: models the online loop supports: dense full-vector pushes
+#: (binary_lr / softmax), keyed sparse pushes (sparse_lr), and keyed
+#: per-class rows (sparse_softmax — each feature key owns its
+#: num_classes lanes, pushed vals_per_key=K when the group's range
+#: boundaries align, expanded per-lane keys otherwise)
+_SUPPORTED = ("binary_lr", "softmax", "sparse_lr", "sparse_softmax")
 
 
 class OnlineTrainer:
@@ -92,7 +95,21 @@ class OnlineTrainer:
                  accum_growth_every: int = 32, accum_max: int = 64,
                  poll_interval_s: float = 0.5, idle_flush_s: float = 2.0,
                  client_id: int | None = None, seed_init: bool = True,
-                 worker_id: int = 0, claim_stale_s: float = 300.0):
+                 worker_id: int = 0, claim_stale_s: float = 300.0,
+                 ns_base: int = 0, ns_total_dim: int | None = None):
+        if cfg.model == "blocked_lr":
+            # named rejection, not a generic unsupported-model error: the
+            # blocked path's raw-CTR hashing happens at shard INGEST
+            # (write_raw_ctr_shards) while feedback shards carry already-
+            # hashed libsvm rows — re-deriving the grouped (R, groups)
+            # row layout from them is not possible, so blocked models
+            # keep training through `launch ps`
+            raise ValueError(
+                "online training does not support blocked_lr: feedback "
+                "shards are hashed libsvm rows, but blocked_lr's grouped "
+                "row layout is only derivable from RAW categorical "
+                "shards at ingest time — train blocked models with "
+                "`launch ps` on raw-CTR data instead")
         if cfg.model not in _SUPPORTED:
             raise ValueError(
                 f"online training supports {_SUPPORTED}, got {cfg.model!r}")
@@ -112,8 +129,14 @@ class OnlineTrainer:
         self.idle_flush_s = float(idle_flush_s)
         self.worker_id = int(worker_id)
         self.claim_stale_s = float(claim_stale_s)
-        self.kv = KVWorker(
-            hosts, self.dim,
+        #: multi-tenant namespace scoping (ISSUE 10): when the group
+        #: hosts several model namespaces, train only the slice
+        #: ``[ns_base, ns_base + dim)`` — each tenant's online trainer
+        #: watches its own shard subdir and pushes into its own
+        #: namespace of the shared group
+        wire_dim = int(ns_total_dim) if ns_total_dim else self.dim
+        worker = KVWorker(
+            hosts, wire_dim,
             client_id=self.ONLINE_CLIENT_ID + worker_id if client_id is None
             else client_id,
             timeout_ms=cfg.ps_timeout_ms,
@@ -121,11 +144,16 @@ class OnlineTrainer:
             retry=RetryPolicy.from_config(cfg),
             compress=cfg.ps_compress,
         )
+        self.kv = (worker if wire_dim == self.dim and not ns_base
+                   else worker.namespace(int(ns_base), self.dim))
         if seed_init:
             # idempotent: seeds an unseeded group with zeros (FTRL's
             # natural origin), no-ops against live weights — so the
             # online trainer can be the loop's FIRST trainer or join an
-            # already-trained group without a flag
+            # already-trained group without a flag.  (In a multi-
+            # namespace group the first namespace's seed initializes the
+            # whole table to zeros — later namespaces' no-ops land on
+            # the same zeros.)
             self.kv.push_init(np.zeros(self.dim, np.float32))
         self._accum = GradientAccumulator(
             self.dim, start=accum_start, growth=accum_growth,
@@ -135,8 +163,16 @@ class OnlineTrainer:
         self.shards_consumed = 0
         self.examples = 0
         self.pushes = 0
-        self._num_classes = (cfg.num_classes if cfg.model == "softmax"
+        self._num_classes = (cfg.num_classes
+                             if cfg.model in ("softmax", "sparse_softmax")
                              else None)
+        # sparse_softmax keyed rows: one feature key owns K class lanes;
+        # vals_per_key rides the wire when the group's range boundaries
+        # align, else keys expand per lane (the keyed trainers' rule)
+        self._row_vpk = 1
+        if cfg.model == "sparse_softmax" and self.kv.supports_vals_per_key(
+                cfg.num_classes):
+            self._row_vpk = cfg.num_classes
 
     @property
     def accum_k(self) -> int:
@@ -177,17 +213,61 @@ class OnlineTrainer:
         self.examples += len(y)
         _EXAMPLES.inc(len(y))
 
+    def _sparse_softmax_batch(self, pc, pv, y) -> None:
+        """Keyed rows per class (the ISSUE-6 follow-on): each unique
+        feature key owns its K class lanes of the row-major (D, K)
+        table — pulled/pushed vals_per_key=K when aligned, expanded
+        per-lane keys otherwise."""
+        from distlr_tpu.train.ps_trainer import (  # noqa: PLC0415
+            _expand_block_keys,
+            _sparse_softmax_batch_grad,
+        )
+
+        cfg = self.cfg
+        K = cfg.num_classes
+        ub, pos = np.unique(pc, return_inverse=True)
+        rows = ub.astype(np.uint64)
+        if self._row_vpk > 1:
+            w_u = self.kv.pull(keys=rows, vals_per_key=K)
+        else:
+            w_u = self.kv.pull(keys=_expand_block_keys(rows, K))
+        mask = np.ones(len(y), np.float32)
+        g_u = _sparse_softmax_batch_grad(
+            w_u.reshape(-1, K), pos.reshape(pc.shape), pv, y, mask,
+            cfg.l2_c, bool(cfg.l2_scale_by_batch))
+        self._accum.add_rows(ub, g_u.reshape(-1), K)
+        self.examples += len(y)
+        _EXAMPLES.inc(len(y))
+
     def _flush_push(self) -> None:
         """Push the accumulated MEAN gradient (one Hogwild update of
         batch size span*B); the accumulator advances its own AdaBatch
         schedule per flush."""
-        if self.cfg.model == "sparse_lr":
+        cfg = self.cfg
+        if cfg.model == "sparse_lr":
             res = self._accum.flush_keyed()
             if res is None:
                 return
             keys, vals = res
             if keys.size:  # async Hogwild: a cancelled span pushes nothing
                 self.kv.wait(self.kv.push(vals, keys=keys))
+        elif cfg.model == "sparse_softmax":
+            res = self._accum.flush_keyed(vpk=cfg.num_classes)
+            if res is None:
+                return
+            rows, vals = res
+            if rows.size:
+                if self._row_vpk > 1:
+                    self.kv.wait(self.kv.push(
+                        vals, keys=rows, vals_per_key=cfg.num_classes))
+                else:
+                    from distlr_tpu.train.ps_trainer import (  # noqa: PLC0415
+                        _expand_block_keys,
+                    )
+
+                    self.kv.wait(self.kv.push(
+                        vals, keys=_expand_block_keys(rows,
+                                                      cfg.num_classes)))
         else:
             g = self._accum.flush_dense()
             if g is None:
@@ -307,14 +387,17 @@ class OnlineTrainer:
                 "online.consume",
                 tags={"shard": shard, "records": len(lines),
                       "worker": self.worker_id}):
-            if cfg.model == "sparse_lr":
+            if cfg.model in ("sparse_lr", "sparse_softmax"):
                 (row_ptr, cols, vals), y = parse_libsvm_lines(
-                    lines, cfg.num_feature_dim, dense=False)
+                    lines, cfg.num_feature_dim, dense=False,
+                    multiclass=cfg.model == "sparse_softmax")
                 pc, pv = csr_to_padded_coo(row_ptr, cols, vals,
                                            nnz_max=cfg.nnz_max)
+                batch_fn = (self._sparse_softmax_batch
+                            if cfg.model == "sparse_softmax"
+                            else self._sparse_batch)
                 for lo in range(0, len(y), B):
-                    self._sparse_batch(pc[lo:lo + B], pv[lo:lo + B],
-                                       y[lo:lo + B])
+                    batch_fn(pc[lo:lo + B], pv[lo:lo + B], y[lo:lo + B])
                     if self._accum.ready:
                         self._flush_push()
                     n += len(y[lo:lo + B])

@@ -68,7 +68,8 @@ class FeedbackSink:
     # -- serve-side entry points ------------------------------------------
     def scored(self, lines: list[str], rows: tuple, scores, *,
                version: int, ids: list[str | None] | None = None,
-               trace: tuple[int, int] | None = None) -> None:
+               trace: tuple[int, int] | None = None,
+               model: str | None = None) -> None:
         """Journal one scored batch.  ``lines`` are the raw request
         lines (label token optional — stripped here), ``rows`` the
         engine's encoded feature leaves for the SAME batch, ``scores``
@@ -80,7 +81,12 @@ class FeedbackSink:
         ``(trace_id, span_id)`` — the spool entry remembers it, so a
         label arriving minutes later (or across a restart, via the
         journal) continues the ORIGINATING request's trace through
-        join -> shard -> online push -> server apply."""
+        join -> shard -> online push -> server apply.
+
+        ``model``: the model VERSION that scored the batch
+        (multi-tenant serving) — joined examples emit into the model's
+        own shard subdir so online training stays per-tenant; None =
+        the pre-tenant flat shard layout."""
         now = time.time()
         keys = per_row_keys(self.model, rows)
         ctx = (dtrace.TraceContext(trace[0], trace[1], True)
@@ -96,7 +102,7 @@ class FeedbackSink:
                     rid=str(rid), ts=now, line=strip_label(line),
                     score=float(scores[i]), version=int(version),
                     keys=keys[i] if i < len(keys) else None,
-                    trace=tr,
+                    trace=tr, model=model,
                 ))
         self.drift.observe(scores)
 

@@ -75,6 +75,11 @@ class SpoolRecord:
     #: distributed-trace (trace_id, span_id) of the scoring request's
     #: feedback.spool span — the delayed-label join continues it
     trace: tuple[int, int] | None = None
+    #: model version that scored the request (multi-tenant serving,
+    #: ISSUE 10): the joiner emits this record's example into the
+    #: model's OWN shard stream so online training stays per-tenant;
+    #: None = single-model serving (flat shards, pre-tenant behavior)
+    model: str | None = None
 
 
 class FeedbackSpool:
@@ -132,6 +137,10 @@ class FeedbackSpool:
             "id": rec.rid, "ts": round(rec.ts, 3), "line": rec.line,
             "score": round(rec.score, 6), "version": rec.version,
         }
+        if rec.model is not None:
+            # the model id rides the journal so a label joined across a
+            # restart still lands in its tenant's shard stream
+            doc["model"] = rec.model
         if rec.trace is not None:
             # the trace rides the journal so a label joined AFTER a
             # restart (replay) still continues the original request's
@@ -201,11 +210,13 @@ class FeedbackSpool:
                         trace = (int(tid, 16), int(sid, 16))
                     except ValueError:
                         pass
+                model = doc.get("model")
                 rec = SpoolRecord(
                     rid=str(doc["id"]), ts=float(doc["ts"]),
                     line=str(doc.get("line", "")),
                     score=float(doc.get("score", 0.0)),
-                    version=int(doc.get("version", 0)), trace=trace)
+                    version=int(doc.get("version", 0)), trace=trace,
+                    model=None if model is None else str(model))
                 recovered[rec.rid] = rec
         with self._lock:
             n = 0

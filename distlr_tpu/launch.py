@@ -326,6 +326,7 @@ def _config_from_args(args: argparse.Namespace) -> Config:
             "ps_compress", "ps_accum_start", "ps_accum_growth",
             "ps_accum_growth_every", "ps_accum_max", "ps_retry_adaptive",
             "trace_sample", "prof_hz", "prof_window_s",
+            "serve_model_id", "route_quota",
         }
     }
     if isinstance(overrides.get("obs_run_dir"), list):
@@ -611,6 +612,8 @@ def cmd_serve(args: argparse.Namespace) -> int:
         "feedback_drift_block": args.drift_block,
         "feedback_drift_threshold": args.drift_threshold,
     }
+    if args.model_id is not None:
+        serve_over["serve_model_id"] = args.model_id
     cfg = cfg.replace(**{k: v for k, v in serve_over.items() if v is not None})
     if not (args.model_file or cfg.checkpoint_dir or args.ps_hosts):
         print("error: serve needs a weight source: --model-file and/or "
@@ -631,14 +634,38 @@ def cmd_serve(args: argparse.Namespace) -> int:
                   "to re-resolve 'auto' from", file=sys.stderr)
             return 2
 
+    # multi-tenant namespace layout: which slice of a shared PS group's
+    # key space each model id owns (must match `launch ps-server
+    # --namespaces` order)
+    ns_layout = None
+    if args.ps_namespaces:
+        if not args.ps_hosts:
+            print("error: --ps-namespaces applies to live-PS reload only "
+                  "(--ps-hosts)", file=sys.stderr)
+            return 2
+        from distlr_tpu.ps import namespace_layout  # noqa: PLC0415
+
+        ns_layout = namespace_layout(args.ps_namespaces, ps_param_dim(cfg))
+
+    def _ns(model_id: str) -> tuple[int, int | None]:
+        if ns_layout is None:
+            return 0, None
+        if model_id not in ns_layout:
+            raise SystemExit(
+                f"error: model {model_id!r} not in --ps-namespaces "
+                f"{sorted(ns_layout)}")
+        return ns_layout[model_id][0], ps_param_dim(cfg) * len(ns_layout)
+
     engine = ScoringEngine(cfg, max_batch_size=cfg.serve_max_batch_size)
     if args.model_file:
         engine.set_weights(
             load_weights(args.model_file, shape=engine.model.param_shape))
     reloader = None
     hot_tracker = None
+    extra_reloaders = []
+    retry = None
+    row_width = _serve_row_width(cfg)
     if args.ps_hosts:
-        row_width = _serve_row_width(cfg)
         if cfg.serve_hot_rows:
             from distlr_tpu.serve import HotSetTracker  # noqa: PLC0415
 
@@ -650,6 +677,7 @@ def cmd_serve(args: argparse.Namespace) -> int:
         # policy degrades to last-good weights (HotReloader), never
         # kills the server
         retry = RetryPolicy.from_config(cfg)
+        base, total = _ns(args.ps_namespace or cfg.serve_model_id)
         source = LivePSWatcher(
             args.ps_hosts, ps_param_dim(cfg),
             vals_per_key=max(row_width, 1),
@@ -657,6 +685,7 @@ def cmd_serve(args: argparse.Namespace) -> int:
             min_coverage=cfg.serve_hot_min_coverage,
             full_refresh_every=cfg.serve_hot_full_every,
             retry=retry,
+            ns_base=base, ns_total_dim=total,
         )
     elif cfg.checkpoint_dir:
         source = CheckpointWatcher(cfg.checkpoint_dir)
@@ -668,6 +697,43 @@ def cmd_serve(args: argparse.Namespace) -> int:
         ).start()
         if not engine.has_weights:
             reloader.wait_for_weights()
+
+    # additional hosted model versions: "--extra-model id=weights" loads
+    # a static engine from a model file; "--extra-model id=@ps" attaches
+    # a live-PS reloader over that id's namespace of the SAME group (one
+    # ScoringServer hosting several live versions — the canary shape)
+    engines = {cfg.serve_model_id: engine}
+    for spec in args.extra_models or []:
+        mid, eq, src = spec.partition("=")
+        mid, src = mid.strip(), src.strip()
+        if not eq or not mid or not src:
+            print(f"error: bad --extra-model {spec!r} (want id=weights "
+                  "or id=@ps)", file=sys.stderr)
+            return 2
+        if mid in engines:
+            print(f"error: duplicate model id {mid!r}", file=sys.stderr)
+            return 2
+        eng = ScoringEngine(cfg, max_batch_size=cfg.serve_max_batch_size)
+        if src == "@ps":
+            if not args.ps_hosts:
+                print("error: --extra-model id=@ps needs --ps-hosts",
+                      file=sys.stderr)
+                return 2
+            base, total = _ns(mid)
+            extra_src = LivePSWatcher(
+                args.ps_hosts, ps_param_dim(cfg),
+                vals_per_key=max(row_width, 1),
+                # distinct pull client per namespace watcher
+                client_id=LivePSWatcher.SERVE_CLIENT_ID - len(engines),
+                retry=retry, ns_base=base, ns_total_dim=total,
+            )
+            rl = HotReloader(eng, extra_src,
+                             interval_s=cfg.serve_reload_interval_s).start()
+            rl.wait_for_weights()
+            extra_reloaders.append(rl)
+        else:
+            eng.set_weights(load_weights(src, shape=eng.model.param_shape))
+        engines[mid] = eng
 
     feedback = None
     if cfg.feedback_spool_dir:
@@ -690,9 +756,16 @@ def cmd_serve(args: argparse.Namespace) -> int:
                  cfg.feedback_window_s, cfg.feedback_negative_rate)
 
     signal.signal(signal.SIGTERM, lambda *_: sys.exit(143))
+    multi = bool(args.extra_models) or args.model_id is not None
     server = ScoringServer(
-        engine, host=cfg.serve_host, port=cfg.serve_port,
+        # single unnamed engine = the pre-tenant construction (flat
+        # feedback shards); an explicit --model-id or extra models turn
+        # model identity on
+        None if multi else engine,
+        engines=engines if multi else None,
+        host=cfg.serve_host, port=cfg.serve_port,
         max_wait_ms=cfg.serve_max_wait_ms, reloader=reloader,
+        extra_reloaders=extra_reloaders,
         hot_tracker=hot_tracker, feedback=feedback,
     )
     with _obs_scope(cfg, "serve", _obs_rank(args)):
@@ -719,6 +792,20 @@ def cmd_online(args: argparse.Namespace) -> int:
         # default (trainers default to 1 = off; the flag overrides both)
         args.ps_accum_max = 64
     cfg = _config_from_args(args)
+    ns_base, ns_total = 0, None
+    if args.ps_namespaces:
+        # train only this tenant's namespace slice of a shared group
+        from distlr_tpu.ps import namespace_layout  # noqa: PLC0415
+        from distlr_tpu.train.ps_trainer import ps_param_dim  # noqa: PLC0415
+
+        layout = namespace_layout(args.ps_namespaces, ps_param_dim(cfg))
+        ns_id = args.ps_namespace or cfg.serve_model_id
+        if ns_id not in layout:
+            print(f"error: namespace {ns_id!r} not in --ps-namespaces "
+                  f"{sorted(layout)}", file=sys.stderr)
+            return 2
+        ns_base = layout[ns_id][0]
+        ns_total = ps_param_dim(cfg) * len(layout)
     stop = threading.Event()
     signal.signal(signal.SIGTERM, lambda *_: stop.set())
     with _obs_scope(cfg, "online", _obs_rank(args)):
@@ -730,6 +817,7 @@ def cmd_online(args: argparse.Namespace) -> int:
             accum_max=cfg.ps_accum_max,
             poll_interval_s=args.poll_interval,
             worker_id=args.worker_id,
+            ns_base=ns_base, ns_total_dim=ns_total,
         )
         print(f"ONLINE shard_dir={args.shard_dir} hosts={args.hosts} "
               f"worker={args.worker_id}", flush=True)
@@ -769,6 +857,8 @@ def cmd_route(args: argparse.Namespace) -> int:
         "route_probe_backoff_max_s": args.probe_backoff_max,
         "route_backend_timeout_s": args.backend_timeout,
     }
+    if args.quota is not None:
+        route_over["route_quota"] = args.quota
     signal.signal(signal.SIGTERM, lambda *_: sys.exit(143))
     try:
         cfg = cfg.replace(
@@ -781,6 +871,7 @@ def cmd_route(args: argparse.Namespace) -> int:
             probe_backoff_s=cfg.route_probe_backoff_s,
             probe_backoff_max_s=cfg.route_probe_backoff_max_s,
             backend_timeout_s=cfg.route_backend_timeout_s,
+            quotas=cfg.route_quota,
         )
     except ValueError as e:
         # config and replica-list errors get the argparse-style contract
@@ -792,6 +883,76 @@ def cmd_route(args: argparse.Namespace) -> int:
         print(f"ROUTING {router.host}:{router.port}", flush=True)
         router.serve_forever()
     return 0
+
+
+def cmd_rollout(args: argparse.Namespace) -> int:
+    """Canary ramp with automatic rollback (:mod:`distlr_tpu.serve.
+    rollout`): drive a routing tier's weighted primary/candidate SPLIT
+    through staged weights, polling the fleet's ``distlr_alert_*``
+    gauges at every hold — any bound alert firing mid-ramp rolls the
+    split back in one admin round trip; a clean ramp ends in PROMOTE.
+    Every transition journals to ``<obs-run-dir>/rollout/``.  Jax-free,
+    like route/obs-agg.  Exit codes: 0 promoted, 3 rolled back, 4
+    aborted (pre-ramp alerts / registry problems)."""
+    import json  # noqa: PLC0415
+
+    from distlr_tpu.obs.federate import discover_endpoints  # noqa: PLC0415
+    from distlr_tpu.serve.rollout import (  # noqa: PLC0415
+        RolloutController,
+        RouterAdmin,
+        fleet_alert_poller,
+        parse_stages,
+    )
+
+    cfg = _config_from_args(args)
+    host, _, port = args.router.rpartition(":")
+    if not host or not port.isdigit():
+        print(f"error: --router must be host:port, got {args.router!r}",
+              file=sys.stderr)
+        return 2
+    try:
+        stages = parse_stages(args.stages)
+    except ValueError as e:
+        print(f"error: bad --stages: {e}", file=sys.stderr)
+        return 2
+    poller = None
+    fleet_url = args.fleet
+    if not fleet_url and cfg.obs_run_dir:
+        run_dir = cfg.obs_run_dir.split(os.pathsep)[0]
+        aggs = [e for e in discover_endpoints(run_dir)
+                if e["role"] == "obs-agg"]
+        if aggs:
+            fleet_url = f"http://{aggs[-1]['host']}:{aggs[-1]['port']}"
+    if fleet_url:
+        names = ([n.strip() for n in args.alerts.split(",") if n.strip()]
+                 if args.alerts else None)
+        poller = fleet_alert_poller(fleet_url, names=names)
+    elif not args.unwatched:
+        print("error: no alert source — pass --fleet http://host:port, an "
+              "--obs-run-dir with a running obs-agg, or --unwatched to "
+              "ramp on the timer alone (rollback becomes manual)",
+              file=sys.stderr)
+        return 2
+    journal_dir = args.journal_dir or (
+        cfg.obs_run_dir.split(os.pathsep)[0] if cfg.obs_run_dir else None)
+    with _obs_scope(cfg, "rollout", _obs_rank(args)):
+        ctrl = RolloutController(
+            RouterAdmin(host, int(port)), args.tenant, args.candidate,
+            stages, alert_poll=poller,
+            poll_interval_s=args.poll_interval,
+            shadow_fraction=args.shadow,
+            settle_s=args.settle,
+            journal_dir=journal_dir,
+        )
+        try:
+            outcome = ctrl.run()
+        except (OSError, RuntimeError) as e:
+            print(f"error: ramp failed against the router: {e}",
+                  file=sys.stderr)
+            return 1
+    # Scriptable contract, like METRICS/SERVING/HOSTS/TRACE.
+    print(f"ROLLOUT {json.dumps(outcome)}", flush=True)
+    return {"promoted": 0, "rolled_back": 3}.get(outcome["outcome"], 4)
 
 
 def cmd_chaos(args: argparse.Namespace) -> int:
@@ -810,7 +971,7 @@ def cmd_chaos(args: argparse.Namespace) -> int:
     cfg = _config_from_args(args)
     try:
         plan = load_plan(args.plan, seed=args.seed)
-        fabric = ChaosFabric(args.upstreams, plan)
+        fabric = ChaosFabric(args.upstreams, plan, protocol=args.protocol)
     except (OSError, FaultPlanError, ValueError) as e:
         print(f"error: {e}", file=sys.stderr)
         return 2
@@ -861,10 +1022,22 @@ def cmd_ps_server(args: argparse.Namespace) -> int:
     if ports and len(ports) != cfg.num_servers:
         print(f"error: {len(ports)} ports for {cfg.num_servers} servers", file=sys.stderr)
         return 2
+    # multi-tenant namespaces (ISSUE 10): one group hosts N model
+    # namespaces as contiguous slices of an N-times-larger key space;
+    # clients scope with the same layout (serve --ps-namespaces /
+    # online --ps-namespaces, or KVWorker.namespace directly)
+    layout = None
+    per_dim = ps_param_dim(cfg)
+    total_dim = per_dim
+    if args.namespaces:
+        from distlr_tpu.ps import namespace_layout  # noqa: PLC0415
+
+        layout = namespace_layout(args.namespaces, per_dim)
+        total_dim = per_dim * len(layout)
     group = ServerGroup(
         cfg.num_servers,
         cfg.num_workers,
-        ps_param_dim(cfg),
+        total_dim,
         learning_rate=cfg.learning_rate,
         sync=cfg.sync_mode and not args.asynchronous,
         last_gradient=bool(cfg.sync_last_gradient),
@@ -892,6 +1065,13 @@ def cmd_ps_server(args: argparse.Namespace) -> int:
             # Workers pass this (with this host's address substituted for
             # 127.0.0.1) as --hosts.
             print(f"HOSTS {group.hosts}", flush=True)
+            if layout is not None:
+                # scriptable layout contract, like HOSTS: clients repeat
+                # the same --ps-namespaces list, this line documents the
+                # flat-slot bases the group actually serves
+                print("NAMESPACES "
+                      + ",".join(f"{m}={b}" for m, (b, _d) in layout.items())
+                      + f" per_dim={per_dim}", flush=True)
             group.wait()
     except KeyboardInterrupt:
         return 130  # interrupted != clean worker-driven shutdown
@@ -1265,6 +1445,25 @@ def main(argv=None) -> int:
     r.add_argument("--drift-threshold", dest="drift_threshold", type=float,
                    help="block-to-block PSI above which "
                    "distlr_alert_score_drift fires (default 0.25)")
+    r.add_argument("--model-id", dest="model_id",
+                   help="model id this server's PRIMARY engine answers as "
+                   "(MODEL/@-addressing; feedback records carry it so "
+                   "online training stays per-tenant).  Default "
+                   "'default' = pre-tenant unaddressed behavior")
+    r.add_argument("--extra-model", dest="extra_models", action="append",
+                   metavar="ID=WEIGHTS|ID=@ps",
+                   help="host an ADDITIONAL model version on this server "
+                   "(repeatable): id=path loads a static engine from a "
+                   "model file / orbax dir; id=@ps attaches a live-PS "
+                   "reloader over that id's namespace of the --ps-hosts "
+                   "group (needs --ps-namespaces)")
+    r.add_argument("--ps-namespaces", dest="ps_namespaces",
+                   help="comma-separated model ids the PS group hosts as "
+                   "key-space namespaces (MUST repeat `launch ps-server "
+                   "--namespaces` verbatim — order defines the slices)")
+    r.add_argument("--ps-namespace", dest="ps_namespace",
+                   help="which namespace the primary engine serves "
+                   "(default: --model-id)")
     r.set_defaults(fn=cmd_serve)
 
     on = sub.add_parser(
@@ -1297,6 +1496,15 @@ def main(argv=None) -> int:
     on.add_argument("--idle-exit", dest="idle_exit", type=float,
                     help="exit after this many seconds with no new shards "
                     "(default: wait forever)")
+    on.add_argument("--ps-namespaces", dest="ps_namespaces",
+                    help="comma-separated model ids the PS group hosts as "
+                    "key-space namespaces (repeat `launch ps-server "
+                    "--namespaces` verbatim); this trainer pushes only "
+                    "into its own namespace slice")
+    on.add_argument("--ps-namespace", dest="ps_namespace",
+                    help="which namespace this trainer trains (default: "
+                    "--model-id / serve_model_id); point --shard-dir at "
+                    "the same tenant's shard subdir")
     on.set_defaults(fn=cmd_online)
 
     rt = sub.add_parser(
@@ -1333,12 +1541,75 @@ def main(argv=None) -> int:
     rt.add_argument("--backend-timeout", dest="backend_timeout", type=float,
                     help="per-exchange socket timeout toward replicas, "
                     "seconds (default 30)")
+    rt.add_argument("--quota", dest="quota", metavar="MODEL=RATE[:BURST],..",
+                    help="per-tenant token-bucket admission quotas "
+                    "(requests/s; burst defaults to 2*rate): a tenant "
+                    "over budget gets an explicit 'ERR SHED tenant' "
+                    "reply and its own distlr_tenant_shed_total counter, "
+                    "distinct from capacity sheds")
     rt.set_defaults(fn=cmd_route)
+
+    ro = sub.add_parser(
+        "rollout",
+        help="canary ramp with automatic rollback: stage a tenant's "
+             "traffic onto a candidate model version via the router's "
+             "SPLIT admin line, roll back the moment any bound "
+             "distlr_alert_* gauge fires, PROMOTE on a clean ramp; "
+             "every transition journals to <obs-run-dir>/rollout/",
+    )
+    _add_config_flags(ro)
+    ro.add_argument("--router", required=True,
+                    help="the routing front-end's host:port (what "
+                    "`launch route` announced as ROUTING)")
+    ro.add_argument("--tenant", required=True,
+                    help="model id whose traffic is being ramped (the "
+                    "PRIMARY)")
+    ro.add_argument("--candidate", required=True,
+                    help="model id taking the ramped traffic (must be "
+                    "registered in the router's --replicas spec)")
+    ro.add_argument("--stages", default="0.05:10,0.25:10,0.5:10,1.0:10",
+                    help="comma-separated weight:hold_s ramp stages, "
+                    "ascending to 1.0 (default "
+                    "'0.05:10,0.25:10,0.5:10,1.0:10')")
+    ro.add_argument("--shadow", type=float, default=0.0,
+                    help="also mirror this fraction of the tenant's "
+                    "traffic to the candidate during the ramp "
+                    "(distlr_tenant_shadow_psi feeds the alert inputs; "
+                    "default 0 = no shadow)")
+    ro.add_argument("--settle", type=float, default=0.0,
+                    help="with --shadow: observe the shadow for this "
+                    "many seconds BEFORE the first split stage "
+                    "(default 0)")
+    ro.add_argument("--fleet",
+                    help="obs-agg URL (http://host:port) whose "
+                    "/fleet.json alerts gate the ramp; default: "
+                    "discovered from --obs-run-dir")
+    ro.add_argument("--alerts",
+                    help="comma-separated alert gauge names to bind "
+                    "(default: every distlr_alert_*)")
+    ro.add_argument("--unwatched", action="store_true",
+                    help="ramp on the stage timers alone, with NO alert "
+                    "gate (rollback becomes manual) — tests/dev only")
+    ro.add_argument("--poll-interval", dest="poll_interval", type=float,
+                    default=0.5,
+                    help="alert poll period during holds, seconds "
+                    "(default 0.5)")
+    ro.add_argument("--journal-dir", dest="journal_dir",
+                    help="journal transitions under DIR/rollout/ "
+                    "(default: the first --obs-run-dir)")
+    ro.set_defaults(fn=cmd_rollout)
 
     v = sub.add_parser("ps-server", help="host a KV server group (multi-host PS)")
     _add_config_flags(v)
     v.add_argument("--async", dest="asynchronous", action="store_true")
     v.add_argument("--ports", help="fixed ports, comma-separated (default: ephemeral)")
+    v.add_argument("--namespaces",
+                   help="host N model namespaces in one group (comma-"
+                   "separated model ids, order defines the key-space "
+                   "slices): the group's dim becomes N x the per-model "
+                   "dim and the layout is announced as 'NAMESPACES "
+                   "id=base,...' — clients repeat the same list via "
+                   "--ps-namespaces")
     v.set_defaults(fn=cmd_ps_server)
 
     c = sub.add_parser(
@@ -1362,6 +1633,12 @@ def main(argv=None) -> int:
     c.add_argument("--events-path", dest="events_path",
                    help="write the deterministic fault-event log here as "
                    "JSON at exit")
+    c.add_argument("--protocol", choices=["kv", "serve"], default="kv",
+                   help="client->server framing the proxy parses: 'kv' "
+                   "(native PS links, the default) or 'serve' (the "
+                   "serving tier's line protocol — front a router or "
+                   "engine replicas so op-offset faults land per request "
+                   "line)")
     c.set_defaults(fn=cmd_chaos)
 
     a = sub.add_parser(

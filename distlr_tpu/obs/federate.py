@@ -956,6 +956,27 @@ class FleetScraper:
                 if snap.get("distlr_feedback_score_psi") is not None:
                     row["score_psi"] = _snap_max(
                         snap, "distlr_feedback_score_psi")
+                # multi-tenant serving ranks (ISSUE 10): hosted-model
+                # count, per-tenant quota sheds, and the live shadow PSI
+                # (the canary ramp's promote/rollback evidence) roll
+                # through fleet.json into `launch top`
+                if snap.get("distlr_tenant_models") is not None:
+                    # the router's purpose-built registration gauge —
+                    # counting distinct request labels instead would
+                    # under-report versions that took no traffic yet
+                    # (exactly the pre-ramp window an operator checks)
+                    m = _snap_max(snap, "distlr_tenant_models")
+                    if m is not None:
+                        row["models"] = int(m)
+                if snap.get("distlr_tenant_shed_total") is not None:
+                    row["tenant_shed"] = int(
+                        _snap_sum(snap, "distlr_tenant_shed_total"))
+                if snap.get("distlr_tenant_shadow_psi") is not None:
+                    row["shadow_psi"] = _snap_max(
+                        snap, "distlr_tenant_shadow_psi")
+                if snap.get("distlr_rollout_weight") is not None:
+                    row["rollout_weight"] = _snap_max(
+                        snap, "distlr_rollout_weight")
                 # routing-tier ranks (`launch route`): surface the
                 # admission/health signals next to the trainer rows
                 if snap.get("distlr_route_requests_total") is not None:

@@ -31,6 +31,7 @@ _COLUMNS = (
     ("samples/s", 10), ("req/s", 8), ("push/s", 8), ("e2e p50/p99", 13),
     ("step p50", 9), ("pull p50/p99", 13), ("push p50/p99", 13),
     ("stale s", 8), ("stale pushes", 13), ("compiles", 8), ("dev MB", 8),
+    ("mdl", 4), ("t-shed", 7), ("sh-psi", 7),
 )
 
 
@@ -127,6 +128,11 @@ def _rank_cells(r: dict, rates: dict | None = None) -> list[str]:
         # buffer footprint (engine/trainer ranks; '-' for jax-free roles)
         _num(r.get("jax_compiles"), "{:d}"),
         _num(r.get("device_mb")),
+        # multi-tenant serving: hosted model count, per-tenant quota
+        # sheds, and the worst live shadow PSI (routing-tier ranks)
+        _num(r.get("models"), "{:d}"),
+        _num(r.get("tenant_shed"), "{:d}"),
+        _num(r.get("shadow_psi"), "{:.3f}"),
     ]
 
 

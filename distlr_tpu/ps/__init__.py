@@ -1,10 +1,12 @@
 from distlr_tpu.ps.build import build_native, native_dir  # noqa: F401
 from distlr_tpu.ps.client import (  # noqa: F401
     FaultRateTracker,
+    KVNamespace,
     KVWorker,
     PSRejectedError,
     PSTimeoutError,
     RetryPolicy,
     STATS_FIELDS,
+    namespace_layout,
 )
 from distlr_tpu.ps.server import ServerGroup, ServerSupervisor  # noqa: F401

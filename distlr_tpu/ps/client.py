@@ -1097,10 +1097,184 @@ class KVWorker:
     def shutdown_servers(self) -> None:
         self._lib.kv_shutdown_servers(self._h)
 
+    def namespace(self, base: int, dim: int) -> "KVNamespace":
+        """A namespace-scoped view of this worker: ops address only the
+        ``[base, base + dim)`` flat-slot slice (see
+        :class:`KVNamespace`)."""
+        return KVNamespace(self, base, dim)
+
     def close(self) -> None:
         if self._h:
             self._lib.kv_close(self._h)
             self._h = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def namespace_layout(models, per_model_dim: int) -> dict[str, tuple[int, int]]:
+    """Pack equal-width model namespaces into one flat key space:
+    ``{model_id: (base, per_model_dim)}`` in spec order — namespace
+    ``i`` owns flat slots ``[i*D, (i+1)*D)``.  The TOTAL dim (what the
+    hosting :class:`~distlr_tpu.ps.ServerGroup` is spawned with) is
+    ``len(models) * per_model_dim``; spawn with ``num_servers`` such
+    that range boundaries stay vals_per_key-aligned per namespace
+    (equal-width namespaces + a server count dividing the model count,
+    or one server, always are)."""
+    if isinstance(models, str):
+        models = [m.strip() for m in models.split(",") if m.strip()]
+    models = list(models)
+    if not models:
+        raise ValueError("namespace layout needs at least one model id")
+    if len(set(models)) != len(models):
+        raise ValueError(f"duplicate model ids in {models}")
+    if per_model_dim <= 0:
+        raise ValueError(
+            f"per_model_dim must be positive, got {per_model_dim}")
+    return {m: (i * per_model_dim, per_model_dim)
+            for i, m in enumerate(models)}
+
+
+class KVNamespace:
+    """A model namespace inside one KV server group's key space.
+
+    Multi-tenant serving (ISSUE 10): one native server group hosts many
+    model namespaces by folding a tenant/version id into the KEYED key
+    space — namespace ``i`` owns a contiguous flat-slot slice, and this
+    view offsets every row key by the namespace base CLIENT-SIDE, the
+    same additive move ``vals_per_key`` made (the wire still carries
+    plain ascending keyed ops; pre-namespace servers need no change and
+    can never desynchronize).  The underlying :class:`KVWorker` is
+    connected with the group's TOTAL dim; this view presents the
+    namespace's ``dim`` through the same op surface the serving
+    reloader and the online trainer already consume.
+
+    Seeding: the group's ``initialized`` flag is global (first
+    ``kInitPush`` wins), so the FIRST namespace's idempotent seed
+    initializes the group and later namespaces' plain ``push_init``
+    calls no-op (their slices stay at the allocation zeros — exactly
+    what the zero-seeding online trainer expects).  A namespace seeding
+    NON-zero initial weights into an already-initialized group must
+    pass ``force=True`` (keyed ``kForceInit`` overwrites only this
+    namespace's keys).
+    """
+
+    def __init__(self, kv: KVWorker, base: int, dim: int):
+        if dim <= 0:
+            raise ValueError(f"namespace dim must be positive, got {dim}")
+        if base < 0 or base + dim > kv.dim:
+            raise ValueError(
+                f"namespace [{base}, {base + dim}) outside the group's "
+                f"key space [0, {kv.dim})")
+        self.kv = kv
+        self.base = int(base)
+        self.dim = int(dim)
+
+    @property
+    def num_servers(self) -> int:
+        return self.kv.num_servers
+
+    @property
+    def compress_active(self):
+        return self.kv.compress_active
+
+    def supports_vals_per_key(self, vpk: int) -> bool:
+        """vals_per_key rows work inside this namespace when they work
+        group-wide AND the namespace slice is row-aligned (base/dim
+        multiples of vpk) — otherwise row ids would shift lanes across
+        the base offset."""
+        if vpk <= 1:
+            return True
+        return (self.base % vpk == 0 and self.dim % vpk == 0
+                and self.kv.supports_vals_per_key(vpk))
+
+    # -- key translation ---------------------------------------------------
+    def _wire_keys(self, keys, vpk: int) -> np.ndarray:
+        """Namespace-local row keys -> group row keys.  ``keys=None`` is
+        the namespace's full row space (an EXPLICIT key frame — the
+        dense default set is a whole-group concept)."""
+        if self.base % vpk != 0 or self.dim % vpk != 0:
+            raise ValueError(
+                f"vals_per_key={vpk} does not align with namespace "
+                f"base={self.base}/dim={self.dim}")
+        rows = self.dim // vpk
+        shift = self.base // vpk
+        if keys is None:
+            return np.arange(shift, shift + rows, dtype=np.uint64)
+        keys = np.ascontiguousarray(keys, dtype=np.uint64)
+        if keys.size:
+            kmax = int(keys.max())
+            if kmax >= rows:
+                raise ValueError(
+                    f"key {kmax} outside namespace row space "
+                    f"[0, {rows}) (vals_per_key={vpk})")
+        return keys + np.uint64(shift)
+
+    # -- scoped ops --------------------------------------------------------
+    def pull(self, keys=None, *, vals_per_key: int = 1) -> np.ndarray:
+        vpk = int(vals_per_key)
+        return self.kv.pull(keys=self._wire_keys(keys, vpk),
+                            vals_per_key=vpk)
+
+    def pull_chunked(self, keys=None, *, vals_per_key: int = 1,
+                     chunk_rows: int = 1 << 16) -> np.ndarray:
+        vpk = int(vals_per_key)
+        return self.kv.pull_chunked(self._wire_keys(keys, vpk),
+                                    vals_per_key=vpk,
+                                    chunk_rows=chunk_rows)
+
+    def pull_rows_into(self, table: np.ndarray, keys: np.ndarray, *,
+                       vals_per_key: int = 1,
+                       chunk_rows: int = 1 << 16) -> int:
+        """Keyed hot-slice pull into a NAMESPACE-sized table (the
+        hot-set reloader's refresh, filtered to this namespace)."""
+        vpk = int(vals_per_key)
+        table = np.asarray(table)
+        if (table.dtype != np.float32 or table.size != self.dim
+                or not table.flags["C_CONTIGUOUS"]):
+            raise ValueError(
+                f"table must be C-contiguous float32 with {self.dim} "
+                f"elements, got {table.dtype} shape {table.shape}")
+        keys = np.ascontiguousarray(keys, dtype=np.uint64)
+        if keys.size == 0:
+            return 0
+        vals = self.pull_chunked(keys, vals_per_key=vpk,
+                                 chunk_rows=chunk_rows)
+        view = table.reshape(self.dim // vpk, vpk)
+        view[keys.astype(np.int64)] = vals.reshape(-1, vpk)
+        return int(keys.size)
+
+    def push(self, vals: np.ndarray, keys=None, *,
+             vals_per_key: int = 1) -> int:
+        vpk = int(vals_per_key)
+        return self.kv.push(vals, keys=self._wire_keys(keys, vpk),
+                            vals_per_key=vpk)
+
+    def push_init(self, vals: np.ndarray, keys=None, *,
+                  force: bool = False) -> int:
+        """Seed THIS namespace's slice (see the class docstring for the
+        multi-namespace init semantics)."""
+        return self.kv.push_init(vals, keys=self._wire_keys(keys, 1),
+                                 force=force)
+
+    # -- pass-through ------------------------------------------------------
+    def stats(self, server: int = 0) -> dict:
+        return self.kv.stats(server)
+
+    def global_pushes(self, **kw) -> float:
+        return self.kv.global_pushes(**kw)
+
+    def wait(self, ts: int) -> None:
+        self.kv.wait(ts)
+
+    def reconnect(self) -> None:
+        self.kv.reconnect()
+
+    def close(self) -> None:
+        self.kv.close()
 
     def __enter__(self):
         return self

@@ -27,6 +27,15 @@ _LAZY = {
     "ScoringRouter": "distlr_tpu.serve.router",
     "ScoringServer": "distlr_tpu.serve.server",
     "score_lines_over_tcp": "distlr_tpu.serve.server",
+    # multi-tenant serving (ISSUE 10) — all jax-free
+    "TenantQuota": "distlr_tpu.serve.tenant",
+    "ShadowMirror": "distlr_tpu.serve.tenant",
+    "parse_model_spec": "distlr_tpu.serve.tenant",
+    "parse_quota_spec": "distlr_tpu.serve.tenant",
+    "RolloutController": "distlr_tpu.serve.rollout",
+    "RouterAdmin": "distlr_tpu.serve.rollout",
+    "fleet_alert_poller": "distlr_tpu.serve.rollout",
+    "parse_stages": "distlr_tpu.serve.rollout",
 }
 
 __all__ = sorted(_LAZY)

@@ -105,13 +105,26 @@ class LivePSWatcher:
                  chunk_rows: int = 1 << 16, timeout_ms: int = 10_000,
                  client_id: int | None = None, hot_tracker=None,
                  min_coverage: float = 0.95, full_refresh_every: int = 10,
-                 retry=None):
+                 retry=None, ns_base: int = 0,
+                 ns_total_dim: int | None = None):
         from distlr_tpu.ps import KVWorker  # noqa: PLC0415
 
         self.hosts = hosts
         self.dim = dim
-        self.kv = KVWorker(
-            hosts, dim,
+        #: multi-tenant namespace scoping (ISSUE 10): when the group
+        #: hosts several model namespaces, ``ns_total_dim`` is the
+        #: group's TOTAL key space and ``[ns_base, ns_base + dim)`` the
+        #: slice this engine serves — every pull (full, chunked, and
+        #: hot-slice) addresses only that slice, so N versions' watchers
+        #: share one server group without reading each other's rows.
+        self.ns_base = int(ns_base)
+        self._wire_dim = int(ns_total_dim) if ns_total_dim else int(dim)
+        if self.ns_base < 0 or self.ns_base + dim > self._wire_dim:
+            raise ValueError(
+                f"namespace [{ns_base}, {ns_base + dim}) outside the "
+                f"group's key space [0, {self._wire_dim})")
+        worker = KVWorker(
+            hosts, self._wire_dim,
             client_id=self.SERVE_CLIENT_ID if client_id is None else client_id,
             timeout_ms=timeout_ms,
             # pull-only client: never votes in a BSP barrier, so the
@@ -122,6 +135,8 @@ class LivePSWatcher:
             # instead of failing the cycle
             retry=retry,
         )
+        self.kv = (worker if self._wire_dim == dim and not self.ns_base
+                   else worker.namespace(self.ns_base, dim))
         # A failed poll leaves the native handle poisoned (every later
         # op on that stream fails fast).  Without this flag the watcher
         # would be dead FOREVER after one blip — the server would serve
@@ -275,7 +290,7 @@ class LivePSWatcher:
         try:
             # a FRESH short-lived probe: this watcher's own handle may be
             # poisoned by the very failure being diagnosed
-            with KVWorker(self.hosts, self.dim,
+            with KVWorker(self.hosts, self._wire_dim,
                           client_id=self.SERVE_CLIENT_ID,
                           timeout_ms=2000) as probe:
                 # every rank, like the init gate: one unseeded rank is
@@ -302,6 +317,8 @@ class LivePSWatcher:
             "last_kind": self.last_kind,
             "last_rows": self.last_rows,
         }
+        if self.ns_base or self._wire_dim != self.dim:
+            rec["namespace"] = [self.ns_base, self.dim, self._wire_dim]
         if self.hot_tracker is not None:
             rec["hot_set"] = self.hot_tracker.stats()
         return rec

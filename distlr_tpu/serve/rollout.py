@@ -1,0 +1,334 @@
+"""Canary ramps with automatic rollback — safe version rollout.
+
+The control loop that makes a new model version take real traffic
+safely: ``launch rollout`` drives the routing tier's SPLIT/PROMOTE
+admin lines (:mod:`distlr_tpu.serve.router`) through a STAGED weight
+ramp — e.g. 5% -> 25% -> 50% -> 100% with a hold at each stage — while
+polling the fleet's derived ``distlr_alert_*`` gauges (``launch
+obs-agg``'s ``/fleet.json``: latency, error rate, score drift, shadow
+PSI — whatever thresholds the run bound).  Any bound alert firing
+mid-ramp triggers an automatic ROLLBACK: the split clears in one admin
+round trip, the primary never stopped serving, and the journal records
+exactly what fired.
+
+Every transition is journaled to ``<obs_run_dir>/rollout/`` as JSONL —
+a ramp is replayable and auditable: who ramped what, through which
+weights, what the alerts said at each hold, and how it ended
+(``promoted`` / ``rolled_back`` / ``aborted``).
+
+Jax-free and stdlib-only (like the router and obs-agg): the controller
+is control-plane — it must keep working while the fleet it is ramping
+is on fire.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import socket
+import time
+import urllib.request
+
+from distlr_tpu.obs.registry import get_registry
+from distlr_tpu.utils.logging import get_logger
+
+log = get_logger(__name__)
+
+_reg = get_registry()
+_ROLLOUT_WEIGHT = _reg.gauge(
+    "distlr_rollout_weight",
+    "current canary split weight of an in-flight rollout (candidate "
+    "share of the tenant's traffic; 0 after a rollback)",
+    labelnames=("tenant", "candidate"),
+)
+_ROLLOUT_TRANSITIONS = _reg.counter(
+    "distlr_rollout_transitions_total",
+    "rollout state transitions by event (start/stage/promote/"
+    "rollback/abort)",
+    labelnames=("event",),
+)
+_ROLLOUT_ROLLBACKS = _reg.counter(
+    "distlr_rollout_rollbacks_total",
+    "canary ramps rolled back automatically by a firing alert gauge",
+    labelnames=("tenant", "candidate"),
+)
+
+
+def parse_stages(spec: str) -> list[tuple[float, float]]:
+    """``"0.05:10,0.25:10,1.0:30"`` -> ``[(weight, hold_s), ...]``.
+    Weights must be ascending in (0, 1] and the last must be 1.0 (a
+    ramp that never reaches full weight cannot promote)."""
+    stages: list[tuple[float, float]] = []
+    for part in str(spec).split(","):
+        part = part.strip()
+        if not part:
+            continue
+        w, _, hold = part.partition(":")
+        try:
+            weight = float(w)
+            hold_s = float(hold) if hold else 5.0
+        except ValueError as e:
+            raise ValueError(f"bad stage {part!r}: {e}") from None
+        if not 0.0 < weight <= 1.0:
+            raise ValueError(f"stage weight must be in (0, 1], got {weight}")
+        if hold_s < 0:
+            raise ValueError(f"stage hold must be >= 0s, got {hold_s}")
+        stages.append((weight, hold_s))
+    if not stages:
+        raise ValueError("ramp needs at least one stage")
+    if any(b[0] <= a[0] for a, b in zip(stages, stages[1:])):
+        raise ValueError(f"stage weights must ascend, got {spec!r}")
+    if stages[-1][0] != 1.0:
+        raise ValueError(
+            f"last stage must be weight 1.0 (full cut-over), got "
+            f"{stages[-1][0]}")
+    return stages
+
+
+def fleet_alert_poller(fleet_url: str, *, names=None,
+                       prefix: str = "distlr_alert_",
+                       timeout_s: float = 2.0):
+    """An ``alert_poll`` callable over a running ``launch obs-agg``:
+    returns the firing alert names (``name{labels}``) bound by ``names``
+    (exact names) or ``prefix``.  An UNREACHABLE aggregator reports a
+    synthetic ``rollout_fleet_unreachable`` alert — ramping blind is
+    exactly when a bad candidate does the most damage, so a dead
+    observability plane fails the ramp safe."""
+    url = fleet_url.rstrip("/") + "/fleet.json"
+    bound = set(names) if names else None
+
+    def poll() -> list[str]:
+        try:
+            with urllib.request.urlopen(url, timeout=timeout_s) as r:
+                doc = json.load(r)
+        except (OSError, ValueError):
+            return ["rollout_fleet_unreachable"]
+        firing = []
+        for a in doc.get("alerts", []):
+            if not a.get("firing"):
+                continue
+            name = a.get("name", "")
+            if bound is not None:
+                if name not in bound:
+                    continue
+            elif not name.startswith(prefix):
+                continue
+            labels = a.get("labels") or {}
+            shown = ",".join(f"{k}={v}" for k, v in sorted(labels.items())
+                             if k != "threshold")
+            firing.append(f"{name}{{{shown}}}" if shown else name)
+        return firing
+
+    return poll
+
+
+class RouterAdmin:
+    """Tiny line-protocol client for the router's admin verbs (one
+    connection per call — the ramp sends a handful of lines over
+    minutes, pooling would buy nothing)."""
+
+    def __init__(self, host: str, port: int, *, timeout_s: float = 10.0):
+        self.host, self.port = host, int(port)
+        self.timeout_s = float(timeout_s)
+
+    def send(self, line: str) -> str:
+        with socket.create_connection((self.host, self.port),
+                                      timeout=self.timeout_s) as s:
+            f = s.makefile("rwb")
+            f.write((line.strip() + "\n").encode())
+            f.flush()
+            reply = f.readline()
+        if not reply:
+            raise ConnectionError(
+                f"router {self.host}:{self.port} closed mid-exchange")
+        return reply.decode().rstrip("\n")
+
+    def expect_ok(self, line: str) -> str:
+        reply = self.send(line)
+        if not reply.startswith("OK"):
+            raise RuntimeError(f"router refused {line.split()[0]}: {reply}")
+        return reply
+
+    def models(self) -> dict:
+        return json.loads(self.send("MODELS"))
+
+
+class RolloutController:
+    """One canary ramp: tenant -> candidate through staged weights.
+
+    ``alert_poll``: zero-arg callable returning the CURRENTLY FIRING
+    bound alert names (see :func:`fleet_alert_poller`); None = ramp on
+    a timer alone (tests, fleets without an aggregator — logged loudly,
+    because an unwatched ramp is just a slow deploy).
+    """
+
+    def __init__(self, admin: RouterAdmin, tenant: str, candidate: str,
+                 stages, *, alert_poll=None, poll_interval_s: float = 0.5,
+                 shadow_fraction: float = 0.0, journal_dir: str | None = None,
+                 settle_s: float = 0.0):
+        if isinstance(stages, str):
+            stages = parse_stages(stages)
+        if not stages:
+            raise ValueError("ramp needs at least one stage")
+        self.admin = admin
+        self.tenant = str(tenant)
+        self.candidate = str(candidate)
+        self.stages = [(float(w), float(h)) for w, h in stages]
+        self.alert_poll = alert_poll
+        self.poll_interval_s = float(poll_interval_s)
+        self.shadow_fraction = float(shadow_fraction)
+        self.settle_s = float(settle_s)
+        self.journal_path: str | None = None
+        if journal_dir:
+            rollout_dir = os.path.join(journal_dir, "rollout")
+            os.makedirs(rollout_dir, exist_ok=True)
+            seq = 0
+            for name in os.listdir(rollout_dir):
+                m = re.match(r"ramp-(\d+)\.jsonl$", name)
+                if m:
+                    seq = max(seq, int(m.group(1)) + 1)
+            self.journal_path = os.path.join(rollout_dir,
+                                             f"ramp-{seq:04d}.jsonl")
+        self._weight_g = _ROLLOUT_WEIGHT.labels(tenant=self.tenant,
+                                                candidate=self.candidate)
+        self.transitions: list[dict] = []
+
+    # -- journal -----------------------------------------------------------
+    def _journal(self, event: str, **detail) -> dict:
+        doc = {"t": round(time.time(), 3), "event": event,
+               "tenant": self.tenant, "candidate": self.candidate, **detail}
+        self.transitions.append(doc)
+        _ROLLOUT_TRANSITIONS.labels(event=event).inc()
+        if self.journal_path:
+            with open(self.journal_path, "a") as f:
+                f.write(json.dumps(doc) + "\n")
+        return doc
+
+    # -- alert gate --------------------------------------------------------
+    def _firing(self) -> list[str]:
+        if self.alert_poll is None:
+            return []
+        try:
+            return list(self.alert_poll())
+        except Exception as e:  # noqa: BLE001 — poller bugs fail SAFE
+            return [f"rollout_alert_poll_failed:{type(e).__name__}"]
+
+    def _hold(self, hold_s: float) -> list[str]:
+        """Hold at the current weight, polling alerts; returns the
+        firing set that broke the hold ([] = held clean)."""
+        deadline = time.monotonic() + hold_s
+        while True:
+            firing = self._firing()
+            if firing:
+                return firing
+            if time.monotonic() >= deadline:
+                return []
+            time.sleep(min(self.poll_interval_s,
+                           max(0.0, deadline - time.monotonic())))
+
+    # -- the ramp ----------------------------------------------------------
+    def run(self) -> dict:
+        """Drive the ramp to promotion or rollback.  Returns the outcome
+        doc (also the journal's last line): ``outcome`` is ``promoted``,
+        ``rolled_back`` (with ``alerts`` + the stage it died at), or
+        ``aborted`` (pre-ramp alerts / registry problems)."""
+        reg = self.admin.models()
+        hosted = reg.get("models", {})
+        for m in (self.tenant, self.candidate):
+            if m not in hosted:
+                self._journal("abort", reason=f"unknown model {m!r}")
+                return {"outcome": "aborted",
+                        "reason": f"model {m!r} not registered "
+                                  f"(hosted: {sorted(hosted)})"}
+        if hosted[self.candidate].get("up", 0) < 1:
+            self._journal("abort", reason="candidate has no healthy replica")
+            return {"outcome": "aborted",
+                    "reason": f"candidate {self.candidate!r} has no "
+                              "healthy replica — nothing to ramp onto"}
+        firing = self._firing()
+        if firing:
+            self._journal("abort", reason="alerts firing pre-ramp",
+                          alerts=firing)
+            return {"outcome": "aborted", "alerts": firing,
+                    "reason": "bound alerts already firing before the "
+                              "ramp started — fix the fleet first"}
+        if self.alert_poll is None:
+            log.warning("ramp %s -> %s runs UNWATCHED (no alert poller): "
+                        "rollback can only be manual", self.tenant,
+                        self.candidate)
+        self._journal("start",
+                      stages=[[w, h] for w, h in self.stages],
+                      shadow=self.shadow_fraction or None,
+                      watched=self.alert_poll is not None)
+        if self.shadow_fraction > 0:
+            # observe the candidate against live traffic BEFORE it takes
+            # any: the shadow PSI gauge is one of the alert inputs a
+            # threshold can bind
+            try:
+                self.admin.expect_ok(
+                    f"SHADOW {self.tenant} {self.candidate} "
+                    f"{self.shadow_fraction:g}")
+            except (OSError, RuntimeError) as e:
+                return self._rollback(
+                    "shadow", [f"rollout_admin_failed:{e}"])
+            if self.settle_s > 0:
+                broke = self._hold(self.settle_s)
+                if broke:
+                    return self._rollback("shadow", broke)
+        for i, (weight, hold_s) in enumerate(self.stages):
+            try:
+                self.admin.expect_ok(
+                    f"SPLIT {self.tenant} {self.candidate} {weight:g}")
+            except (OSError, RuntimeError) as e:
+                # a failed admin exchange mid-ramp must NOT leave the
+                # previous stage's split live and unwatched — roll back
+                # (best-effort: _rollback journals its own failure too)
+                return self._rollback(i, [f"rollout_admin_failed:{e}"])
+            self._weight_g.set(weight)
+            self._journal("stage", stage=i, weight=weight, hold_s=hold_s)
+            log.info("ramp %s -> %s: stage %d/%d at weight %.2f "
+                     "(hold %.1fs)", self.tenant, self.candidate, i + 1,
+                     len(self.stages), weight, hold_s)
+            broke = self._hold(hold_s)
+            if broke:
+                return self._rollback(i, broke)
+        try:
+            self.admin.expect_ok(f"PROMOTE {self.tenant} {self.candidate}")
+        except (OSError, RuntimeError) as e:
+            # the ramp is at full weight but the cut-over failed: clear
+            # the split rather than serving 100% canary indefinitely
+            return self._rollback(len(self.stages) - 1,
+                                  [f"rollout_admin_failed:{e}"])
+        if self.shadow_fraction > 0:
+            # promote already clears tenant state router-side; belt and
+            # braces for older routers is one cheap idempotent line
+            try:
+                self.admin.send(f"SHADOW {self.tenant} {self.candidate} 0")
+            except OSError:
+                pass
+        self._weight_g.set(0.0)
+        doc = self._journal("promote")
+        log.info("ramp %s -> %s: PROMOTED (%d stages clean)", self.tenant,
+                 self.candidate, len(self.stages))
+        return {"outcome": "promoted", "stages": len(self.stages),
+                "journal": self.journal_path, "transitions": doc["t"]}
+
+    def _rollback(self, stage, alerts: list[str]) -> dict:
+        try:
+            self.admin.expect_ok(
+                f"SPLIT {self.tenant} {self.candidate} 0")
+            if self.shadow_fraction > 0:
+                self.admin.send(f"SHADOW {self.tenant} {self.candidate} 0")
+        except (OSError, RuntimeError) as e:
+            # the rollback line itself failed — journal it; the router
+            # may be down (in which case no split is being served either)
+            self._journal("rollback_error", error=str(e))
+        self._weight_g.set(0.0)
+        _ROLLOUT_ROLLBACKS.labels(tenant=self.tenant,
+                                  candidate=self.candidate).inc()
+        self._journal("rollback", stage=stage, alerts=alerts)
+        log.warning("ramp %s -> %s: ROLLED BACK at stage %s — firing: %s",
+                    self.tenant, self.candidate, stage, ", ".join(alerts))
+        return {"outcome": "rolled_back", "stage": stage, "alerts": alerts,
+                "journal": self.journal_path}

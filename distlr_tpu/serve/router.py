@@ -35,7 +35,21 @@ Speaks exactly the replica line protocol (libsvm line / JSON batch /
   are caller-minted; tracking them would make the router stateful).
   The reply is the best outcome any replica reported (``joined`` >
   ``duplicate`` > ``pending``); replicas that never saw the id answer
-  ``pending`` and age the orphan label out of their window.
+  ``pending`` and age the orphan label out of their window.  A
+  ``MODEL``-scoped connection fans only to that model's replicas.
+* **multi-tenant model registry** (additive, like STATS/TRACE) — the
+  replica spec may name several model versions
+  (``v1=h:p+h:p,v2=h:p``, :func:`distlr_tpu.serve.tenant.
+  parse_model_spec`); requests address a version by ``MODEL <id>``
+  connection scoping or a per-request ``@<id>`` prefix, each tenant
+  can carry a token-bucket admission quota (``ERR SHED tenant`` —
+  its own counter, distinct from capacity sheds), a SHADOW mirror
+  (a fraction of the tenant's traffic replayed fire-and-forget
+  against a candidate version, score distributions compared via PSI,
+  never touching the primary reply), and a SPLIT (the canary ramp's
+  weighted primary/candidate routing, driven by ``launch rollout``
+  over the same line protocol: ``SPLIT``/``SHADOW``/``PROMOTE``/
+  ``MODELS`` admin lines).
 
 Stdlib-only and jax-free: ``python -m distlr_tpu.launch route`` starts
 in well under a second and never competes with replicas for a chip.
@@ -44,6 +58,7 @@ in well under a second and never competes with replicas for a chip.
 from __future__ import annotations
 
 import json
+import random
 import socket
 import socketserver
 import threading
@@ -51,6 +66,7 @@ import time
 
 from distlr_tpu.obs import dtrace
 from distlr_tpu.obs.registry import get_registry
+from distlr_tpu.serve import tenant as _tenant
 from distlr_tpu.utils.logging import get_logger
 
 log = get_logger(__name__)
@@ -125,6 +141,12 @@ class _Replica:
         self.addr = addr
         self.host, self.port = host, int(port)
         self.timeout_s = timeout_s
+        #: model ids this address is registered under (multi-tenant):
+        #: an address under SEVERAL ids hosts multiple engines and gets
+        #: @-addressed lines; an address under exactly one id serves
+        #: that model as its default engine and gets bare lines — so
+        #: pre-tenant replicas interop byte-identically
+        self.models: set[str] = set()
         self._sem = threading.BoundedSemaphore(max_inflight)
         self._pool_lock = threading.Lock()
         self._idle: list[tuple] = []
@@ -232,7 +254,14 @@ class _Replica:
 
 class _RouterHandler(socketserver.StreamRequestHandler):
     def handle(self):
+        try:
+            self._serve_lines()
+        except ConnectionResetError:
+            pass  # peer RST mid-read (client died, chaos reset): not an error
+
+    def _serve_lines(self):
         router: ScoringRouter = self.server.router  # type: ignore[attr-defined]
+        scope: str | None = None  # MODEL <id> connection scoping
         for raw in self.rfile:
             try:
                 line = raw.decode("utf-8", errors="replace").strip()
@@ -240,7 +269,10 @@ class _RouterHandler(socketserver.StreamRequestHandler):
                 continue
             if not line:
                 continue
-            reply = router.handle_line(line)
+            if line == "MODEL" or line.startswith("MODEL "):
+                reply, scope = router.handle_model_line(line, scope)
+            else:
+                reply = router.handle_line(line, model=scope)
             try:
                 self.wfile.write((reply + "\n").encode())
                 self.wfile.flush()
@@ -258,7 +290,15 @@ class ScoringRouter:
 
     ``replicas``: list (or comma-separated string) of ``host:port``
     addresses of running :class:`ScoringServer` listeners (or nested
-    routers — the protocol is identical).
+    routers — the protocol is identical), or a multi-model registry
+    spec / mapping (``v1=h:p+h:p,v2=h:p`` — see
+    :func:`distlr_tpu.serve.tenant.parse_model_spec`).  One address may
+    serve several models (a :class:`ScoringServer` hosting multiple
+    engines): it shares ONE health state and in-flight budget.
+
+    ``quotas``: per-tenant token-bucket admission
+    (``model=rate[:burst]`` spec or a ready mapping — see
+    :func:`distlr_tpu.serve.tenant.parse_quota_spec`).
     """
 
     def __init__(self, replicas, *, host: str = "127.0.0.1", port: int = 0,
@@ -266,13 +306,10 @@ class ScoringRouter:
                  health_interval_s: float = 1.0,
                  probe_backoff_s: float = 0.5,
                  probe_backoff_max_s: float = 30.0,
-                 backend_timeout_s: float = 30.0, retries: int = 1):
-        if isinstance(replicas, str):
-            replicas = [a.strip() for a in replicas.split(",") if a.strip()]
-        if not replicas:
-            raise ValueError("router needs at least one replica address")
-        if len(set(replicas)) != len(replicas):
-            raise ValueError(f"duplicate replica addresses in {replicas}")
+                 backend_timeout_s: float = 30.0, retries: int = 1,
+                 quotas=None, shadow_block: int = 256,
+                 shadow_queue_max: int = 256, seed: int | None = None):
+        models = _tenant.parse_model_spec(replicas)
         if max_inflight <= 0:
             raise ValueError(f"max_inflight must be positive, got {max_inflight}")
         if eject_after < 1:
@@ -286,10 +323,43 @@ class ScoringRouter:
                 f"{probe_backoff_s}/{probe_backoff_max_s}")
         if retries < 0:
             raise ValueError(f"retries must be >= 0, got {retries}")
-        self.replicas = [
-            _Replica(a, max_inflight=max_inflight, timeout_s=backend_timeout_s)
-            for a in replicas
-        ]
+        by_addr: dict[str, _Replica] = {}
+        self._model_replicas: dict[str, list[_Replica]] = {}
+        for model, addrs in models.items():
+            reps = []
+            for a in addrs:
+                rep = by_addr.get(a)
+                if rep is None:
+                    rep = by_addr[a] = _Replica(
+                        a, max_inflight=max_inflight,
+                        timeout_s=backend_timeout_s)
+                rep.models.add(model)
+                reps.append(rep)
+            self._model_replicas[model] = reps
+        self.replicas = list(by_addr.values())
+        self.model_ids = list(models)
+        self.default_model = self.model_ids[0]
+        self.quotas = _tenant.parse_quota_spec(quotas)
+        unknown = sorted(set(self.quotas) - set(self.model_ids))
+        if unknown:
+            raise ValueError(
+                f"quota names unregistered model(s) {unknown}; hosted: "
+                f"{self.model_ids}")
+        #: canary split / shadow state: tenant -> (candidate, fraction)
+        self._splits: dict[str, tuple[str, float]] = {}
+        self._shadows: dict[str, tuple[str, float]] = {}
+        #: post-PROMOTE identity: tenant -> the model id its traffic is
+        #: actually addressed as on the wire (replica-list swap alone is
+        #: not enough — one address can host BOTH engines, and the
+        #: promoted tenant's lines must select the candidate's engine)
+        self._serve_as: dict[str, str] = {}
+        self._rng = random.Random(seed)
+        self._per_model = {m: {"requests": 0, "shed": 0}
+                           for m in self.model_ids}
+        self._shadow_block = int(shadow_block)
+        self._shadow_queue_max = int(shadow_queue_max)
+        self._shadow_mirror: _tenant.ShadowMirror | None = None
+        _tenant.set_model_count(len(self.model_ids))
         self.max_inflight = int(max_inflight)
         self.eject_after = int(eject_after)
         self.health_interval_s = float(health_interval_s)
@@ -326,11 +396,15 @@ class ScoringRouter:
             target=self._health_loop, daemon=True, name="distlr-route-health")
 
     # -- replica selection / health ---------------------------------------
-    def _acquire(self, excluded: list) -> _Replica | None:
-        """A healthy replica with a free in-flight slot: least in-flight
-        first, rotating tie-break so serial traffic still spreads."""
+    def _acquire(self, excluded: list,
+                 model: str | None = None) -> _Replica | None:
+        """A healthy replica (of ``model``'s registry slice when given)
+        with a free in-flight slot: least in-flight first, rotating
+        tie-break so serial traffic still spreads."""
         with self._lock:
-            cands = [r for r in self.replicas
+            pool = (self.replicas if model is None
+                    else self._model_replicas.get(model, []))
+            cands = [r for r in pool
                      if r.healthy and r not in excluded]
             if not cands:
                 return None
@@ -443,9 +517,11 @@ class ScoringRouter:
     #: (someone already joined it) beats a pending hold
     _LABEL_ORDER = {"joined": 0, "duplicate": 1, "pending": 2}
 
-    def _broadcast_label(self, line: str) -> str:
+    def _broadcast_label(self, line: str, model: str | None = None) -> str:
         with self._lock:
-            targets = [r for r in self.replicas if r.healthy]
+            pool = (self.replicas if model is None
+                    else self._model_replicas.get(model, []))
+            targets = [r for r in pool if r.healthy]
         best: str | None = None
         for rep in targets:
             with self._lock:
@@ -483,8 +559,136 @@ class ScoringRouter:
         return ("ERR LABEL: no replica accepted the label (are the "
                 "replicas running a feedback sink?)")
 
+    # -- multi-tenant control plane ---------------------------------------
+    def handle_model_line(self, line: str,
+                          scope: str | None) -> tuple[str, str | None]:
+        """``MODEL <id>`` connection scoping (additive): subsequent
+        unaddressed lines route to that model's replicas.  Returns
+        ``(reply, new_scope)`` — an unknown id keeps the old scope."""
+        parts = line.split()
+        if len(parts) != 2:
+            self._errors_c.inc()
+            return "ERR MODEL: need MODEL <id>", scope
+        if parts[1] not in self._model_replicas:
+            self._errors_c.inc()
+            return (f"ERR MODEL: unknown model {parts[1]!r} (hosted: "
+                    f"{','.join(self.model_ids)})", scope)
+        return f"OK MODEL {parts[1]}", parts[1]
+
+    def _check_models_locked(self, tenant: str, candidate: str) -> None:
+        for m in (tenant, candidate):
+            if m not in self._model_replicas:
+                raise ValueError(
+                    f"unknown model {m!r} (hosted: "
+                    f"{','.join(self.model_ids)})")
+        if tenant == candidate:
+            raise ValueError(f"tenant and candidate are both {tenant!r}")
+
+    def set_split(self, tenant: str, candidate: str, weight: float) -> None:
+        """Canary split: route ``weight`` of ``tenant``'s scoring
+        traffic to ``candidate``; 0 clears (the rollback)."""
+        if not 0.0 <= weight <= 1.0:
+            raise ValueError(f"weight must be in [0, 1], got {weight}")
+        with self._lock:
+            self._check_models_locked(tenant, candidate)
+            if weight == 0.0:
+                self._splits.pop(tenant, None)
+            else:
+                self._splits[tenant] = (candidate, float(weight))
+        log.info("split: %s -> %s at %.3f", tenant, candidate, weight)
+
+    def set_shadow(self, tenant: str, candidate: str,
+                   fraction: float) -> None:
+        """Shadow mirror: replay ``fraction`` of ``tenant``'s scoring
+        traffic against ``candidate`` off the reply path; 0 clears."""
+        if not 0.0 <= fraction <= 1.0:
+            raise ValueError(f"fraction must be in [0, 1], got {fraction}")
+        with self._lock:
+            self._check_models_locked(tenant, candidate)
+            if fraction == 0.0:
+                self._shadows.pop(tenant, None)
+            else:
+                self._shadows[tenant] = (candidate, float(fraction))
+                if self._shadow_mirror is None:
+                    self._shadow_mirror = _tenant.ShadowMirror(
+                        self._exchange_for_model,
+                        queue_max=self._shadow_queue_max,
+                        block=self._shadow_block)
+        log.info("shadow: %s -> %s at %.3f", tenant, candidate, fraction)
+
+    def promote(self, tenant: str, candidate: str) -> None:
+        """The ramp's terminal transition: ``tenant``'s registry slice
+        becomes ``candidate``'s replicas (the candidate version now IS
+        the tenant's primary); any split/shadow for the tenant clears.
+        The candidate id stays addressable — old version replicas are
+        simply no longer reachable under the tenant's id."""
+        with self._lock:
+            self._check_models_locked(tenant, candidate)
+            self._model_replicas[tenant] = list(
+                self._model_replicas[candidate])
+            self._serve_as[tenant] = self._serve_as.get(candidate,
+                                                        candidate)
+            self._splits.pop(tenant, None)
+            self._shadows.pop(tenant, None)
+        log.info("promoted: %s now serves %s's replicas", tenant, candidate)
+
+    def _handle_admin(self, line: str) -> str:
+        parts = line.split()
+        verb = parts[0]
+        try:
+            if verb in ("SPLIT", "SHADOW"):
+                if len(parts) != 4:
+                    raise ValueError(
+                        f"need {verb} <tenant> <candidate> <fraction>")
+                frac = float(parts[3])
+                (self.set_split if verb == "SPLIT"
+                 else self.set_shadow)(parts[1], parts[2], frac)
+                return f"OK {verb} {parts[1]} {parts[2]} {frac:g}"
+            if len(parts) != 3:
+                raise ValueError("need PROMOTE <tenant> <candidate>")
+            self.promote(parts[1], parts[2])
+            return f"OK PROMOTE {parts[1]} {parts[2]}"
+        except ValueError as e:
+            self._errors_c.inc()
+            return f"ERR {verb}: {e}"
+
+    def models_json(self) -> dict:
+        """The registry as the ``MODELS`` reply (what ``launch rollout``
+        reads before ramping)."""
+        with self._lock:
+            return {
+                "default": self.default_model,
+                "models": {
+                    m: {
+                        "replicas": [r.addr for r in reps],
+                        "up": sum(r.healthy for r in reps),
+                    }
+                    for m, reps in self._model_replicas.items()
+                },
+                "splits": {t: list(sc) for t, sc in self._splits.items()},
+                "shadows": {t: list(sc) for t, sc in self._shadows.items()},
+                "serves_as": dict(self._serve_as),
+            }
+
+    def _exchange_for_model(self, model: str, line: str) -> str:
+        """One admission-controlled exchange toward a model's replicas
+        (the shadow mirror's send path): no retry, failures raise."""
+        rep = self._acquire([], model)
+        if rep is None:
+            raise ConnectionError(f"no capacity toward model {model!r}")
+        try:
+            wire = f"@{model} {line}" if len(rep.models) > 1 else line
+            reply = rep.exchange(wire)
+        except Exception:
+            self._note_failure(rep)
+            raise
+        finally:
+            self._release(rep)
+        self._note_success(rep)
+        return reply
+
     # -- request path ------------------------------------------------------
-    def handle_line(self, line: str) -> str:
+    def handle_line(self, line: str, model: str | None = None) -> str:
         """One routed line.  Scoring requests mint (or join, via an
         incoming ``TRACE <tid>/<sid>`` prefix from a parent router or a
         traced client) a distributed-trace context; sampled contexts are
@@ -492,11 +696,30 @@ class ScoringRouter:
         one trace follows the request through router -> engine -> (via
         the feedback loop) the PS wire.  LABEL lines continue their
         REQUEST's trace at the scoring replica instead of minting one,
-        and replies never carry the prefix."""
+        and replies never carry the prefix.  ``model`` is the
+        connection's ``MODEL`` scope; a per-request ``@<id>`` prefix
+        (parsed after TRACE) overrides it."""
         if line == "STATS":
             return json.dumps(self.stats())
+        if line == "MODELS":
+            return json.dumps(self.models_json())
+        if line.startswith(("SPLIT ", "SHADOW ", "PROMOTE ")):
+            return self._handle_admin(line)
+        if line.startswith("@"):
+            # a model-ADDRESSED label must broadcast to that model's
+            # replicas like a scoped one — falling through to the
+            # scoring path would deliver it to exactly one replica and
+            # strand it in every other's pending buffer
+            prefix, _, rest = line.partition(" ")
+            if rest.startswith("LABEL ") or rest == "LABEL":
+                mid = prefix[1:]
+                if mid not in self._model_replicas:
+                    self._errors_c.inc()
+                    return (f"ERR MODEL: unknown model {mid!r} (hosted: "
+                            f"{','.join(self.model_ids)})")
+                return self._broadcast_label(rest, mid)
         if line.startswith("LABEL ") or line == "LABEL":
-            return self._broadcast_label(line)
+            return self._broadcast_label(line, model)
         ctx = None
         if line.startswith("TRACE "):
             parts = line.split(" ", 2)
@@ -512,31 +735,76 @@ class ScoringRouter:
         else:
             ctx = dtrace.new_trace()  # None until dtrace.configure ran
         if ctx is None:
-            return self._route_line(line)
+            return self._route_line(line, model)
         with dtrace.use(ctx), dtrace.span(
                 "route.request",
                 tags={"listener": f"{self.host}:{self.port}"}) as sp:
-            reply = self._route_line(line)
+            reply = self._route_line(line, model)
             if reply.startswith("ERR "):
                 sp.tags["error"] = reply.split(":", 1)[0]
             return reply
 
-    def _route_line(self, line: str) -> str:
+    def _route_line(self, line: str, scope: str | None = None) -> str:
+        # tenant resolution: @-prefix > connection scope > default model
+        if line.startswith("@"):
+            prefix, _, rest = line.partition(" ")
+            tenant, line = prefix[1:], rest.strip()
+            if not tenant or not line:
+                self._errors_c.inc()
+                return "ERR MODEL: need @<id> <request line>"
+            if tenant not in self._model_replicas:
+                self._errors_c.inc()
+                return (f"ERR MODEL: unknown model {tenant!r} (hosted: "
+                        f"{','.join(self.model_ids)})")
+        else:
+            tenant = scope if scope is not None else self.default_model
+        # per-tenant admission quota, BEFORE any replica is touched: a
+        # tenant over budget must not consume in-flight slots.  The
+        # reply is deliberately distinct from the capacity shed — quota
+        # = "this tenant is over budget", capacity = "scale the tier up"
+        q = self.quotas.get(tenant)
+        if q is not None and not q.try_admit():
+            _tenant.count_tenant_shed(tenant)
+            with self._lock:
+                self._per_model[tenant]["shed"] += 1
+            return (f"ERR SHED tenant: {tenant!r} over admission quota "
+                    f"({q.rate:g} req/s)")
+        # canary split: a fraction of the tenant's traffic serves from
+        # the candidate version's replicas (weighted draw per request)
+        with self._lock:
+            split = self._splits.get(tenant)
+            shadow = self._shadows.get(tenant)
+            serve_model = tenant
+            if split is not None and self._rng.random() < split[1]:
+                serve_model = split[0]
+            # canary-served requests don't mirror (candidate vs
+            # candidate would read as perfect agreement) — decided
+            # BEFORE the serve_as remap, which renames the PROMOTED
+            # tenant's own primary and must not disable its shadow
+            canary = serve_model != tenant
+            # post-PROMOTE identity: the tenant's traffic addresses the
+            # promoted version's engine on the wire
+            serve_model = self._serve_as.get(serve_model, serve_model)
+            mirror = (shadow is not None and not canary
+                      and self._rng.random() < shadow[1])
         # sampled context -> the replica exchange carries the additive
         # prefix (the replica strips it; retries resend it verbatim —
-        # scores are idempotent and the span ids do not change)
+        # scores are idempotent and the span ids do not change).  The
+        # @-model prefix is PER REPLICA (below): only addresses hosting
+        # several models need it — a pre-tenant single-engine replica
+        # keeps parsing every byte it always parsed
         tok = dtrace.token()
-        wire = f"TRACE {tok} {line}" if tok else line
         t0 = time.monotonic()
         excluded: list[_Replica] = []
         last_err = "no healthy replica in rotation"
         shed_only = True  # every failure so far was overload, not death
         for attempt in range(self._retries + 1):
-            rep = self._acquire(excluded)
+            rep = self._acquire(excluded, serve_model)
             if rep is None:
                 if attempt == 0:
                     with self._lock:
-                        any_healthy = any(r.healthy for r in self.replicas)
+                        pool = self._model_replicas.get(serve_model, [])
+                        any_healthy = any(r.healthy for r in pool)
                     if not any_healthy:
                         # total outage, not overload: shed means "scale
                         # up"; this means "the tier is down" — it must
@@ -554,6 +822,9 @@ class ScoringRouter:
                 # acquired — a failed exchange with nowhere to go is an
                 # error, not a retry
                 self._retries_c.inc()
+            routed = (f"@{serve_model} {line}" if len(rep.models) > 1
+                      else line)
+            wire = f"TRACE {tok} {routed}" if tok else routed
             try:
                 reply = rep.exchange(wire)
             except Exception as e:  # noqa: BLE001 — any transport failure
@@ -580,6 +851,16 @@ class ScoringRouter:
             self._note_success(rep)
             self._req_seconds.observe(time.monotonic() - t0)
             self._requests_c.inc()
+            _tenant.count_request(tenant)
+            with self._lock:
+                self._per_model[tenant]["requests"] += 1
+            if mirror:
+                # fire-and-forget, strictly AFTER the reply is final:
+                # nothing below can change the bytes the client gets
+                scores = _tenant.extract_scores(reply)
+                sm = self._shadow_mirror
+                if scores and sm is not None:
+                    sm.submit(tenant, shadow[0], line, scores)
             return reply
         if shed_only and excluded:
             # every tried child shed: the tier-wide truth is still
@@ -608,7 +889,24 @@ class ScoringRouter:
                 "ejections": r.ejections,
                 "reinstates": r.reinstates,
             } for r in self.replicas]
-        return {
+            per_model = {}
+            for m in self.model_ids:
+                pool = self._model_replicas[m]
+                pm = {
+                    "requests": self._per_model[m]["requests"],
+                    "shed": self._per_model[m]["shed"],
+                    "replicas": len(pool),
+                    "replicas_up": sum(r.healthy for r in pool),
+                }
+                if m in self._splits:
+                    pm["split"] = list(self._splits[m])
+                if m in self._shadows:
+                    pm["shadow"] = list(self._shadows[m])
+                q = self.quotas.get(m)
+                if q is not None:
+                    pm["quota"] = q.stats()
+                per_model[m] = pm
+        rec = {
             "requests": n_req,
             "errors": n_err,
             "qps": round(n_req / elapsed, 2),
@@ -619,7 +917,14 @@ class ScoringRouter:
             "replica_count": len(reps),
             "replicas_up": sum(r["healthy"] for r in reps),
             "replicas": reps,
+            # multi-tenant additions (additive, like shed/retries were)
+            "models": len(self.model_ids),
+            "per_model": per_model,
         }
+        sm = self._shadow_mirror
+        if sm is not None:
+            rec["shadow"] = sm.stats()
+        return rec
 
     # -- lifecycle ---------------------------------------------------------
     def start(self) -> "ScoringRouter":
@@ -650,6 +955,8 @@ class ScoringRouter:
             # a router stopped before start() just closes the socket
             self._tcp.shutdown()
         self._tcp.server_close()
+        if self._shadow_mirror is not None:
+            self._shadow_mirror.stop()
         if self._health_thread.is_alive():
             self._health_thread.join(timeout=10.0)
         for rep in self.replicas:

@@ -23,6 +23,13 @@ Line protocol, one request per line, one reply line per request:
   :mod:`distlr_tpu.feedback`); reply ``OK <outcome>`` where outcome is
   ``joined`` / ``pending`` / ``duplicate``, or ``ERR`` when the server
   runs no feedback sink.
+* **model addressing** (additive, like STATS/TRACE — multi-tenant
+  serving): one server can host several model versions as multiple
+  :class:`~distlr_tpu.serve.engine.ScoringEngine`\\ s.  ``MODEL <id>``
+  scopes the CONNECTION to a hosted model (reply ``OK MODEL <id>``);
+  a per-request ``@<id> `` prefix addresses one line (it may wrap ID
+  mode and JSON mode: ``@v2 ID r1 1:1``).  Unaddressed lines score on
+  the default (first) engine — pre-tenant clients interop unchanged.
 * Malformed input -> ``ERR <reason>`` for that line; the connection
   stays up (one bad row from one client must not drop its neighbors).
 
@@ -77,10 +84,13 @@ class _Handler(socketserver.StreamRequestHandler):
         srv._track(self.connection)
         try:
             self._serve_lines(srv)
+        except ConnectionResetError:
+            pass  # peer RST mid-read (client died, chaos reset): not an error
         finally:
             srv._untrack(self.connection)
 
     def _serve_lines(self, srv: "ScoringServer"):
+        scope: str | None = None  # MODEL <id> connection scoping
         for raw in self.rfile:
             try:
                 line = raw.decode("utf-8", errors="replace").strip()
@@ -88,7 +98,10 @@ class _Handler(socketserver.StreamRequestHandler):
                 continue
             if not line:
                 continue
-            reply = srv.handle_line(line)
+            if line == "MODEL" or line.startswith("MODEL "):
+                reply, scope = srv.handle_model_line(line, scope)
+            else:
+                reply = srv.handle_line(line, model=scope)
             try:
                 self.wfile.write((reply + "\n").encode())
                 self.wfile.flush()
@@ -102,26 +115,62 @@ class _TCPServer(socketserver.ThreadingTCPServer):
 
 
 class ScoringServer:
-    """Engine + microbatcher behind a line-protocol TCP listener."""
+    """Engine(s) + microbatcher(s) behind a line-protocol TCP listener.
 
-    def __init__(self, engine, *, host: str = "127.0.0.1", port: int = 0,
+    Single-model (the pre-tenant form): pass ``engine``.  Multi-tenant:
+    pass ``engines`` — an ordered ``{model_id: ScoringEngine}`` mapping;
+    the FIRST entry is the default engine unaddressed lines score on,
+    and each engine gets its own microbatcher (coalescing is per model:
+    two versions' rows must never share a padded batch).
+    """
+
+    def __init__(self, engine=None, *, engines: dict | None = None,
+                 host: str = "127.0.0.1", port: int = 0,
                  max_wait_ms: float = 2.0, reloader=None,
+                 extra_reloaders=(),
                  metrics: MetricsLogger | None = None, hot_tracker=None,
                  feedback=None):
-        self.engine = engine
+        if engines is None:
+            if engine is None:
+                raise ValueError("need an engine (or an engines mapping)")
+            engines = {"default": engine}
+            # single-engine compat: feedback records carry no model id,
+            # shards stay flat — byte-identical to the pre-tenant loop
+            self._multi = False
+        else:
+            if engine is not None:
+                raise ValueError("pass engine OR engines, not both")
+            if not engines:
+                raise ValueError("engines mapping must name >= 1 model")
+            engines = dict(engines)
+            self._multi = True
+        self.engines = engines
+        self._default_id = next(iter(engines))
+        self.engine = engines[self._default_id]
         self.reloader = reloader
+        #: extra per-engine reloaders (multi-tenant live-PS serving) the
+        #: server owns for lifecycle only — stopped with the listener
+        self._extra_reloaders = list(extra_reloaders)
         #: HotSetTracker fed from request traffic (hot-row keyed reload);
         #: None = full-table refresh semantics, no tracking overhead.
+        #: Tracks the DEFAULT engine's key space only — each model
+        #: version has its own namespace, and mixing their keys would
+        #: poison the hot set.
         self.hot_tracker = hot_tracker
         #: FeedbackSink (distlr_tpu.feedback): journals scored requests,
         #: joins LABEL lines, feeds the drift detector.  None = the loop
         #: is open (pre-feedback behavior, zero overhead).
         self.feedback = feedback
-        self.batcher = MicroBatcher(
-            engine.score,
-            max_batch_size=engine.max_batch_size,
-            max_wait_ms=max_wait_ms,
-        )
+        self._batchers = {
+            mid: MicroBatcher(
+                eng.score,
+                max_batch_size=eng.max_batch_size,
+                max_wait_ms=max_wait_ms,
+            )
+            for mid, eng in engines.items()
+        }
+        self.batcher = self._batchers[self._default_id]
+        self._model_requests = {mid: 0 for mid in engines}
         self.metrics = metrics or MetricsLogger()
         self._t0 = time.monotonic()
         self._tcp = _TCPServer((host, port), _Handler, bind_and_activate=True)
@@ -155,25 +204,32 @@ class ScoringServer:
         with self._conn_lock:
             self._active_conns.discard(conn)
 
-    def _score_lines(self, lines: list[str], ids: list | None = None):
-        with dtrace.span("serve.encode", tags={"rows": len(lines)}):
-            rows = self.engine.encode_lines(lines)
-        if self.hot_tracker is not None:
-            self.hot_tracker.observe(self.engine.row_keys(rows))
+    def _score_lines(self, lines: list[str], ids: list | None = None,
+                     model: str | None = None):
+        mid = self._default_id if model is None else model
+        engine = self.engines[mid]
+        batcher = self._batchers[mid]
+        with dtrace.span("serve.encode",
+                         tags={"rows": len(lines), "model": mid}):
+            rows = engine.encode_lines(lines)
+        if self.hot_tracker is not None and mid == self._default_id:
+            self.hot_tracker.observe(engine.row_keys(rows))
         # version read BEFORE scoring: a swap racing the batch means the
         # journal attributes at most one version early, never one that
         # did not exist when the request entered
-        version = self.engine.weights_version
+        version = engine.weights_version
         # the score span covers microbatch queue wait + the engine call;
         # the batcher's own serve.batch span (under the same trace)
         # isolates the engine half, so queue time reads as the gap
         with dtrace.span("serve.score"):
-            labels, scores = self.batcher.submit(
+            labels, scores = batcher.submit(
                 rows, ctx=dtrace.current()).result()
         labels, scores = np.asarray(labels), np.asarray(scores)
+        self._model_requests[mid] += 1
         if self.feedback is not None:
             self.feedback.scored(lines, rows, scores, version=version,
-                                 ids=ids, trace=dtrace.current_ids())
+                                 ids=ids, trace=dtrace.current_ids(),
+                                 model=mid if self._multi else None)
         return labels, scores
 
     def _handle_label(self, line: str) -> str:
@@ -189,12 +245,29 @@ class ScoringServer:
             raise ValueError(f"label must be 0 or 1, got {parts[2]!r}")
         return f"OK {self.feedback.label(parts[1], int(y))}"
 
-    def handle_line(self, line: str) -> str:
+    def handle_model_line(self, line: str,
+                          scope: str | None) -> tuple[str, str | None]:
+        """``MODEL <id>`` connection scoping: subsequent unaddressed
+        lines on this connection score on ``<id>``.  Returns
+        ``(reply, new_scope)`` — an unknown id keeps the old scope."""
+        parts = line.split()
+        if len(parts) != 2:
+            self._errors_c.inc()
+            return "ERR MODEL: need MODEL <id>", scope
+        if parts[1] not in self.engines:
+            self._errors_c.inc()
+            return (f"ERR MODEL: unknown model {parts[1]!r} (hosted: "
+                    f"{','.join(self.engines)})", scope)
+        return f"OK MODEL {parts[1]}", parts[1]
+
+    def handle_line(self, line: str, model: str | None = None) -> str:
         """One request line -> one reply line.  An additive ``TRACE
         <tid>/<sid> <line>`` prefix (minted by the router, or by any
         traced client) joins this request to a distributed trace; a
         server reached directly mints its own root for scoring lines.
-        Replies never carry the prefix — clients see identical bytes."""
+        ``model`` is the connection's ``MODEL`` scope (a per-request
+        ``@<id>`` prefix inside the line overrides it).  Replies never
+        carry the prefix — clients see identical bytes."""
         ctx = None
         if line.startswith("TRACE "):
             parts = line.split(" ", 2)
@@ -212,14 +285,25 @@ class ScoringServer:
             # record instead of minting a second trace per label
             ctx = dtrace.new_trace()
         if ctx is None:
-            return self._handle_request(line)
+            return self._handle_request(line, model)
         with dtrace.use(ctx), dtrace.span(
                 "serve.request",
                 tags={"listener": f"{self.host}:{self.port}"}):
-            return self._handle_request(line)
+            return self._handle_request(line, model)
 
-    def _handle_request(self, line: str) -> str:
+    def _handle_request(self, line: str, model: str | None = None) -> str:
         t0 = time.monotonic()
+        if line.startswith("@"):
+            # per-request model addressing (additive): "@<id> <line>"
+            prefix, _, rest = line.partition(" ")
+            model, line = prefix[1:], rest.strip()
+            if not model or not line:
+                self._errors_c.inc()
+                return "ERR MODEL: need @<id> <request line>"
+        if model is not None and model not in self.engines:
+            self._errors_c.inc()
+            return (f"ERR MODEL: unknown model {model!r} (hosted: "
+                    f"{','.join(self.engines)})")
         try:
             if line == "STATS":
                 return json.dumps(self.stats())
@@ -238,7 +322,8 @@ class ScoringServer:
                 labels, scores = self._score_lines(
                     [str(r) for r in batch],
                     None if ids is None
-                    else [None if i is None else str(i) for i in ids])
+                    else [None if i is None else str(i) for i in ids],
+                    model)
                 reply = json.dumps({
                     "labels": [int(v) for v in labels],
                     "scores": [round(float(v), 6) for v in scores],
@@ -251,7 +336,7 @@ class ScoringServer:
                         raise ValueError(
                             "ID mode needs: ID <request_id> <features>")
                     line, ids = parts[2], [parts[1]]
-                labels, scores = self._score_lines([line], ids)
+                labels, scores = self._score_lines([line], ids, model)
                 reply = f"{int(labels[0])} {float(scores[0]):.6g}"
         except Exception as e:
             self._errors_c.inc()
@@ -283,6 +368,18 @@ class ScoringServer:
             "shed": 0,
             "retries": 0,
             "replica_count": 1,
+            # Multi-tenant additions (additive, like shed/retries were):
+            # hosted-model count and per-model request/engine state.  A
+            # single-engine server reports models=1 under "default".
+            "models": len(self.engines),
+            "per_model": {
+                mid: {
+                    "requests": self._model_requests[mid],
+                    "shed": 0,
+                    "engine": eng.stats(),
+                }
+                for mid, eng in self.engines.items()
+            },
             "batcher": self.batcher.stats(),
             "engine": self.engine.stats(),
         }
@@ -310,9 +407,11 @@ class ScoringServer:
         if self.feedback is not None:
             self.feedback.start()  # window-expiry / idle-flush ticker
         self._thread.start()
-        log.info("serving %s on %s:%d (max_batch=%d, buckets=%s)",
+        log.info("serving %s on %s:%d (max_batch=%d, buckets=%s, "
+                 "models=%s)",
                  self.engine.cfg.model, self.host, self.port,
-                 self.engine.max_batch_size, list(self.engine.buckets))
+                 self.engine.max_batch_size, list(self.engine.buckets),
+                 ",".join(self.engines))
         return self
 
     def serve_forever(self) -> None:
@@ -332,9 +431,12 @@ class ScoringServer:
             # ran (the MetricsServer.stop() bug class from ISSUE 3)
             self._tcp.shutdown()
         self._tcp.server_close()
-        self.batcher.close()
+        for batcher in self._batchers.values():
+            batcher.close()
         if self.reloader is not None:
             self.reloader.stop()
+        for rl in self._extra_reloaders:
+            rl.stop()
         if self.feedback is not None:
             self.feedback.stop()  # flushes the partial shard
         self.metrics.close()

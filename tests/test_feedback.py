@@ -356,8 +356,11 @@ class TestOnlineTrainer:
         assert acc > 0.8
 
     def test_rejects_unsupported_model(self, tmp_path):
+        # blocked_lr stays rejected (ISSUE-10 satellite: the error now
+        # NAMES why — raw-CTR hashing happens at shard ingest, so the
+        # grouped row layout cannot be re-derived from feedback shards)
         cfg = Config(model="blocked_lr", num_feature_dim=D, block_size=8)
-        with pytest.raises(ValueError, match="online training supports"):
+        with pytest.raises(ValueError, match="RAW categorical"):
             OnlineTrainer(cfg, "127.0.0.1:1", str(tmp_path))
 
 

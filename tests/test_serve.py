@@ -497,9 +497,12 @@ class TestStatsSchemaRegression:
             (raw,) = score_lines_over_tcp(srv.host, srv.port, ["STATS"])
         stats = json.loads(raw)
         # exact top-level key set: the pre-registry accumulator's keys
-        # plus the ISSUE-4 routing-tier additions, nothing else
+        # plus the ISSUE-4 routing-tier additions plus the ISSUE-10
+        # multi-tenant additions (models / per_model), nothing else —
+        # every pre-existing key is untouched, so old clients still parse
         assert set(stats) == {"requests", "errors", "qps", "p50_ms",
                               "p99_ms", "shed", "retries", "replica_count",
+                              "models", "per_model",
                               "batcher", "engine"}
         assert isinstance(stats["requests"], int) and stats["requests"] >= 5
         # a single engine behind no router never sheds or retries and IS
@@ -507,6 +510,12 @@ class TestStatsSchemaRegression:
         assert stats["shed"] == 0 and isinstance(stats["shed"], int)
         assert stats["retries"] == 0 and isinstance(stats["retries"], int)
         assert stats["replica_count"] == 1
+        # a single unnamed engine reports one hosted model, "default"
+        assert stats["models"] == 1
+        assert set(stats["per_model"]) == {"default"}
+        pm = stats["per_model"]["default"]
+        assert isinstance(pm["requests"], int) and pm["shed"] == 0
+        assert pm["engine"]["weights_version"] >= 1
         assert isinstance(stats["errors"], int) and stats["errors"] == 1
         assert isinstance(stats["qps"], (int, float)) and stats["qps"] > 0
         for k in ("p50_ms", "p99_ms"):

@@ -158,8 +158,13 @@ class TestRouterBasics:
             st = json.loads(raw)
             assert set(st) == {"requests", "errors", "qps", "p50_ms",
                                "p99_ms", "shed", "retries", "replica_count",
-                               "replicas_up", "replicas"}
+                               "replicas_up", "replicas",
+                               "models", "per_model"}
             assert st["requests"] == 1 and st["replica_count"] == 1
+            # ISSUE-10 multi-tenant additions (additive): an old-style
+            # replica list reads as one "default" model
+            assert st["models"] == 1
+            assert set(st["per_model"]) == {"default"}
             assert set(st["replicas"][0]) == {
                 "addr", "healthy", "inflight", "requests", "errors",
                 "ejections", "reinstates"}
