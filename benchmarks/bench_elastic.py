@@ -1,0 +1,170 @@
+"""Elastic-fleet benchmark: what a live reshard costs the data plane.
+
+Drives a real async KV server group with continuous pull traffic (the
+serving tier's read shape) and a Hogwild pusher, then live-reshards the
+group — double, then halve — through the membership coordinator
+(:mod:`distlr_tpu.ps.membership`) while the load keeps flowing.  The
+row answers the three questions the ROADMAP's elastic item asks:
+
+* **migration duration** — fence -> drain -> commit -> activate wall
+  seconds per reshard (the client-visible unavailability upper bound);
+* **requests failed during reshard** — ops that surfaced an error to
+  the caller (the zero-restarts bar demands 0: fences and retired-rank
+  disconnects must be absorbed by re-routing);
+* **QPS dip %** — pull throughput in the migration window vs the
+  steady-state baseline (what the fleet "feels").
+
+Prints ONE JSON line in ``bench.py``'s format.  Jax-free (the load is
+the KV wire itself), so the row costs seconds and runs anywhere.
+
+Run: ``python benchmarks/bench_elastic.py [--quick|--smoke]``
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+sys.path.insert(0, REPO)
+
+
+def _resilience() -> dict:
+    from bench import resilience_snapshot  # noqa: PLC0415
+
+    return resilience_snapshot()
+
+
+def bench_reshard(d: int, servers: int, pullers: int,
+                  settle_s: float) -> dict:
+    import numpy as np  # noqa: PLC0415
+
+    from distlr_tpu.ps import (  # noqa: PLC0415
+        KVWorker,
+        MembershipCoordinator,
+        RetryPolicy,
+        ServerGroup,
+    )
+
+    policy = RetryPolicy(attempts=6, backoff_ms=10, deadline_s=30)
+    ops: list[int] = [0] * pullers
+    fails: list[int] = [0] * pullers
+    stop = threading.Event()
+
+    with ServerGroup(servers, 1, d, sync=False) as group:
+        coord = MembershipCoordinator(group)
+        with KVWorker(group.hosts, d, client_id=1, sync_group=False) as s:
+            s.push_init(np.zeros(d, np.float32))
+
+        def puller(i: int) -> None:
+            with KVWorker(None, d, client_id=16 + i, sync_group=False,
+                          retry=policy, route=coord.layout) as kv:
+                while not stop.is_set():
+                    try:
+                        kv.pull()
+                        ops[i] += 1
+                    except Exception:  # noqa: BLE001 — counted, not fatal
+                        fails[i] += 1
+
+        def pusher() -> None:
+            g = np.full(d, 1e-4, np.float32)
+            with KVWorker(None, d, client_id=2, sync_group=False,
+                          retry=policy, route=coord.layout) as kv:
+                while not stop.is_set():
+                    try:
+                        kv.push(g)
+                    except Exception:  # noqa: BLE001
+                        fails[0] += 1
+
+        threads = [threading.Thread(target=puller, args=(i,))
+                   for i in range(pullers)]
+        threads.append(threading.Thread(target=pusher))
+        for t in threads:
+            t.start()
+        try:
+            time.sleep(settle_s)  # warm-up
+            base0, t0 = sum(ops), time.perf_counter()
+            time.sleep(settle_s)
+            qps_base = (sum(ops) - base0) / (time.perf_counter() - t0)
+
+            mig0, m_t0 = sum(ops), time.perf_counter()
+            grow = coord.resize(servers * 2)
+            shrink = coord.resize(servers)
+            m_dt = time.perf_counter() - m_t0
+            qps_during = (sum(ops) - mig0) / m_dt
+
+            time.sleep(settle_s)  # recovery window
+            rec0, r_t0 = sum(ops), time.perf_counter()
+            time.sleep(settle_s)
+            qps_after = (sum(ops) - rec0) / (time.perf_counter() - r_t0)
+        finally:
+            stop.set()
+            for t in threads:
+                t.join(timeout=30)
+        dip = (max(0.0, 1.0 - qps_during / qps_base) * 100.0
+               if qps_base > 0 else None)
+        return {
+            "migration_seconds": round(grow["seconds"]
+                                       + shrink["seconds"], 4),
+            "grow_seconds": grow["seconds"],
+            "shrink_seconds": shrink["seconds"],
+            "keys_moved": grow["keys_moved"] + shrink["keys_moved"],
+            "bytes_moved": grow["bytes_moved"] + shrink["bytes_moved"],
+            "requests_failed_during_reshard": int(sum(fails)),
+            "qps_base": round(qps_base, 1),
+            "qps_during_reshard": round(qps_during, 1),
+            "qps_after": round(qps_after, 1),
+            "qps_dip_pct": None if dip is None else round(dip, 1),
+            "final_epoch": coord.epoch,
+        }
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true",
+                    help="tiny shapes (smoke/test mode)")
+    ap.add_argument("--smoke", action="store_true",
+                    help="alias of --quick (the `make -C benchmarks "
+                    "elastic-smoke` entry point)")
+    args = ap.parse_args()
+    quick = args.quick or args.smoke
+    d, servers, pullers, settle = ((65_536, 2, 2, 0.4) if quick
+                                   else (1_000_000, 2, 4, 2.0))
+
+    sub = bench_reshard(d, servers, pullers, settle)
+    row = {
+        "metric": (f"elastic fleet, D={d}: live reshard "
+                   f"({servers}->{2 * servers}->{servers} ranks) under "
+                   "continuous pull+push load — migration wall seconds"),
+        "value": sub["migration_seconds"],
+        "unit": "seconds",
+        "D": d,
+        "num_servers": servers,
+        "pull_clients": pullers,
+        "quick": quick,
+        "elastic": sub,
+        "resilience": _resilience(),
+    }
+    try:
+        import jax  # noqa: PLC0415
+
+        row["backend"] = jax.default_backend()
+    except Exception:  # noqa: BLE001 — deliberately jax-free
+        row["backend"] = "none"
+    print(json.dumps(row))
+    if sub["requests_failed_during_reshard"]:
+        print(f"[bench_elastic] WARNING: "
+              f"{sub['requests_failed_during_reshard']} request(s) "
+              "failed during the reshard (the bar is 0)",
+              file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

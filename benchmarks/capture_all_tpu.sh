@@ -114,6 +114,16 @@ DISTLR_METRICS_SNAPSHOT="benchmarks/capture_logs/fleet/snapshots/tenant-0.json" 
   && echo "bench_tenant ok" \
   || echo "bench_tenant failed (non-fatal; artifact not refreshed)"
 
+echo "== bench_elastic.py (live reshard under load: migration cost; best-effort) =="
+# Elastic-fleet row (ISSUE 12): live 2->4->2 reshard of a D=1M group
+# under continuous pull+push load — migration wall seconds, requests
+# failed during the reshard (the bar is 0), and the QPS dip %.
+DISTLR_METRICS_SNAPSHOT="benchmarks/capture_logs/fleet/snapshots/elastic-0.json" \
+  timeout 900 python -u benchmarks/bench_elastic.py \
+  > benchmarks/capture_logs/bench_elastic.json \
+  && echo "bench_elastic ok" \
+  || echo "bench_elastic failed (non-fatal; artifact not refreshed)"
+
 echo "== bank the fleet metrics snapshot (merged view; best-effort) =="
 # Federates every snapshot banked into the window's fleet dir (today:
 # bench.py; any --obs-run-dir'd process that joins a future window rides

@@ -628,8 +628,23 @@ class ChaosFabric:
     @property
     def hosts(self) -> str:
         """Proxied connection spec — hand this to clients in place of
-        the real server group's ``hosts``."""
+        the real server group's ``hosts``.  Links are in CREATION order;
+        an elastic group that adds/retires upstreams mid-run keeps its
+        own rank->link mapping (ServerGroup._chaos_links) instead."""
         return ",".join(f"127.0.0.1:{lk.port}" for lk in self.links)
+
+    def add_upstream(self, host: str, port: int) -> ChaosLink:
+        """Grow the fabric by one link (the elastic-fleet hook: a server
+        rank spawned mid-run gets its own fault-injecting proxy, so a
+        resharded group stays fully behind the plan).  The new link gets
+        the next link index: plan faults with ``links: null`` apply to
+        it; faults naming explicit link indices keep meaning the links
+        that existed when the plan was written."""
+        lk = ChaosLink(len(self.links), (host, int(port)), self.plan, self,
+                       protocol=self.links[0].protocol if self.links
+                       else "kv")
+        self.links.append(lk)
+        return lk
 
     def now(self) -> float:
         return time.monotonic() - self.started_at

@@ -305,6 +305,13 @@ class Config:
     # Also force a full refresh every N polls (bounds cold-row staleness
     # to N poll intervals); 0 = only coverage-driven refreshes.
     serve_hot_full_every: int = 10
+    # Idle-engine device eviction (the cold-model-version satellite): an
+    # engine that scored nothing for this many seconds releases its
+    # device weight table to a host copy (HBM freed for the hot
+    # versions) and lazily re-loads on the next request.  0 = never
+    # evict (every engine pins device memory forever — the pre-elastic
+    # behavior).
+    serve_engine_idle_evict_s: float = 0.0
 
     # ---- feedback loop (launch serve --feedback-* / launch online;
     # distlr_tpu.feedback) ----
@@ -539,6 +546,11 @@ class Config:
             raise ValueError(
                 "serve_hot_full_every must be >= 0 (0 = coverage-driven "
                 f"only), got {self.serve_hot_full_every}"
+            )
+        if self.serve_engine_idle_evict_s < 0:
+            raise ValueError(
+                "serve_engine_idle_evict_s must be >= 0 (0 = never "
+                f"evict), got {self.serve_engine_idle_evict_s}"
             )
         if self.feedback_window_s <= 0:
             raise ValueError(

@@ -96,7 +96,8 @@ class OnlineTrainer:
                  poll_interval_s: float = 0.5, idle_flush_s: float = 2.0,
                  client_id: int | None = None, seed_init: bool = True,
                  worker_id: int = 0, claim_stale_s: float = 300.0,
-                 ns_base: int = 0, ns_total_dim: int | None = None):
+                 ns_base: int = 0, ns_total_dim: int | None = None,
+                 route=None):
         if cfg.model == "blocked_lr":
             # named rejection, not a generic unsupported-model error: the
             # blocked path's raw-CTR hashing happens at shard INGEST
@@ -143,6 +144,10 @@ class OnlineTrainer:
             sync_group=False,  # Hogwild client: no barriers, keyed shortcut
             retry=RetryPolicy.from_config(cfg),
             compress=cfg.ps_compress,
+            # elastic fleet: with a membership route provider (`launch
+            # online --ps-ctl`), a live reshard costs this trainer one
+            # routing re-negotiation — never a restart
+            route=route,
         )
         self.kv = (worker if wire_dim == self.dim and not ns_base
                    else worker.namespace(int(ns_base), self.dim))

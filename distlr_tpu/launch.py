@@ -603,6 +603,7 @@ def cmd_serve(args: argparse.Namespace) -> int:
         "serve_hot_rows": args.hot_rows,
         "serve_hot_min_coverage": args.hot_min_coverage,
         "serve_hot_full_every": args.hot_full_every,
+        "serve_engine_idle_evict_s": args.engine_idle_evict,
         "feedback_spool_dir": args.feedback_spool,
         "feedback_shard_dir": args.feedback_shards,
         "feedback_window_s": args.feedback_window,
@@ -615,16 +616,25 @@ def cmd_serve(args: argparse.Namespace) -> int:
     if args.model_id is not None:
         serve_over["serve_model_id"] = args.model_id
     cfg = cfg.replace(**{k: v for k, v in serve_over.items() if v is not None})
-    if not (args.model_file or cfg.checkpoint_dir or args.ps_hosts):
+    live_ps = bool(args.ps_hosts or args.ps_ctl)
+    if not (args.model_file or cfg.checkpoint_dir or live_ps):
         print("error: serve needs a weight source: --model-file and/or "
-              "--checkpoint-dir (watched) or --ps-hosts (live pull)",
-              file=sys.stderr)
+              "--checkpoint-dir (watched) or --ps-hosts / --ps-ctl "
+              "(live pull)", file=sys.stderr)
         return 2
-    if cfg.serve_hot_rows and not args.ps_hosts:
+    if cfg.serve_hot_rows and not live_ps:
         print("error: --hot-rows applies to live-PS reload only "
-              "(--ps-hosts); checkpoint/model-file sources always load "
-              "the full table", file=sys.stderr)
+              "(--ps-hosts / --ps-ctl); checkpoint/model-file sources "
+              "always load the full table", file=sys.stderr)
         return 2
+    ps_route = None
+    if args.ps_ctl:
+        # elastic group: serving pulls follow the membership
+        # coordinator's layout — a live reshard costs the watcher one
+        # re-route inside a poll, never a dead reloader
+        from distlr_tpu.ps.membership import layout_client  # noqa: PLC0415
+
+        ps_route = layout_client(args.ps_ctl)
     if cfg.model == "blocked_lr" and cfg.block_size == 0:
         if cfg.data_dir and os.path.isdir(cfg.data_dir):
             cfg = _resolve_auto_block(cfg)
@@ -639,9 +649,9 @@ def cmd_serve(args: argparse.Namespace) -> int:
     # --namespaces` order)
     ns_layout = None
     if args.ps_namespaces:
-        if not args.ps_hosts:
+        if not live_ps:
             print("error: --ps-namespaces applies to live-PS reload only "
-                  "(--ps-hosts)", file=sys.stderr)
+                  "(--ps-hosts / --ps-ctl)", file=sys.stderr)
             return 2
         from distlr_tpu.ps import namespace_layout  # noqa: PLC0415
 
@@ -656,7 +666,8 @@ def cmd_serve(args: argparse.Namespace) -> int:
                 f"{sorted(ns_layout)}")
         return ns_layout[model_id][0], ps_param_dim(cfg) * len(ns_layout)
 
-    engine = ScoringEngine(cfg, max_batch_size=cfg.serve_max_batch_size)
+    engine = ScoringEngine(cfg, max_batch_size=cfg.serve_max_batch_size,
+                           idle_evict_s=cfg.serve_engine_idle_evict_s)
     if args.model_file:
         engine.set_weights(
             load_weights(args.model_file, shape=engine.model.param_shape))
@@ -665,7 +676,7 @@ def cmd_serve(args: argparse.Namespace) -> int:
     extra_reloaders = []
     retry = None
     row_width = _serve_row_width(cfg)
-    if args.ps_hosts:
+    if live_ps:
         if cfg.serve_hot_rows:
             from distlr_tpu.serve import HotSetTracker  # noqa: PLC0415
 
@@ -686,6 +697,7 @@ def cmd_serve(args: argparse.Namespace) -> int:
             full_refresh_every=cfg.serve_hot_full_every,
             retry=retry,
             ns_base=base, ns_total_dim=total,
+            route=ps_route,
         )
     elif cfg.checkpoint_dir:
         source = CheckpointWatcher(cfg.checkpoint_dir)
@@ -713,11 +725,12 @@ def cmd_serve(args: argparse.Namespace) -> int:
         if mid in engines:
             print(f"error: duplicate model id {mid!r}", file=sys.stderr)
             return 2
-        eng = ScoringEngine(cfg, max_batch_size=cfg.serve_max_batch_size)
+        eng = ScoringEngine(cfg, max_batch_size=cfg.serve_max_batch_size,
+                            idle_evict_s=cfg.serve_engine_idle_evict_s)
         if src == "@ps":
-            if not args.ps_hosts:
-                print("error: --extra-model id=@ps needs --ps-hosts",
-                      file=sys.stderr)
+            if not live_ps:
+                print("error: --extra-model id=@ps needs --ps-hosts or "
+                      "--ps-ctl", file=sys.stderr)
                 return 2
             base, total = _ns(mid)
             extra_src = LivePSWatcher(
@@ -726,6 +739,7 @@ def cmd_serve(args: argparse.Namespace) -> int:
                 # distinct pull client per namespace watcher
                 client_id=LivePSWatcher.SERVE_CLIENT_ID - len(engines),
                 retry=retry, ns_base=base, ns_total_dim=total,
+                route=ps_route,
             )
             rl = HotReloader(eng, extra_src,
                              interval_s=cfg.serve_reload_interval_s).start()
@@ -806,6 +820,16 @@ def cmd_online(args: argparse.Namespace) -> int:
             return 2
         ns_base = layout[ns_id][0]
         ns_total = ps_param_dim(cfg) * len(layout)
+    route = None
+    if args.ps_ctl:
+        # elastic fleet: follow the membership coordinator's layout —
+        # a live reshard costs this trainer a re-route, not a restart
+        from distlr_tpu.ps.membership import layout_client  # noqa: PLC0415
+
+        route = layout_client(args.ps_ctl)
+    if not args.hosts and route is None:
+        print("error: online needs --hosts or --ps-ctl", file=sys.stderr)
+        return 2
     stop = threading.Event()
     signal.signal(signal.SIGTERM, lambda *_: stop.set())
     with _obs_scope(cfg, "online", _obs_rank(args)):
@@ -818,6 +842,7 @@ def cmd_online(args: argparse.Namespace) -> int:
             poll_interval_s=args.poll_interval,
             worker_id=args.worker_id,
             ns_base=ns_base, ns_total_dim=ns_total,
+            route=route,
         )
         print(f"ONLINE shard_dir={args.shard_dir} hosts={args.hosts} "
               f"worker={args.worker_id}", flush=True)
@@ -926,7 +951,14 @@ def cmd_rollout(args: argparse.Namespace) -> int:
     if fleet_url:
         names = ([n.strip() for n in args.alerts.split(",") if n.strip()]
                  if args.alerts else None)
-        poller = fleet_alert_poller(fleet_url, names=names)
+        # scoped SLO gating (ISSUE 12 satellite): by default only alerts
+        # ATTRIBUTABLE to the candidate (label-named — e.g. its own
+        # shadow-PSI series) break the ramp; an alert the primary or
+        # another tenant caused no longer rolls the candidate back.
+        # --gate-all-alerts restores the indiscriminate fleet gate.
+        poller = fleet_alert_poller(
+            fleet_url, names=names,
+            scope_model=None if args.gate_all_alerts else args.candidate)
     elif not args.unwatched:
         print("error: no alert source — pass --fleet http://host:port, an "
               "--obs-run-dir with a running obs-agg, or --unwatched to "
@@ -1025,15 +1057,41 @@ def cmd_ps_server(args: argparse.Namespace) -> int:
     # multi-tenant namespaces (ISSUE 10): one group hosts N model
     # namespaces as contiguous slices of an N-times-larger key space;
     # clients scope with the same layout (serve --ps-namespaces /
-    # online --ps-namespaces, or KVWorker.namespace directly)
+    # online --ps-namespaces, or KVWorker.namespace directly).  Each
+    # entry may carry a per-namespace optimizer ("v1:ftrl,v2:sgd" —
+    # the ISSUE-12 satellite): the group spawns with --opt_segments so
+    # one fleet hosts an FTRL model generation next to an SGD one.
     layout = None
+    opt_segments = None
     per_dim = ps_param_dim(cfg)
     total_dim = per_dim
     if args.namespaces:
-        from distlr_tpu.ps import namespace_layout  # noqa: PLC0415
+        from distlr_tpu.ps import (  # noqa: PLC0415
+            namespace_layout,
+            parse_namespace_optimizers,
+        )
 
         layout = namespace_layout(args.namespaces, per_dim)
         total_dim = per_dim * len(layout)
+        try:
+            ns_opts = parse_namespace_optimizers(args.namespaces)
+        except ValueError as e:
+            print(f"error: bad --namespaces: {e}", file=sys.stderr)
+            return 2
+        if ns_opts:
+            default_opt = server_optimizer(cfg)
+            if default_opt == "signsgd":
+                print("error: per-namespace optimizers are incompatible "
+                      "with signsgd groups (sign votes only mean "
+                      "majority-vote through a uniform group)",
+                      file=sys.stderr)
+                return 2
+            opt_segments = [(base + d, ns_opts.get(m, default_opt))
+                            for m, (base, d) in layout.items()]
+    if args.elastic and cfg.sync_mode and not args.asynchronous:
+        print("error: --elastic requires --async (a sync BSP round "
+              "cannot straddle a membership change)", file=sys.stderr)
+        return 2
     group = ServerGroup(
         cfg.num_servers,
         cfg.num_workers,
@@ -1059,7 +1117,9 @@ def cmd_ps_server(args: argparse.Namespace) -> int:
             os.path.join(cfg.obs_run_dir.split(os.pathsep)[0], "profiles")
             if cfg.obs_run_dir and cfg.prof_hz > 0 else None),
         prof_window_s=cfg.prof_window_s,
+        opt_segments=opt_segments,
     )
+    ctl = None
     try:
         with _obs_scope(cfg, "ps-server", _obs_rank(args)), group:
             # Workers pass this (with this host's address substituted for
@@ -1072,10 +1132,53 @@ def cmd_ps_server(args: argparse.Namespace) -> int:
                 print("NAMESPACES "
                       + ",".join(f"{m}={b}" for m, (b, _d) in layout.items())
                       + f" per_dim={per_dim}", flush=True)
+            if args.elastic:
+                # the scheduler role (membership coordination): LAYOUT/
+                # STATUS/RESIZE over a tiny TCP line protocol — `launch
+                # ps-ctl` drives it, clients' route= providers poll it
+                from distlr_tpu.ps.membership import (  # noqa: PLC0415
+                    MembershipCoordinator,
+                    MembershipServer,
+                )
+
+                coord = MembershipCoordinator(group)
+                ctl = MembershipServer(coord, host="0.0.0.0",
+                                       port=args.ctl_port or 0).start()
+                print(f"PSCTL {ctl.host}:{ctl.port}", flush=True)
             group.wait()
     except KeyboardInterrupt:
         return 130  # interrupted != clean worker-driven shutdown
+    finally:
+        if ctl is not None:
+            ctl.stop()
     return 0
+
+
+def cmd_ps_ctl(args: argparse.Namespace) -> int:
+    """Admin CLI for an elastic group's membership coordinator
+    (:mod:`distlr_tpu.ps.membership`): ``layout`` / ``status`` /
+    ``resize N`` against the ``PSCTL host:port`` endpoint a ``launch
+    ps-server --elastic`` announced.  Jax-free, like route/obs-agg."""
+    import json  # noqa: PLC0415
+
+    from distlr_tpu.ps.membership import ctl_request  # noqa: PLC0415
+
+    if args.command == "resize":
+        if args.n is None or args.n < 1:
+            print("error: resize needs a target server count "
+                  "(ps-ctl --ctl host:port resize N)", file=sys.stderr)
+            return 2
+        line = f"RESIZE {args.n}"
+    else:
+        line = args.command.upper()
+    try:
+        doc = ctl_request(args.ctl, line)
+    except (OSError, ValueError) as e:
+        print(f"error: ps-ctl at {args.ctl}: {e}", file=sys.stderr)
+        return 1
+    # Scriptable contract, like METRICS/SERVING/HOSTS/ROLLOUT.
+    print(f"PSCTL {json.dumps(doc)}", flush=True)
+    return 0 if doc.get("ok", True) else 3
 
 
 def cmd_obs_agg(args: argparse.Namespace) -> int:
@@ -1114,6 +1217,7 @@ def cmd_obs_agg(args: argparse.Namespace) -> int:
             weight_age_ratio=args.alert_weight_age_ratio,
             retry_rate=args.alert_retry_rate,
             scrape_stale_s=args.stale_after,
+            shadow_psi=args.alert_shadow_psi,
         )
     except (OSError, ValueError) as e:
         print(f"error: bad alert thresholds: {e}", file=sys.stderr)
@@ -1393,6 +1497,11 @@ def main(argv=None) -> int:
                    help="pull live weights from this running KV server "
                    "group (comma-separated host:port, rank order) — serve "
                    "WHILE `launch ps --async` trains against the same group")
+    r.add_argument("--ps-ctl", dest="ps_ctl",
+                   help="elastic group: the membership coordinator's "
+                   "PSCTL host:port — serving pulls follow layout epochs "
+                   "across live reshards (optional next to --ps-hosts; "
+                   "alone, the layout is fetched from the coordinator)")
     r.add_argument("--port", type=int, help="listen port (default: "
                    "ephemeral, announced as 'SERVING host:port')")
     r.add_argument("--bind", help="listen address (default 127.0.0.1)")
@@ -1418,6 +1527,12 @@ def main(argv=None) -> int:
                    help="also force a full refresh every N polls, bounding "
                    "cold-row staleness (default 10; 0 = coverage-driven "
                    "only)")
+    r.add_argument("--engine-idle-evict", dest="engine_idle_evict",
+                   type=float,
+                   help="release an engine's DEVICE weight table after "
+                   "this many idle seconds (host copy kept; the next "
+                   "request lazily re-loads) — a cold model version "
+                   "stops pinning HBM.  Default 0 = never evict")
     r.add_argument("--feedback-spool", dest="feedback_spool",
                    help="turn the feedback loop ON: journal every scored "
                    "request into this bounded spool dir, accept LABEL "
@@ -1473,10 +1588,16 @@ def main(argv=None) -> int:
              "serving engines hot-reload from (the closed loop)",
     )
     _add_config_flags(on)
-    on.add_argument("--hosts", required=True,
+    on.add_argument("--hosts",
                     help="the live ASYNC KV server group (comma-separated "
                     "host:port, rank order) — the same group `launch serve "
-                    "--ps-hosts` pulls from")
+                    "--ps-hosts` pulls from; optional with --ps-ctl "
+                    "(the layout is fetched from the coordinator)")
+    on.add_argument("--ps-ctl", dest="ps_ctl",
+                    help="elastic group: the membership coordinator's "
+                    "PSCTL host:port — this trainer follows layout "
+                    "epochs (a live reshard costs one re-route, never "
+                    "a restart)")
     on.add_argument("--shard-dir", dest="shard_dir", required=True,
                     help="joined-shard dir the serving tier's feedback "
                     "sink writes (serve --feedback-shards)")
@@ -1587,6 +1708,14 @@ def main(argv=None) -> int:
     ro.add_argument("--alerts",
                     help="comma-separated alert gauge names to bind "
                     "(default: every distlr_alert_*)")
+    ro.add_argument("--gate-all-alerts", dest="gate_all_alerts",
+                    action="store_true",
+                    help="roll back on ANY bound firing alert, "
+                    "attributed or not (the pre-scoping behavior). "
+                    "Default: only alerts attributable to the CANDIDATE "
+                    "— label-named, e.g. its shadow-PSI series — gate "
+                    "the ramp; the aggregator-unreachable synthetic "
+                    "always gates")
     ro.add_argument("--unwatched", action="store_true",
                     help="ramp on the stage timers alone, with NO alert "
                     "gate (rollback becomes manual) — tests/dev only")
@@ -1609,8 +1738,38 @@ def main(argv=None) -> int:
                    "slices): the group's dim becomes N x the per-model "
                    "dim and the layout is announced as 'NAMESPACES "
                    "id=base,...' — clients repeat the same list via "
-                   "--ps-namespaces")
+                   "--ps-namespaces.  An id may carry a per-namespace "
+                   "optimizer suffix ('v1:ftrl,v2:sgd'): that slice's "
+                   "keys run the named update rule (sgd|ftrl), so one "
+                   "group hosts different model generations")
+    v.add_argument("--elastic", action="store_true",
+                   help="async only: run the membership coordinator "
+                   "(scheduler role) next to the group — announced as "
+                   "'PSCTL host:port'; `launch ps-ctl` resizes the "
+                   "group live, clients with a route provider follow "
+                   "epoch flips without restarts")
+    v.add_argument("--ctl-port", dest="ctl_port", type=int,
+                   help="with --elastic: fixed ps-ctl port (default: "
+                   "ephemeral)")
     v.set_defaults(fn=cmd_ps_server)
+
+    pc = sub.add_parser(
+        "ps-ctl",
+        help="admin CLI against an elastic group's membership "
+             "coordinator (`launch ps-server --elastic`): show the "
+             "layout, poll a migration, or live-reshard the group",
+    )
+    pc.add_argument("--ctl", required=True,
+                    help="the coordinator endpoint (what ps-server "
+                    "announced as PSCTL host:port)")
+    pc.add_argument("command", choices=["layout", "status", "resize"],
+                    help="layout = the routing contract clients follow; "
+                    "status = migration state + last-resize stats; "
+                    "resize = live-reshard to N server ranks (blocks "
+                    "until the drain completes)")
+    pc.add_argument("n", nargs="?", type=int,
+                    help="target server count (resize only)")
+    pc.set_defaults(fn=cmd_ps_ctl)
 
     c = sub.add_parser(
         "chaos",
@@ -1681,6 +1840,13 @@ def main(argv=None) -> int:
                    "fleet share of KV op attempts that are in-place "
                    "retry re-issues (default 0.05) — degradation the "
                    "resilience layer is absorbing, visible before errors")
+    a.add_argument("--alert-shadow-psi", dest="alert_shadow_psi",
+                   type=float,
+                   help="distlr_alert_shadow_psi fires per (tenant, "
+                   "candidate) when the shadow-scored candidate's score "
+                   "distribution diverges from its primary's past this "
+                   "PSI (default 0.25) — the candidate-attributed "
+                   "evidence `launch rollout`'s scoped gate binds")
     a.add_argument("--once", action="store_true",
                    help="scrape+merge once and exit: print the fleet "
                    "Prometheus text (or write --snapshot-path) instead of "

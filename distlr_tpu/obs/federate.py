@@ -368,6 +368,14 @@ class AlertThresholds:
     #: the retry layer is absorbing it" signal; it alerts BEFORE the
     #: error-rate alert (retries precede failures).
     retry_rate: float = 0.05
+    #: shadow-scoring PSI (distlr_tenant_shadow_psi) above which
+    #: distlr_alert_shadow_psi fires PER (tenant, candidate) series —
+    #: the one alert family ATTRIBUTABLE to a specific model version,
+    #: which is what lets `launch rollout` gate a candidate's ramp on
+    #: the candidate's OWN evidence instead of any fleet alert (the
+    #: scoped-SLO-gating contract; see serve.rollout.attributable).
+    #: Same default as the drift detector's PSI threshold.
+    shadow_psi: float = 0.25
 
     @classmethod
     def resolve(cls, path: str | None = None, **overrides) -> "AlertThresholds":
@@ -574,6 +582,28 @@ def evaluate_alerts(reg: MetricsRegistry, *, thresholds: AlertThresholds,
                   "(respawn budget exhausted — that key range is frozen)",
                   ("threshold",))
     emit(g, {"threshold": "0"}, gave_up > 0, gave_up, 0.0)
+
+    # 7. shadow-scoring PSI per (tenant, candidate) — the one alert
+    # family ATTRIBUTABLE to a model version: a shadow-mirrored
+    # candidate whose score distribution diverges from its primary past
+    # the threshold fires ITS OWN series, and a candidate-scoped ramp
+    # (`launch rollout`'s default) rolls back on exactly this evidence
+    # — never on an alert the primary or another tenant caused.
+    g = reg.gauge("distlr_alert_shadow_psi",
+                  "1 while a shadow-scored candidate's score "
+                  "distribution diverges from its primary's (PSI above "
+                  "the threshold label) — candidate-attributed, the "
+                  "scoped rollout gate's input",
+                  ("tenant", "candidate", "threshold"))
+    psi_fam = reg.get("distlr_tenant_shadow_psi")
+    if psi_fam is not None and psi_fam.kind == "gauge":
+        names = psi_fam.labelnames
+        if "tenant" in names and "candidate" in names:
+            it, ic = names.index("tenant"), names.index("candidate")
+            for values, child in sorted(psi_fam.children()):
+                emit(g, {"tenant": values[it], "candidate": values[ic],
+                         "threshold": f"{t.shadow_psi:g}"},
+                     child.value > t.shadow_psi, child.value, t.shadow_psi)
     return alerts
 
 

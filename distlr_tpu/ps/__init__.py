@@ -3,10 +3,17 @@ from distlr_tpu.ps.client import (  # noqa: F401
     FaultRateTracker,
     KVNamespace,
     KVWorker,
+    PSEpochError,
     PSRejectedError,
     PSTimeoutError,
     RetryPolicy,
     STATS_FIELDS,
     namespace_layout,
+    parse_namespace_optimizers,
+)
+from distlr_tpu.ps.membership import (  # noqa: F401
+    MembershipCoordinator,
+    MembershipServer,
+    layout_client,
 )
 from distlr_tpu.ps.server import ServerGroup, ServerSupervisor  # noqa: F401

@@ -67,6 +67,23 @@ _PUSH_UNKNOWN = _reg.counter(
     "class), NEVER re-issued (a maybe-applied push re-issued is a "
     "silent double-apply)",
 )
+_REROUTES = _reg.counter(
+    "distlr_membership_reroutes_total",
+    "client routing re-negotiations after an epoch fence (the group "
+    "layout changed mid-run: layout re-fetched from the membership "
+    "coordinator, handle rebuilt against the new ranks — no restart)",
+)
+_EPOCH_MISMATCHES = _reg.counter(
+    "distlr_membership_epoch_mismatches_total",
+    "KV ops bounced by a server's membership-epoch fence (each one "
+    "triggers a routing re-negotiation, or — for a gradient push whose "
+    "frames already left — an absorbed unknown-outcome push)",
+)
+_CLIENT_EPOCH = _reg.gauge(
+    "distlr_membership_client_epoch",
+    "membership epoch this process's most recently (re)connected "
+    "epoch-announced KV client is at (0 = no epoch announced)",
+)
 #: Push-byte accounting (ISSUE 7): raw = the dense-f32 encoding the
 #: same frame would have cost before codecs (uncompressed keys + 4
 #: bytes/value), wire = what actually left the kernel (headers + keys +
@@ -141,6 +158,10 @@ STATS_FIELDS = (
     "cpu_pull_seconds",
     "cpu_stats_seconds",
     "cpu_barrier_seconds",
+    # the membership round's additive slot: this rank's layout epoch
+    # (kv_protocol.h kEpoch) — a probe of a migrating group reads the
+    # flip rank by rank
+    "epoch",
 )
 
 
@@ -156,6 +177,20 @@ class PSRejectedError(OSError):
     against an sgd server) — deterministic, so the retry driver
     raises it immediately instead of burning its attempt/deadline
     budget re-issuing an op that can never succeed."""
+
+
+class PSEpochError(OSError):
+    """A server's membership-epoch fence bounced the op: the group
+    layout this client routed by is stale (ranks joined or retired —
+    kv_protocol.h kEpoch).  Unlike :class:`PSRejectedError` this is
+    transient BY DESIGN: re-fetch the layout from the membership
+    coordinator, reconnect, and the op is legal again.  A client built
+    with a ``route`` provider handles it automatically; ``epoch`` is
+    the epoch the server reported."""
+
+    def __init__(self, msg: str, epoch: int = 0):
+        super().__init__(msg)
+        self.epoch = int(epoch)
 
 
 class FaultRateTracker:
@@ -361,6 +396,14 @@ def _load():
         lib.kv_clock_offset.argtypes = [ctypes.c_void_p, ctypes.c_uint32]
         lib.kv_last_wire_sent.restype = ctypes.c_uint64
         lib.kv_last_wire_sent.argtypes = [ctypes.c_void_p]
+        lib.kv_negotiate_epoch.restype = ctypes.c_int
+        lib.kv_negotiate_epoch.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.kv_set_epoch.restype = ctypes.c_int
+        lib.kv_set_epoch.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.kv_epoch_mismatch.restype = ctypes.c_int
+        lib.kv_epoch_mismatch.argtypes = [ctypes.c_void_p]
+        lib.kv_group_epoch.restype = ctypes.c_int
+        lib.kv_group_epoch.argtypes = [ctypes.c_void_p]
         lib.kv_pull_opt_state.restype = ctypes.c_int
         lib.kv_pull_opt_state.argtypes = [
             ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
@@ -385,10 +428,12 @@ def _load():
 class KVWorker:
     """Blocking Push/Pull/Wait client over a range-sharded server group."""
 
-    def __init__(self, hosts: str, dim: int, client_id: int = 0, *,
+    def __init__(self, hosts: str | None, dim: int, client_id: int = 0, *,
                  timeout_ms: int = 0, sync_group: bool = True,
                  retry: RetryPolicy | None = None,
-                 compress: str = "none", trace: bool | None = None):
+                 compress: str = "none", trace: bool | None = None,
+                 epoch: int | None = None, route=None,
+                 route_timeout_s: float = 30.0):
         from distlr_tpu.compress import CODEC_IDS  # noqa: PLC0415  (cycle-free, numpy-only)
 
         if compress not in CODEC_IDS:
@@ -398,6 +443,35 @@ class KVWorker:
         lib = _load()
         self._lib = lib
         self.dim = dim
+        #: membership routing (the elastic-fleet round): ``route`` is a
+        #: zero-arg callable returning the coordinator's current layout
+        #: ``{"hosts", "epoch", "status", ...}`` (see
+        #: :mod:`distlr_tpu.ps.membership` — ``layout_client`` wraps a
+        #: ``launch ps-ctl`` endpoint into one).  With it set, an epoch
+        #: fence mid-op re-fetches the layout and rebuilds the handle in
+        #: place — a resharding costs a re-route, never a restart.
+        #: ``epoch`` announces the layout epoch to every server so the
+        #: fence can protect this client; both default from the route
+        #: provider when one is given.
+        self._route = route
+        self._route_timeout_s = float(route_timeout_s)
+        self._epoch = int(epoch) if epoch else 0
+        self._epoch_armed = False
+        self._warned_no_epoch = False
+        if route is not None:
+            # the coordinator is AUTHORITATIVE: a caller-supplied hosts
+            # list may predate a resize, and a stale list announced with
+            # the current epoch would pass every fence while range-
+            # slicing against the wrong layout — silent misrouting.
+            layout = self._fetch_active_layout()
+            if hosts is not None and hosts != layout["hosts"]:
+                log.info("route provider overrides stale hosts %s -> %s",
+                         hosts, layout["hosts"])
+            hosts = layout["hosts"]
+            if not self._epoch:
+                self._epoch = int(layout.get("epoch") or 0)
+        if hosts is None:
+            raise ValueError("KVWorker needs hosts or a route provider")
         self.num_servers = hosts.count(",") + 1
         # connection state kept for reconnect(): a poisoned handle is
         # rebuilt in place with exactly these parameters
@@ -441,7 +515,26 @@ class KVWorker:
         self._sign_zero_checked = False
         # dense-default row encoding under compression (lazy): (keys, vpk)
         self._dense_rows: tuple[np.ndarray, int] | None = None
-        self._h = self._build_handle()
+        self._h = None
+        if route is None:
+            self._h = self._build_handle()
+        else:
+            # a route-provided client may be constructed mid-migration
+            # (or mid-partition, behind a chaos plan): poll through
+            # connect/negotiation failures the same way a reroute does,
+            # bounded by route_timeout_s
+            deadline = time.monotonic() + self._route_timeout_s
+            while True:
+                try:
+                    self._h = self._build_handle()
+                    break
+                except OSError as e:
+                    if time.monotonic() >= deadline:
+                        raise
+                    log.debug("route-provided connect failed (%s); "
+                              "re-fetching layout", e)
+                    time.sleep(0.05)
+                    self._apply_layout(self._fetch_active_layout())
         # dense default key set 0..D-1, like the reference app (src/lr.cc:117-121)
         self._all_keys = np.arange(dim, dtype=np.uint64)
 
@@ -504,6 +597,30 @@ class KVWorker:
                             hosts[s], lib.kv_clock_offset(h, s))
             else:
                 self.trace_active = False
+            if self._epoch:
+                got = lib.kv_negotiate_epoch(h, self._epoch)
+                if got < 0:
+                    raise OSError("epoch negotiation failed: "
+                                  + lib.kv_last_error(h).decode())
+                if got == 0:
+                    # mixed-fleet degradation, like codec/trace: no
+                    # fencing — this client behaves like a pre-epoch one
+                    if not self._warned_no_epoch:
+                        log.warning(
+                            "KV group at %s predates membership epochs; "
+                            "epoch fencing disabled for this client",
+                            self._hosts)
+                        self._warned_no_epoch = True
+                    self._epoch_armed = False
+                elif got != self._epoch:
+                    raise PSEpochError(
+                        f"group at {self._hosts} is at membership epoch "
+                        f"{got}; this client's layout says {self._epoch} "
+                        "— re-fetch routing from the coordinator",
+                        epoch=got)
+                else:
+                    self._epoch_armed = True
+                    _CLIENT_EPOCH.set(self._epoch)
         except Exception:
             lib.kv_close(h)
             raise
@@ -530,6 +647,81 @@ class KVWorker:
         if old:
             self._lib.kv_close(old)
         _RECONNECTS.inc()
+
+    # -- membership re-routing (elastic fleet) -----------------------------
+    def _fetch_active_layout(self) -> dict:
+        """Poll the route provider until it reports an ACTIVE layout —
+        a client landing mid-migration waits the drain out here instead
+        of bouncing ops off the fence — bounded by ``route_timeout_s``."""
+        deadline = time.monotonic() + self._route_timeout_s
+        delay = 0.05
+        last: Exception | None = None
+        while True:
+            layout = None
+            try:
+                layout = self._route()
+            except Exception as e:  # noqa: BLE001 — coordinator may be mid-flip
+                last = e
+            if (layout is not None
+                    and layout.get("status", "active") == "active"):
+                return layout
+            if time.monotonic() >= deadline:
+                raise OSError(
+                    "membership layout fetch timed out after "
+                    f"{self._route_timeout_s:g}s"
+                    + (f" (last error: {last})" if last else
+                       " (coordinator still migrating)"))
+            time.sleep(min(delay, max(0.0, deadline - time.monotonic())))
+            delay = min(delay * 2, 0.5)
+
+    def _renegotiate_route(self) -> None:
+        """The epoch-fence recovery: re-fetch the layout from the
+        membership coordinator and rebuild the native handle against
+        the new ranks — the same in-place move ``reconnect()`` makes
+        for a poisoned stream, plus new hosts and a new announced
+        epoch.  Polls through a migration window (the coordinator
+        reports ``status: migrating`` until the drain completes);
+        bounded by ``route_timeout_s``."""
+        deadline = time.monotonic() + self._route_timeout_s
+        last: Exception | None = None
+        while True:
+            layout = self._fetch_active_layout()
+            self._apply_layout(layout)
+            try:
+                self.reconnect()
+            except PSEpochError as e:
+                # coordinator lag: the fetched layout is ALREADY stale
+                # (a second resize raced this one) — poll again
+                last = e
+            except OSError as e:
+                last = e  # new ranks may still be binding; poll again
+            else:
+                _REROUTES.inc()
+                dtrace.instant("ps.reroute", tags={
+                    "epoch": self._epoch, "servers": self.num_servers})
+                log.info("membership re-route: now at epoch %d over %d "
+                         "server(s)", self._epoch, self.num_servers)
+                return
+            if time.monotonic() >= deadline:
+                raise OSError(
+                    f"membership re-route failed after "
+                    f"{self._route_timeout_s:g}s: {last}")
+            time.sleep(0.05)
+
+    def _apply_layout(self, layout: dict) -> None:
+        hosts = layout["hosts"]
+        epoch = int(layout.get("epoch") or 0)
+        if "dim" in layout and int(layout["dim"]) != self.dim:
+            raise OSError(
+                f"membership layout changed the key-space dim "
+                f"({self.dim} -> {layout['dim']}): not a reshard — "
+                "this client cannot follow")
+        self._hosts = hosts
+        self.num_servers = hosts.count(",") + 1
+        self._epoch = epoch
+        # range boundaries moved: the cached dense row encoding (keyed
+        # vpk re-rowing under compression) must re-derive
+        self._dense_rows = None
 
     # -- in-place retry (RetryPolicy) -------------------------------------
     def _run_with_retry(self, op: str, fn, *, idempotent: bool,
@@ -561,9 +753,81 @@ class KVWorker:
         ``on_failure`` fires only on the unknown-delivery outcome; the
         idempotent path never reaches it (re-issue is always legal
         there).
+
+        A membership change (the elastic fleet resharding under this
+        op) is its own recovery class, live even WITHOUT a retry policy
+        when a ``route`` provider is set.  It surfaces two ways — an
+        epoch fence (:class:`PSEpochError`) from a still-running rank,
+        or plain transport exhaustion against a RETIRED rank (a
+        resharded layout closes old processes; a dead socket cannot
+        reply a fence) — and both recover identically: re-fetch the
+        layout from the coordinator, rebuild the handle, re-issue
+        (bounded; a reshard is not a fault and burns no retry budget).
+        A gradient push caught by the fence is absorbed through the
+        same unknown-outcome path as a transport failure: the fenced
+        rank applied nothing, but a peer whose epoch flipped a moment
+        later may have applied its slice — re-issuing would
+        double-apply it.
         """
+        if not idempotent and self._sync_group:
+            return fn()  # BSP pushes: fail fast, no retry, no re-route
+        if self.retry is None and self._route is None:
+            return fn()
+        max_reroutes = 8 if self._route is not None else 0
+        for reroute in range(max_reroutes + 1):
+            try:
+                return self._retry_ladder(op, fn, idempotent=idempotent,
+                                          on_failure=on_failure)
+            except PSRejectedError:
+                # explicit protocol rejection: deterministic caller
+                # error, identical on every re-issue — never retried
+                raise
+            except PSEpochError:
+                _EPOCH_MISMATCHES.inc()
+                if reroute >= max_reroutes:
+                    # no coordinator to ask (or it keeps handing out
+                    # already-stale layouts): surface the fence
+                    raise
+                if not idempotent:
+                    _PUSH_UNKNOWN.inc()
+                    with contextlib.suppress(OSError):
+                        self._renegotiate_route()
+                    if on_failure is not None:
+                        return on_failure()
+                    return -1
+                self._renegotiate_route()  # raises OSError on timeout
+            except OSError:
+                if (not idempotent
+                        and self._lib.kv_op_delivery_began(self._h)):
+                    # Without a RetryPolicy the ladder is a plain call,
+                    # so the delivery-proof absorb decision lands HERE:
+                    # frames reached a kernel, the outcome is unknown —
+                    # re-issuing after the re-route would be a silent
+                    # double-apply.  (With a policy the ladder already
+                    # absorbed this case; OSErrors escaping it carry
+                    # delivery_began == false.)
+                    _PUSH_UNKNOWN.inc()
+                    with contextlib.suppress(OSError):
+                        self._renegotiate_route()
+                    if on_failure is not None:
+                        return on_failure()
+                    return -1
+                if reroute >= max_reroutes:
+                    raise
+                # transport exhaustion with a route provider: possibly a
+                # retired rank — recover routing and re-issue (legal:
+                # nothing of this op was delivered anywhere).
+                self._renegotiate_route()
+        raise AssertionError("unreachable")
+
+    def _retry_ladder(self, op: str, fn, *, idempotent: bool, on_failure):
+        """The transport-fault half of :meth:`_run_with_retry`: bounded
+        reconnect/backoff/re-issue attempts under the
+        :class:`RetryPolicy` (a plain single call without one).
+        :class:`PSEpochError` and exhaustion propagate to the
+        membership layer above."""
         pol = self.retry
-        if pol is None or (not idempotent and self._sync_group):
+        if pol is None:
             return fn()
         deadline = time.monotonic() + pol.deadline_s
         last: Exception | None = None
@@ -579,6 +843,11 @@ class KVWorker:
                 time.sleep(min(nap, max(0.0, deadline - time.monotonic())))
                 try:
                     self.reconnect()
+                except PSEpochError:
+                    # the group resharded while this op was backing off:
+                    # the membership layer recovers routing, not the
+                    # transport ladder
+                    raise
                 except OSError as e:
                     # servers unreachable (e.g. mid-partition): burn the
                     # attempt on the reconnect and keep backing off
@@ -595,10 +864,8 @@ class KVWorker:
                 _RETRIES.labels(op=op).inc()
             try:
                 return fn()
-            except PSRejectedError:
-                # explicit protocol rejection: deterministic caller
-                # error, identical on every re-issue — never retried
-                raise
+            except (PSRejectedError, PSEpochError):
+                raise  # both handled a layer up, neither is a fault
             except OSError as e:
                 self._record_fault()
                 if not idempotent and self._lib.kv_op_delivery_began(self._h):
@@ -669,6 +936,9 @@ class KVWorker:
             err = self._lib.kv_last_error(self._h).decode()
             if self._lib.kv_timed_out(self._h):
                 raise PSTimeoutError(f"KV {what} timed out: {err}")
+            if self._lib.kv_epoch_mismatch(self._h):
+                raise PSEpochError(f"KV {what} fenced: {err}",
+                                   epoch=self._lib.kv_group_epoch(self._h))
             if self._lib.kv_op_rejected(self._h):
                 raise PSRejectedError(f"KV {what} rejected: {err}")
             raise IOError(f"KV {what} failed: {err}")
@@ -1094,6 +1364,20 @@ class KVWorker:
                     for r in range(self.num_servers))
         return total / self.num_servers if per_worker_scale else float(total)
 
+    def set_epoch(self, epoch: int) -> None:
+        """ADMIN: flip every server of this handle to membership epoch
+        ``epoch`` (kv_protocol.h kEpoch SET) — the coordinator's fence
+        arm.  Ordinary clients never call this; they ANNOUNCE via the
+        constructor's ``epoch=`` and recover through ``route=``."""
+        if self._lib.kv_set_epoch(self._h, int(epoch)) != 0:
+            raise OSError("epoch set failed: "
+                          + self._lib.kv_last_error(self._h).decode())
+
+    def group_epoch(self) -> int:
+        """Newest membership epoch any server reported to this handle
+        (0 = never epoch-negotiated)."""
+        return int(self._lib.kv_group_epoch(self._h))
+
     def shutdown_servers(self) -> None:
         self._lib.kv_shutdown_servers(self._h)
 
@@ -1115,6 +1399,29 @@ class KVWorker:
         self.close()
 
 
+def parse_namespace_optimizers(spec) -> dict[str, str]:
+    """Per-namespace server optimizers from an extended ``--namespaces``
+    spec: ``"v1:ftrl,v2:sgd"`` -> ``{"v1": "ftrl", "v2": "sgd"}``.
+    Entries without a ``:opt`` suffix are omitted (they ride the
+    group-wide ``--ps-optimizer``); bare specs return ``{}``.  Only
+    ``sgd`` and ``ftrl`` are legal per-namespace (sign votes only mean
+    majority-vote through a UNIFORM signsgd group)."""
+    if not isinstance(spec, str):
+        return {}
+    opts: dict[str, str] = {}
+    for part in spec.split(","):
+        part = part.strip()
+        if not part or ":" not in part:
+            continue
+        mid, _, opt = part.partition(":")
+        mid, opt = mid.strip(), opt.strip()
+        if opt not in ("sgd", "ftrl"):
+            raise ValueError(
+                f"namespace optimizer must be sgd|ftrl, got {part!r}")
+        opts[mid] = opt
+    return opts
+
+
 def namespace_layout(models, per_model_dim: int) -> dict[str, tuple[int, int]]:
     """Pack equal-width model namespaces into one flat key space:
     ``{model_id: (base, per_model_dim)}`` in spec order — namespace
@@ -1123,9 +1430,13 @@ def namespace_layout(models, per_model_dim: int) -> dict[str, tuple[int, int]]:
     ``len(models) * per_model_dim``; spawn with ``num_servers`` such
     that range boundaries stay vals_per_key-aligned per namespace
     (equal-width namespaces + a server count dividing the model count,
-    or one server, always are)."""
+    or one server, always are).  Entries may carry a per-namespace
+    optimizer suffix (``"v1:ftrl,v2:sgd"`` — see
+    :func:`parse_namespace_optimizers`); the layout strips it, so
+    clients can repeat the server's spec verbatim."""
     if isinstance(models, str):
-        models = [m.strip() for m in models.split(",") if m.strip()]
+        models = [m.strip().partition(":")[0].strip()
+                  for m in models.split(",") if m.strip()]
     models = list(models)
     if not models:
         raise ValueError("namespace layout needs at least one model id")

@@ -1,0 +1,482 @@
+"""Membership coordination for an elastic KV server group.
+
+The reference's scheduler process exists for exactly one reason —
+dynamic membership (PAPER.md L1: "a scheduler process coordinating
+membership") — and it was the one ps-lite capability this reproduction
+dropped: key ranges frozen at spawn, worker counts fixed at launch.
+This module is that scheduler role, rebuilt on the native KV group's
+own primitives:
+
+* **epochs** — the group layout (which rank owns which key range) is
+  versioned by a u16 epoch riding the same header field (and the same
+  released-generation pattern) the barrier machinery already uses for
+  its generation ids (kv_protocol.h kEpoch).  Clients ANNOUNCE their
+  layout epoch per connection; a server whose epoch moved fences their
+  keyed ops with an unambiguous error carrying the new epoch, and the
+  client re-negotiates routing from this coordinator exactly the way
+  it already re-runs kHello on reconnect.
+* **live key-range migration** — :meth:`MembershipCoordinator.resize`
+  grows or shrinks the server group mid-run: spawn the new ranks at
+  the next epoch, FENCE the old ranks (arming the drain window), DRAIN
+  every moving sub-range (keyed ``pull`` from the old owner, forced
+  keyed init-``push`` into the new owner — FTRL groups migrate their
+  z/n accumulators through the same kOptState ops the supervisor's
+  snapshot path uses), COMMIT the layout, and publish it as ACTIVE.
+  Reusable processes (same range start) never move their resident
+  slice: doubling moves half the table, halving drains only the odd
+  ranks.
+* **in-flight safety** — writers mid-migration bounce off the fence
+  and re-route; a gradient push that straddled the flip is absorbed
+  through the established ``push_outcome_unknown`` path (some ranks
+  may have applied their slices before fencing), never double-applied.
+  The coordinator's own drain connections never announce an epoch, so
+  the control plane works THROUGH the fence — the same move the
+  supervisor's probes make against the chaos proxy.
+
+``launch ps-server --elastic`` embeds a :class:`MembershipServer`
+(announced as ``PSCTL host:port``); ``launch ps-ctl`` is the admin CLI
+against it (LAYOUT / STATUS / RESIZE n); :func:`layout_client` wraps
+the endpoint into the ``route=`` provider a
+:class:`~distlr_tpu.ps.client.KVWorker` follows automatically.
+
+Deliberately jax-free (like the router, obs-agg, and the chaos proxy):
+the scheduler is control-plane and must keep working while the data
+plane is on fire.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import socketserver
+import threading
+import time
+
+import numpy as np
+
+from distlr_tpu.obs import dtrace
+from distlr_tpu.obs.registry import get_registry
+from distlr_tpu.ps.client import KVWorker
+from distlr_tpu.ps.server import ResizePlan, ServerGroup
+from distlr_tpu.utils.logging import get_logger
+
+log = get_logger(__name__)
+
+_reg = get_registry()
+_EPOCH = _reg.gauge(
+    "distlr_membership_epoch",
+    "the group layout's CURRENT membership epoch (bumps once per "
+    "completed resize; clients at an older epoch are fenced and "
+    "re-route)",
+)
+_RESHARDS = _reg.counter(
+    "distlr_reshard_total",
+    "completed live reshards of the server group, by direction",
+    labelnames=("direction",),
+)
+_RESHARD_SECONDS = _reg.histogram(
+    "distlr_reshard_seconds",
+    "wall seconds per live reshard (fence -> drain -> commit -> "
+    "activate; the client-visible unavailability upper bound)",
+)
+_KEYS_MOVED = _reg.counter(
+    "distlr_reshard_keys_moved_total",
+    "flat parameter slots migrated between ranks by live reshards",
+)
+_BYTES_MOVED = _reg.counter(
+    "distlr_reshard_bytes_moved_total",
+    "payload bytes (keys + f32 values, opt-state included) moved by "
+    "live reshards",
+)
+_SEED_PUSHES = _reg.counter(
+    "distlr_reshard_seed_pushes_total",
+    "forced init-pushes issued by reshard drains (these tick the "
+    "servers' push clocks; subtract them when auditing applied vs "
+    "issued worker pushes across a migration)",
+)
+_RESHARD_FAILED = _reg.gauge(
+    "distlr_alert_reshard_failed",
+    "1 while the most recent live reshard failed and was rolled back "
+    "(the group still serves the OLD layout); clears on the next "
+    "successful resize",
+    labelnames=("threshold",),
+)
+
+
+class MembershipError(RuntimeError):
+    """A resize could not run (bad target, migration already in
+    flight, or a drain failure that was rolled back)."""
+
+
+class MembershipCoordinator:
+    """The scheduler role for ONE elastic async server group.
+
+    Owns the layout epoch, orchestrates live resharding over the
+    :class:`~distlr_tpu.ps.server.ServerGroup`'s plan/spawn/commit
+    mechanics, publishes the layout to clients (:meth:`layout` — the
+    ``route=`` provider for in-process consumers;
+    :class:`MembershipServer` serves it over TCP for everyone else),
+    and keeps the group's :class:`~distlr_tpu.ps.server.
+    ServerSupervisor` honest through the window (paused + re-bound, so
+    a retiring rank's exit never reads as a crash).
+    """
+
+    def __init__(self, group: ServerGroup, *, supervisor=None,
+                 drain_timeout_ms: int = 10_000,
+                 chunk_rows: int = 1 << 16):
+        self.group = group
+        self.supervisor = supervisor
+        self.drain_timeout_ms = int(drain_timeout_ms)
+        self.chunk_rows = int(chunk_rows)
+        self._lock = threading.Lock()
+        self._status = "active"
+        self._epoch = int(group.epoch)
+        #: (monotonic time, event, detail) audit trail, newest last
+        self.events: list[tuple[float, str, dict]] = []
+        #: stats of the last completed/failed resize (STATUS surface)
+        self.last_resize: dict | None = None
+        #: cumulative seed pushes per rank-agnostic total — the push-
+        #: clock audit hook (applied worker pushes = server clocks
+        #: minus these)
+        self.seed_pushes = 0
+        _EPOCH.set(self._epoch)
+        _RESHARD_FAILED.labels(threshold="0").set(0.0)
+
+    # -- layout publishing -------------------------------------------------
+    @property
+    def epoch(self) -> int:
+        return self._epoch
+
+    def layout(self) -> dict:
+        """The routing contract clients follow (the ``route=`` provider
+        of :class:`~distlr_tpu.ps.client.KVWorker`): proxied hosts when
+        the group rides a chaos plan — clients stay behind the faults —
+        with ``status: migrating`` telling them to poll, not connect."""
+        with self._lock:
+            return {
+                "status": self._status,
+                "epoch": self._epoch,
+                "hosts": self.group.hosts,
+                "dim": self.group.dim,
+                "num_servers": self.group.num_servers,
+            }
+
+    def status(self) -> dict:
+        with self._lock:
+            return {
+                "status": self._status,
+                "epoch": self._epoch,
+                "num_servers": self.group.num_servers,
+                "dim": self.group.dim,
+                "events": len(self.events),
+                "seed_pushes": self.seed_pushes,
+                "last_resize": self.last_resize,
+            }
+
+    def _record(self, event: str, **detail) -> None:
+        self.events.append((time.monotonic(), event, detail))
+        log.info("membership: %s %s", event, detail or "")
+
+    # -- drain plumbing ----------------------------------------------------
+    def _rank_conn(self, port: int, dim: int) -> KVWorker:
+        """A per-rank control-plane connection: direct port (the drain
+        must work THROUGH a chaos plan, like supervisor probes), never
+        epoch-announced (the fence must not stop the migration that
+        clears it)."""
+        return KVWorker(f"127.0.0.1:{port}", dim, client_id=0xFFFD,
+                        timeout_ms=self.drain_timeout_ms,
+                        sync_group=False)
+
+    def _fence(self, epoch: int) -> None:
+        """Arm the fence: flip every CURRENT rank to the new epoch.
+        From here, announced writers bounce and re-route; un-announced
+        legacy writers keep landing on the old owners — which is why
+        the drain runs strictly AFTER this."""
+        for rank, port in enumerate(self.group.ports):
+            lo, hi = self.group.key_range(rank)
+            with self._rank_conn(port, hi - lo) as kv:
+                kv.set_epoch(epoch)
+
+    def _unfence(self, epoch: int) -> None:
+        """Best-effort rollback of the fence (aborted migration)."""
+        for rank, port in enumerate(self.group.ports):
+            lo, hi = self.group.key_range(rank)
+            try:
+                with self._rank_conn(port, hi - lo) as kv:
+                    kv.set_epoch(epoch)
+            except OSError:
+                continue
+
+    def _drain(self, plan: ResizePlan, staged: dict[int, tuple]) -> int:
+        """Move every planned sub-range: keyed pull from the old owner,
+        forced keyed init-push into its new owner.  Returns payload
+        bytes moved.  FTRL groups (never reused by plan) additionally
+        migrate z/n via the kOptState ops, assembled full-range per new
+        rank (the wire only seeds full ranges)."""
+        bytes_moved = 0
+
+        def dst_port(nr: int) -> int:
+            if nr in plan.reuse:
+                return self.group.ports[plan.reuse[nr]]
+            return staged[nr][1]
+
+        for old_rank, lo, hi, nr in plan.moves:
+            olo, _ohi = self.group.key_range(old_rank)
+            nlo, nhi = plan.new_ranges[nr]
+            with dtrace.span("reshard.migrate", tags={
+                    "from": old_rank, "to": nr, "keys": hi - lo}):
+                with self._rank_conn(self.group.ports[old_rank],
+                                     self.group.key_range(old_rank)[1]
+                                     - olo) as src:
+                    vals = src.pull_chunked(
+                        np.arange(lo - olo, hi - olo, dtype=np.uint64),
+                        chunk_rows=self.chunk_rows)
+                with self._rank_conn(dst_port(nr), nhi - nlo) as dst:
+                    for clo in range(0, hi - lo, self.chunk_rows):
+                        chi = min(clo + self.chunk_rows, hi - lo)
+                        keys = np.arange(lo - nlo + clo, lo - nlo + chi,
+                                         dtype=np.uint64)
+                        dst.push_init(vals[clo:chi], keys=keys, force=True)
+                        self.seed_pushes += 1
+                        _SEED_PUSHES.inc()
+                bytes_moved += (hi - lo) * 12  # 8B key + 4B f32 per slot
+            _KEYS_MOVED.inc(hi - lo)
+        if self.group.has_ftrl:
+            # full-rebuild path (plan.reuse is empty for FTRL groups):
+            # capture every old rank's accumulators, re-seed each new
+            # rank's FULL range — a respawn-grade restore, so per-
+            # coordinate learning-rate schedules and L1 duals survive
+            # the reshard instead of degrading to a warm restart
+            from distlr_tpu.ps.client import PSRejectedError  # noqa: PLC0415
+
+            z = np.zeros(self.group.dim, np.float32)
+            n = np.zeros(self.group.dim, np.float32)
+            for rank, port in enumerate(self.group.ports):
+                lo, hi = self.group.key_range(rank)
+                with self._rank_conn(port, hi - lo) as kv:
+                    try:
+                        zr, nr_ = kv.pull_opt_state()
+                    except PSRejectedError:
+                        # an opt_segments rank with no FTRL slice of its
+                        # own: nothing to capture (z/n stay zeros)
+                        continue
+                    z[lo:hi] = zr
+                    n[lo:hi] = nr_
+            for nr2, (nlo, nhi) in enumerate(plan.new_ranges):
+                with self._rank_conn(dst_port(nr2), nhi - nlo) as kv:
+                    try:
+                        kv.push_init_opt_state(z[nlo:nhi], n[nlo:nhi],
+                                               force=True)
+                    except PSRejectedError:
+                        continue  # new rank hosts no FTRL coordinates
+                    self.seed_pushes += 1
+                    _SEED_PUSHES.inc()
+                bytes_moved += (nhi - nlo) * 16  # 8B key + 2 x 4B f32
+        _BYTES_MOVED.inc(bytes_moved)
+        return bytes_moved
+
+    # -- the tentpole ------------------------------------------------------
+    def resize(self, new_num_servers: int) -> dict:
+        """Live-reshard the group to ``new_num_servers`` ranks with
+        ZERO client restarts: spawn -> fence -> drain -> commit ->
+        activate.  Raises :class:`MembershipError` on a bad target or a
+        drain failure (the group is rolled back to the old layout and
+        ``distlr_alert_reshard_failed`` fires until the next success).
+        """
+        with self._lock:
+            if self._status != "active":
+                raise MembershipError(
+                    f"a migration is already in flight ({self._status})")
+            if new_num_servers == self.group.num_servers:
+                return {"epoch": self._epoch, "noop": True,
+                        "num_servers": self.group.num_servers}
+            if self._epoch >= 0xFFFF:
+                raise MembershipError("epoch space exhausted (65535)")
+            try:
+                plan = self.group.plan_resize(new_num_servers)
+            except ValueError as e:
+                raise MembershipError(str(e)) from e
+            self._status = "migrating"
+        direction = ("grow" if new_num_servers > self.group.num_servers
+                     else "shrink")
+        new_epoch = self._epoch + 1
+        t0 = time.monotonic()
+        self._record("resize_start", direction=direction,
+                     old=self.group.num_servers, new=new_num_servers,
+                     epoch=new_epoch, moves=len(plan.moves),
+                     reuse=len(plan.reuse))
+        if self.supervisor is not None:
+            self.supervisor.pause()
+        staged: dict[int, tuple] = {}
+        try:
+            with dtrace.span("reshard.resize", tags={
+                    "direction": direction, "new": new_num_servers,
+                    "epoch": new_epoch}):
+                staged = self.group.spawn_for_resize(plan, new_epoch)
+                self._fence(new_epoch)
+                bytes_moved = self._drain(plan, staged)
+                self.group.commit_resize(plan, staged, new_epoch)
+        except Exception as e:
+            # roll back: kill AND REAP the staged spawns (a long-lived
+            # coordinator must not accumulate zombies across failed
+            # resizes), drop the fence so the OLD layout serves again,
+            # surface the failure loudly
+            for proc, _port in staged.values():
+                if proc.poll() is None:
+                    proc.terminate()
+                if proc.stdout:
+                    proc.stdout.close()
+                proc.wait()
+            self._unfence(self._epoch)
+            with self._lock:
+                self._status = "active"
+            if self.supervisor is not None:
+                self.supervisor.resume()
+            _RESHARD_FAILED.labels(threshold="0").set(1.0)
+            self._record("resize_failed", error=str(e))
+            self.last_resize = {"ok": False, "error": str(e),
+                                "direction": direction}
+            raise MembershipError(f"resize failed (rolled back): {e}") from e
+        wall = time.monotonic() - t0
+        with self._lock:
+            self._epoch = new_epoch
+            self._status = "active"
+        if self.supervisor is not None:
+            self.supervisor.reset_layout()
+            self.supervisor.resume()
+        _EPOCH.set(new_epoch)
+        _RESHARDS.labels(direction=direction).inc()
+        _RESHARD_SECONDS.observe(wall)
+        _RESHARD_FAILED.labels(threshold="0").set(0.0)
+        stats = {
+            "ok": True,
+            "direction": direction,
+            "epoch": new_epoch,
+            "num_servers": self.group.num_servers,
+            "keys_moved": plan.moved_keys,
+            "bytes_moved": bytes_moved,
+            "reused": len(plan.reuse),
+            "spawned": len(plan.spawn),
+            "retired": len(plan.retire),
+            "seconds": round(wall, 4),
+        }
+        self.last_resize = stats
+        self._record("resize_done", **stats)
+        return stats
+
+
+# ---------------------------------------------------------------------------
+# the ps-ctl wire: a tiny line protocol over TCP
+# ---------------------------------------------------------------------------
+
+class _CtlHandler(socketserver.StreamRequestHandler):
+    def handle(self):
+        server: MembershipServer = self.server.membership  # type: ignore[attr-defined]
+        for raw in self.rfile:
+            line = raw.decode("utf-8", errors="replace").strip()
+            if not line:
+                continue
+            reply = server.handle_line(line)
+            try:
+                self.wfile.write((reply + "\n").encode())
+                self.wfile.flush()
+            except (BrokenPipeError, ConnectionResetError):
+                return
+
+
+class _CtlTCPServer(socketserver.ThreadingTCPServer):
+    daemon_threads = True
+    allow_reuse_address = True
+
+
+class MembershipServer:
+    """``launch ps-ctl``'s wire: LAYOUT / STATUS / RESIZE <n> over a
+    newline-delimited TCP protocol, every reply one JSON line — the
+    scheduler endpoint clients' ``route=`` providers poll
+    (:func:`layout_client`) and operators script against."""
+
+    def __init__(self, coordinator: MembershipCoordinator, *,
+                 host: str = "127.0.0.1", port: int = 0):
+        self.coordinator = coordinator
+        self._tcp = _CtlTCPServer((host, port), _CtlHandler,
+                                  bind_and_activate=True)
+        self._tcp.membership = self  # type: ignore[attr-defined]
+        self.host, self.port = self._tcp.server_address[:2]
+        self._thread = threading.Thread(target=self._tcp.serve_forever,
+                                        daemon=True, name="distlr-ps-ctl")
+        self._started = False
+
+    def handle_line(self, line: str) -> str:
+        parts = line.split()
+        verb = parts[0].upper()
+        try:
+            if verb == "LAYOUT" and len(parts) == 1:
+                return json.dumps(self.coordinator.layout())
+            if verb == "STATUS" and len(parts) == 1:
+                return json.dumps(self.coordinator.status())
+            if verb == "RESIZE" and len(parts) == 2:
+                # blocking by design: the reply IS the completion signal
+                # (a drain takes well under a second at bench scale;
+                # operators scripting huge tables can poll STATUS from a
+                # second connection)
+                return json.dumps(self.coordinator.resize(int(parts[1])))
+            return json.dumps({"ok": False,
+                               "error": f"unknown command {line!r} "
+                                        "(LAYOUT | STATUS | RESIZE <n>)"})
+        except (MembershipError, ValueError) as e:
+            return json.dumps({"ok": False, "error": str(e)})
+
+    def start(self) -> "MembershipServer":
+        if not self._started:
+            self._started = True
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        if self._started:
+            self._tcp.shutdown()
+        self._tcp.server_close()
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+
+
+def ctl_request(addr: str, line: str, *, timeout_s: float = 30.0) -> dict:
+    """One command against a :class:`MembershipServer` (``launch
+    ps-ctl``'s transport).  ``addr`` is ``host:port``; returns the
+    decoded JSON reply."""
+    host, _, port = addr.rpartition(":")
+    if not host or not port.isdigit():
+        raise ValueError(f"ps-ctl address must be host:port, got {addr!r}")
+    with socket.create_connection((host, int(port)),
+                                  timeout=timeout_s) as s:
+        f = s.makefile("rwb")
+        f.write((line.strip() + "\n").encode())
+        f.flush()
+        reply = f.readline()
+    if not reply:
+        raise ConnectionError(f"ps-ctl at {addr} closed mid-exchange")
+    return json.loads(reply.decode())
+
+
+def layout_client(addr: str, *, timeout_s: float = 5.0):
+    """Wrap a ``PSCTL host:port`` endpoint into the zero-arg ``route=``
+    provider a :class:`~distlr_tpu.ps.client.KVWorker` follows: each
+    call fetches the coordinator's current LAYOUT."""
+
+    def fetch() -> dict:
+        return ctl_request(addr, "LAYOUT", timeout_s=timeout_s)
+
+    return fetch
+
+
+__all__ = [
+    "MembershipCoordinator",
+    "MembershipError",
+    "MembershipServer",
+    "ctl_request",
+    "layout_client",
+]

@@ -80,6 +80,19 @@ struct Client {
   // 0 = dense f32.  Set ONLY by kv_negotiate_codec after the kHello
   // capability handshake proved every server decodes it.
   uint8_t codec = 0;
+  // Membership epoch (kv_protocol.h kEpoch): the layout epoch this
+  // handle ANNOUNCED to every server (0 = never announced — no
+  // fencing), set by kv_negotiate_epoch after the kHello handshake
+  // proved every server speaks kEpoch.
+  uint16_t announced_epoch = 0;
+  // Last failure was an epoch-fence rejection: the server's layout
+  // epoch moved past announced_epoch (membership changed mid-op).  The
+  // caller must re-fetch the layout from the membership coordinator
+  // and reconnect — NOT retry in place (the op would bounce forever)
+  // and NOT treat it as a config rejection (it is transient by
+  // design).  server_epoch carries the epoch the server reported.
+  bool epoch_mismatch = false;
+  uint16_t server_epoch = 0;
   // Distributed-trace capability (kv_protocol.h kTraced/kCapTrace):
   // set ONLY by kv_negotiate_trace after every server advertised it.
   bool trace_ok = false;
@@ -202,6 +215,7 @@ int RoundTrip(Client* c, Op op, const Key* keys, const float* vals,
               uint16_t barrier_id = 0, uint64_t vpk = 1) {
   c->timed_out = false;
   c->op_rejected = false;
+  c->epoch_mismatch = false;
   c->op_delivery_began = false;
   c->wire_sent = 0;
   if (c->poisoned) {
@@ -365,6 +379,26 @@ int RoundTrip(Client* c, Op op, const Key* keys, const float* vals,
     const uint64_t expected =
         (op == Op::kPull || op == Op::kPushPull) ? (e - b) * vpk * mult : 0;
     if (rh.flags & kError) {
+      if (rh.op == static_cast<uint8_t>(Op::kEpoch) && op != Op::kEpoch) {
+        // Epoch fence (kv_protocol.h kEpoch): the server's layout
+        // epoch moved past what this handle announced — membership
+        // changed.  Distinct from op_rejected: a config rejection is
+        // deterministic forever, this one clears the moment the caller
+        // re-negotiates routing from the coordinator and reconnects.
+        // Still poisons a multi-server handle (peers' replies were
+        // abandoned mid-collection) — which is fine, the re-route
+        // rebuilds the handle anyway.
+        c->poisoned = c->servers.size() > 1;
+        c->epoch_mismatch = true;
+        c->server_epoch = rh.aux;
+        snprintf(c->err, sizeof(c->err),
+                 "server %zu fenced op %d at membership epoch %u (this "
+                 "client announced %u): the group layout changed — "
+                 "re-negotiate routing", s, static_cast<int>(op),
+                 static_cast<unsigned>(rh.aux),
+                 static_cast<unsigned>(c->announced_epoch));
+        return -1;
+      }
       // Explicit protocol-level rejection (e.g. an opt-state op against
       // a non-FTRL server): a caller error with a clean, still-framed
       // stream — named, and not poisoned on the single-server handles
@@ -522,6 +556,72 @@ int kv_push_pull_vpk(void* handle, const uint64_t* keys, const float* vals,
                            distlr::kNone, 0, vpk);
 }
 
+static double WallNowS() {
+  timeval tv{};
+  gettimeofday(&tv, nullptr);
+  return static_cast<double>(tv.tv_sec) + 1e-6 * tv.tv_usec;
+}
+
+// One kHello capability round trip toward server s — THE shared copy of
+// the hello-reply framing (codec / trace / epoch negotiators all call
+// it; three hand-rolled parses of the same frame would drift apart on
+// the next reply extension).  `flags`: kNone, or kTraced to ask for the
+// server's wall clock in the reply.  A legacy server's empty reply
+// reads as mask 0 ("no capabilities").  Accepts 0/2/4 Val slots (the
+// 4-slot form only arrives for kTraced requests); when `clock_offset`
+// is non-null and the clock arrived, fills the symmetric-RTT offset
+// estimate (server minus client, seconds).  Returns 0, or -1 on a
+// transport/framing failure (handle poisoned, err set).
+static int HelloProbe(distlr::Client* c, size_t s, uint8_t flags,
+                      uint64_t* mask, double* clock_offset) {
+  const uint32_t ts = c->next_ts++;
+  distlr::MsgHeader h{distlr::kMagic,
+                      static_cast<uint8_t>(distlr::Op::kHello),
+                      flags, 0, c->client_id, ts, 0};
+  const int fd = c->servers[s].fd;
+  const double t0 = WallNowS();
+  if (!distlr::WriteFull(fd, &h, sizeof(h))) {
+    c->poisoned = true;
+    snprintf(c->err, sizeof(c->err), "hello to server %zu failed", s);
+    return -1;
+  }
+  distlr::MsgHeader rh{};
+  errno = 0;
+  if (!distlr::ReadFull(fd, &rh, sizeof(rh))) {
+    c->poisoned = true;
+    c->timed_out = errno == EAGAIN || errno == EWOULDBLOCK;
+    snprintf(c->err, sizeof(c->err), "no hello reply from server %zu", s);
+    return -1;
+  }
+  if (rh.magic != distlr::kMagic || !(rh.flags & distlr::kResponse) ||
+      rh.timestamp != ts ||
+      (rh.num_keys != 0 && rh.num_keys != 2 && rh.num_keys != 4)) {
+    c->poisoned = true;
+    snprintf(c->err, sizeof(c->err), "bad hello reply from server %zu", s);
+    return -1;
+  }
+  *mask = 0;  // legacy empty reply: no capabilities
+  if (rh.num_keys) {
+    double d[2] = {0.0, 0.0};
+    static_assert(sizeof(d[0]) == 2 * sizeof(distlr::Val),
+                  "capability mask layout");
+    if (!distlr::ReadFull(fd, d, rh.num_keys * sizeof(distlr::Val))) {
+      c->poisoned = true;
+      snprintf(c->err, sizeof(c->err),
+               "short hello reply from server %zu", s);
+      return -1;
+    }
+    *mask = static_cast<uint64_t>(d[0]);
+    if (clock_offset != nullptr && rh.num_keys == 4) {
+      // symmetric-RTT estimate: the server stamped d[1] roughly at the
+      // round trip's midpoint
+      const double t1 = WallNowS();
+      *clock_offset = d[1] - (t0 + (t1 - t0) / 2.0);
+    }
+  }
+  return 0;
+}
+
 // --- gradient-codec negotiation (kv_protocol.h capability handshake).
 // Sends kHello to EVERY server and intersects the capability masks: a
 // legacy server's empty reply reads as "no capabilities", so the
@@ -547,46 +647,8 @@ int kv_negotiate_codec(void* handle, int want) {
   }
   uint64_t caps = ~0ull;
   for (size_t s = 0; s < c->servers.size(); ++s) {
-    const uint32_t ts = c->next_ts++;
-    distlr::MsgHeader h{distlr::kMagic,
-                        static_cast<uint8_t>(distlr::Op::kHello),
-                        distlr::kNone, 0, c->client_id, ts, 0};
-    const int fd = c->servers[s].fd;
-    if (!distlr::WriteFull(fd, &h, sizeof(h))) {
-      c->poisoned = true;
-      snprintf(c->err, sizeof(c->err), "hello to server %zu failed", s);
-      return -1;
-    }
-    distlr::MsgHeader rh{};
-    errno = 0;
-    if (!distlr::ReadFull(fd, &rh, sizeof(rh))) {
-      c->poisoned = true;
-      c->timed_out = errno == EAGAIN || errno == EWOULDBLOCK;
-      snprintf(c->err, sizeof(c->err),
-               "no hello reply from server %zu", s);
-      return -1;
-    }
-    if (rh.magic != distlr::kMagic || !(rh.flags & distlr::kResponse) ||
-        rh.timestamp != ts ||
-        (rh.num_keys != 0 && rh.num_keys != 2)) {
-      c->poisoned = true;
-      snprintf(c->err, sizeof(c->err),
-               "bad hello reply from server %zu", s);
-      return -1;
-    }
-    uint64_t mask = 0;  // legacy empty reply: no capabilities
-    if (rh.num_keys == 2) {
-      double d = 0.0;
-      static_assert(sizeof(d) == 2 * sizeof(distlr::Val),
-                    "capability mask layout");
-      if (!distlr::ReadFull(fd, &d, sizeof(d))) {
-        c->poisoned = true;
-        snprintf(c->err, sizeof(c->err),
-                 "short hello reply from server %zu", s);
-        return -1;
-      }
-      mask = static_cast<uint64_t>(d);
-    }
+    uint64_t mask = 0;
+    if (HelloProbe(c, s, distlr::kNone, &mask, nullptr) < 0) return -1;
     caps &= mask;
   }
   c->codec = (caps & (1ull << want)) ? static_cast<uint8_t>(want) : 0;
@@ -609,12 +671,6 @@ uint64_t kv_last_wire_sent(void* handle) {
 // failure.  The hello round trip doubles as a clock-skew probe: the
 // estimated per-server offset (server minus client, symmetric-RTT
 // assumption) is kept for kv_clock_offset.
-static double WallNowS() {
-  timeval tv{};
-  gettimeofday(&tv, nullptr);
-  return static_cast<double>(tv.tv_sec) + 1e-6 * tv.tv_usec;
-}
-
 int kv_negotiate_trace(void* handle) {
   auto* c = static_cast<distlr::Client*>(handle);
   c->timed_out = false;
@@ -628,50 +684,12 @@ int kv_negotiate_trace(void* handle) {
   c->clock_offsets.assign(c->servers.size(), 0.0);
   uint64_t caps = ~0ull;
   for (size_t s = 0; s < c->servers.size(); ++s) {
-    const uint32_t ts = c->next_ts++;
-    distlr::MsgHeader h{distlr::kMagic,
-                        static_cast<uint8_t>(distlr::Op::kHello),
-                        distlr::kTraced, 0, c->client_id, ts, 0};
-    const int fd = c->servers[s].fd;
-    const double t0 = WallNowS();
     // kTraced on a kHello carries NO trailer: the flag here only asks
     // the server to include its clock in the reply (kv_protocol.h).
-    if (!distlr::WriteFull(fd, &h, sizeof(h))) {
-      c->poisoned = true;
-      snprintf(c->err, sizeof(c->err), "hello to server %zu failed", s);
+    uint64_t mask = 0;
+    if (HelloProbe(c, s, distlr::kTraced, &mask,
+                   &c->clock_offsets[s]) < 0) {
       return -1;
-    }
-    distlr::MsgHeader rh{};
-    errno = 0;
-    if (!distlr::ReadFull(fd, &rh, sizeof(rh))) {
-      c->poisoned = true;
-      c->timed_out = errno == EAGAIN || errno == EWOULDBLOCK;
-      snprintf(c->err, sizeof(c->err), "no hello reply from server %zu", s);
-      return -1;
-    }
-    if (rh.magic != distlr::kMagic || !(rh.flags & distlr::kResponse) ||
-        rh.timestamp != ts ||
-        (rh.num_keys != 0 && rh.num_keys != 2 && rh.num_keys != 4)) {
-      c->poisoned = true;
-      snprintf(c->err, sizeof(c->err), "bad hello reply from server %zu", s);
-      return -1;
-    }
-    uint64_t mask = 0;  // legacy empty reply: no capabilities
-    if (rh.num_keys) {
-      double d[2] = {0.0, 0.0};
-      if (!distlr::ReadFull(fd, d, rh.num_keys * sizeof(distlr::Val))) {
-        c->poisoned = true;
-        snprintf(c->err, sizeof(c->err),
-                 "short hello reply from server %zu", s);
-        return -1;
-      }
-      mask = static_cast<uint64_t>(d[0]);
-      if (rh.num_keys == 4) {
-        const double t1 = WallNowS();
-        // symmetric-RTT estimate: the server stamped d[1] roughly at
-        // the round trip's midpoint
-        c->clock_offsets[s] = d[1] - (t0 + (t1 - t0) / 2.0);
-      }
     }
     caps &= mask;
   }
@@ -696,6 +714,131 @@ double kv_clock_offset(void* handle, uint32_t server) {
   auto* c = static_cast<distlr::Client*>(handle);
   if (server >= c->clock_offsets.size()) return 0.0;
   return c->clock_offsets[server];
+}
+
+// --- membership-epoch ops (kv_protocol.h kEpoch) -----------------------
+
+// One kEpoch round trip toward server s; returns the server's epoch
+// (>= 1) or -1 on transport failure (handle poisoned).
+static int EpochRoundTrip(distlr::Client* c, size_t s, uint8_t flags,
+                          uint16_t aux) {
+  const uint32_t ts = c->next_ts++;
+  distlr::MsgHeader h{distlr::kMagic,
+                      static_cast<uint8_t>(distlr::Op::kEpoch),
+                      flags, aux, c->client_id, ts, 0};
+  const int fd = c->servers[s].fd;
+  if (!distlr::WriteFull(fd, &h, sizeof(h))) {
+    c->poisoned = true;
+    snprintf(c->err, sizeof(c->err), "epoch op to server %zu failed", s);
+    return -1;
+  }
+  distlr::MsgHeader rh{};
+  errno = 0;
+  if (!distlr::ReadFull(fd, &rh, sizeof(rh))) {
+    c->poisoned = true;
+    c->timed_out = errno == EAGAIN || errno == EWOULDBLOCK;
+    snprintf(c->err, sizeof(c->err),
+             "no epoch reply from server %zu", s);
+    return -1;
+  }
+  if (rh.magic != distlr::kMagic || !(rh.flags & distlr::kResponse) ||
+      rh.timestamp != ts || rh.num_keys != 0) {
+    c->poisoned = true;
+    snprintf(c->err, sizeof(c->err), "bad epoch reply from server %zu", s);
+    return -1;
+  }
+  return static_cast<int>(rh.aux);
+}
+
+// Announce a layout epoch to every server of the group (arming the
+// per-connection fence), after a kHello capability pass proved they all
+// speak kEpoch.  Returns:
+//   epoch  — every server confirmed this epoch; fencing armed;
+//   other  — some server is already at a DIFFERENT epoch (its value is
+//            returned): the layout this handle was built from is stale,
+//            re-fetch it from the coordinator and reconnect;
+//   0      — some server predates the membership protocol (no kCapEpoch;
+//            graceful fallback: no fencing, like a pre-epoch client);
+//   -1     — transport failure (handle poisoned).
+int kv_negotiate_epoch(void* handle, int epoch) {
+  auto* c = static_cast<distlr::Client*>(handle);
+  c->timed_out = false;
+  c->epoch_mismatch = false;
+  if (c->poisoned) {
+    snprintf(c->err, sizeof(c->err),
+             "connection poisoned by an earlier receive failure; "
+             "reconnect (kv_connect) before issuing more ops");
+    return -1;
+  }
+  if (epoch < 1 || epoch > 0xFFFF) {
+    snprintf(c->err, sizeof(c->err),
+             "epoch must be in [1, 65535], got %d", epoch);
+    return -1;
+  }
+  // capability pass: a kEpoch frame against a pre-epoch binary would
+  // never be answered (unknown ops are skipped, not nacked), so probe
+  // with kHello first — the same additive-negotiation move the codec
+  // and trace capabilities made.
+  uint64_t caps = ~0ull;
+  for (size_t s = 0; s < c->servers.size(); ++s) {
+    uint64_t mask = 0;
+    if (HelloProbe(c, s, distlr::kNone, &mask, nullptr) < 0) return -1;
+    caps &= mask;
+  }
+  if (!(caps & distlr::kCapEpoch)) return 0;  // graceful: no fencing
+  for (size_t s = 0; s < c->servers.size(); ++s) {
+    const int got = EpochRoundTrip(c, s, distlr::kNone,
+                                   static_cast<uint16_t>(epoch));
+    if (got < 0) return -1;
+    if (got != epoch) {
+      // this handle was built from a stale layout: report the newer
+      // epoch so the caller re-fetches routing before any data op
+      c->server_epoch = static_cast<uint16_t>(got);
+      return got;
+    }
+  }
+  c->announced_epoch = static_cast<uint16_t>(epoch);
+  c->server_epoch = static_cast<uint16_t>(epoch);
+  return epoch;
+}
+
+// ADMIN: flip every server of this handle to `epoch` (the membership
+// coordinator's fence-arming set — coordinators hold per-rank handles,
+// so "every server" is usually one).  Returns 0, or -1 on failure.
+int kv_set_epoch(void* handle, int epoch) {
+  auto* c = static_cast<distlr::Client*>(handle);
+  c->timed_out = false;
+  if (c->poisoned) {
+    snprintf(c->err, sizeof(c->err),
+             "connection poisoned by an earlier receive failure; "
+             "reconnect (kv_connect) before issuing more ops");
+    return -1;
+  }
+  if (epoch < 1 || epoch > 0xFFFF) {
+    snprintf(c->err, sizeof(c->err),
+             "epoch must be in [1, 65535], got %d", epoch);
+    return -1;
+  }
+  for (size_t s = 0; s < c->servers.size(); ++s) {
+    if (EpochRoundTrip(c, s, distlr::kForceInit,
+                       static_cast<uint16_t>(epoch)) < 0) {
+      return -1;
+    }
+  }
+  return 0;
+}
+
+// 1 if the most recent failed op was an epoch-fence rejection (the
+// group layout changed): re-fetch the layout and reconnect — never
+// retry in place, never treat as a config rejection.
+int kv_epoch_mismatch(void* handle) {
+  return static_cast<distlr::Client*>(handle)->epoch_mismatch ? 1 : 0;
+}
+
+// The newest membership epoch any server reported to this handle
+// (via negotiation or a fence rejection); 0 = never epoch-negotiated.
+int kv_group_epoch(void* handle) {
+  return static_cast<distlr::Client*>(handle)->server_epoch;
 }
 
 // --- FTRL opt-state snapshot/restore (kOptState, kv_protocol.h).

@@ -63,6 +63,34 @@ enum class Op : uint8_t {
   // what the worker's NEXT pull would have returned (rounds are totally
   // ordered), so the fused trajectory is bit-identical to pull+push.
   kPushPull = 7,
+  // Membership epoch (the elastic-fleet round): the group layout —
+  // which rank owns which key range — is versioned by a u16 epoch that
+  // rides MsgHeader::aux, the same field (and the same released-
+  // generation pattern) the barrier machinery already uses for its
+  // generation ids.  Three forms:
+  //   * ANNOUNCE (flags kNone, aux = E > 0): this connection expects
+  //     layout epoch E.  From then on every keyed data op (push / pull
+  //     / push_pull, incl. init forms) is FENCED: if the server's
+  //     epoch differs, the op is answered — after its payload is fully
+  //     read, so the stream stays framed — with an error frame whose
+  //     op is kEpoch (not the echoed data op; that is what makes the
+  //     fence unambiguous to the client) and whose aux carries the
+  //     server's CURRENT epoch.  The client re-negotiates routing from
+  //     the membership coordinator exactly the way it already re-runs
+  //     kHello on reconnect; an in-flight push that straddled the flip
+  //     is absorbed through the push-outcome-unknown path (some ranks
+  //     may have applied their slices), never re-issued.
+  //   * QUERY (flags kNone, aux = 0): no announcement; the reply's aux
+  //     is the server's current epoch.
+  //   * SET (flags kForceInit, aux = E): ADMIN — the membership
+  //     coordinator flips the server to epoch E (the fence arming the
+  //     drain window).  Replies aux = E.
+  // Un-announced connections (legacy clients, supervisor probes, the
+  // coordinator's own drain pulls/seeds) are never fenced — the
+  // control plane must work THROUGH a migration, and a pre-epoch
+  // client of a static group sees zero behavior change.  Epochs start
+  // at 1; 0 means "not announced".
+  kEpoch = 8,
 };
 
 // kStats response payload, in order: dim, initialized,
@@ -88,8 +116,12 @@ enum class Op : uint8_t {
 // §5.3: a dead worker deadlocks the sync barrier forever with no
 // diagnostic) — a supervisor polling kStats sees pending_sync_pushes
 // stuck below num_workers and can name the straggler condition.
+// Slot 10 (the membership round, additive like the CPU tail): the
+// server's current layout EPOCH — so one health probe shows a mixed-
+// epoch group mid-migration, and `distlr_ps_server_stat{stat="epoch"}`
+// scrapes the flip.
 constexpr uint64_t kStatsValsV1 = 6;
-constexpr uint64_t kStatsVals = 10;
+constexpr uint64_t kStatsVals = 11;
 
 enum Flags : uint8_t {
   kNone = 0,
@@ -279,6 +311,12 @@ constexpr uint64_t kCapCodecSign = 1ull << kCodecSign;
 // Plain kHello requests keep the 2-slot reply, so pre-trace clients
 // never see a frame shape they cannot parse.
 constexpr uint64_t kCapTrace = 1ull << 8;
+// The server speaks the kEpoch membership op (announce/query/set) and
+// fences announced connections on epoch mismatch — the elastic-fleet
+// capability.  A client must see this from EVERY server before
+// announcing an epoch: a kEpoch frame against a pre-epoch binary would
+// never be answered (unknown ops are skipped, not nacked).
+constexpr uint64_t kCapEpoch = 1ull << 9;
 
 #pragma pack(push, 1)
 struct MsgHeader {

@@ -29,6 +29,14 @@
 //                    [--sync=1] [--last_gradient=0] [--bind_any=0]
 //                    [--optimizer=sgd] [--ftrl_alpha=0.1] [--ftrl_beta=1]
 //                    [--ftrl_l1=0] [--ftrl_l2=0] [--compress=1]
+//                    [--epoch=1]  (initial membership epoch; see kEpoch
+//                                  in kv_protocol.h — elastic groups)
+//                    [--opt_segments=end:opt,...]  (per-LOCAL-key-range
+//                        optimizer map: keys < end1 use opt1, then <
+//                        end2 use opt2, ...; keys past the last end use
+//                        --optimizer.  The per-namespace-optimizer
+//                        capability: one group hosts an FTRL namespace
+//                        next to an SGD one.  sgd|ftrl only.)
 //                    [--trace_journal=<path>]  (per-handler span JSONL for
 //                                               `launch trace-agg`)
 //                    [--prof_journal=<path>] [--prof_window=10]
@@ -160,15 +168,21 @@ class KVServer {
            bool last_gradient, bool bind_any, uint64_t max_dim,
            Opt opt, FtrlParams ftrl_params, bool compress,
            std::string trace_journal, std::string prof_journal,
-           double prof_window_s)
+           double prof_window_s, uint16_t epoch,
+           std::vector<std::pair<uint64_t, Opt>> opt_segments)
       : port_(port), num_workers_(num_workers), lr_(lr), sync_(sync),
         last_gradient_(last_gradient), bind_any_(bind_any),
         max_dim_(max_dim), opt_(opt), fp_(ftrl_params),
         compress_(compress), trace_journal_(std::move(trace_journal)),
         prof_journal_(std::move(prof_journal)),
-        prof_window_s_(prof_window_s) {
+        prof_window_s_(prof_window_s), epoch_(epoch),
+        opt_segments_(std::move(opt_segments)) {
     weights_.resize(dim, 0.0f);
-    if (opt_ == Opt::kFtrl) {
+    has_ftrl_ = opt_ == Opt::kFtrl;
+    for (const auto& seg : opt_segments_) {
+      if (seg.second == Opt::kFtrl) has_ftrl_ = true;
+    }
+    if (has_ftrl_) {
       z_.resize(dim, 0.0f);
       nacc_.resize(dim, 0.0f);
     }
@@ -481,6 +495,10 @@ class KVServer {
           break;
         }
         if (traced) tr_decoded = WallNowS();
+        if (EpochFence(fd, h)) {
+          AccumulateCpu(op, cpu0);
+          continue;  // payload fully read above — the stream stays framed
+        }
         if (opt_state) {
           HandleOptStatePush(fd, hf, *use_keys, vals, max_key);
         } else {
@@ -493,6 +511,10 @@ class KVServer {
         }
       } else if (op == Op::kPull) {
         if (traced) tr_decoded = WallNowS();
+        if (EpochFence(fd, h)) {
+          AccumulateCpu(op, cpu0);
+          continue;
+        }
         if (h.flags & kOptState) {
           HandleOptStatePull(fd, hf, *use_keys, max_key);
         } else {
@@ -511,6 +533,8 @@ class KVServer {
         HandleStats(fd, h);
       } else if (op == Op::kHello) {
         HandleHello(fd, h);
+      } else if (op == Op::kEpoch) {
+        HandleEpoch(fd, h);
       } else if (op == Op::kShutdown) {
         Respond(fd, h, nullptr, 0);
         shutdown_.store(true);
@@ -559,11 +583,55 @@ class KVServer {
     DropConnection(fd);
     {
       std::lock_guard<std::mutex> lock(mu_);
+      conn_epoch_.erase(fd);
       for (auto it = active_fds_.begin(); it != active_fds_.end(); ++it) {
         if (*it == fd) { active_fds_.erase(it); break; }
       }
     }
     close(fd);
+  }
+
+  // --- EPOCH fence (kv_protocol.h kEpoch): a connection that ANNOUNCED
+  // a layout epoch gets its keyed data ops rejected — with the server's
+  // current epoch, on a still-framed stream — the moment the epochs
+  // diverge.  The rejection frame's op is kEpoch (not the echoed data
+  // op), which is what lets the client distinguish "membership changed,
+  // re-negotiate routing" from an ordinary kError config rejection.
+  // Un-announced connections (legacy clients, supervisors, the
+  // migration drain itself) pass untouched. ---
+  bool EpochFence(int fd, const MsgHeader& h) {
+    uint16_t current;
+    {
+      std::lock_guard<std::mutex> lock(mu_);
+      auto it = conn_epoch_.find(fd);
+      if (it == conn_epoch_.end() || it->second == epoch_) return false;
+      current = epoch_;
+    }
+    MsgHeader eh = h;
+    eh.op = static_cast<uint8_t>(Op::kEpoch);
+    eh.aux = current;
+    RespondError(fd, eh);
+    return true;
+  }
+
+  // --- kEpoch: membership announce / query / admin set (kv_protocol.h).
+  // Control plane like kStats/kHello: never deferred, never fenced. ---
+  void HandleEpoch(int fd, const MsgHeader& h) {
+    MsgHeader eh = h;
+    {
+      std::lock_guard<std::mutex> lock(mu_);
+      if (h.flags & kForceInit) {
+        // admin SET: the membership coordinator arms the fence — every
+        // connection still announced at the old epoch starts bouncing
+        epoch_ = h.aux;
+        fprintf(stderr, "[distlr_kv_server] membership epoch -> %u\n",
+                static_cast<unsigned>(h.aux));
+      } else if (h.aux != 0) {
+        conn_epoch_[fd] = h.aux;  // announce: arm the fence for this conn
+      }
+      eh.aux = epoch_;
+    }
+    Respond(fd, eh, nullptr, 0);
   }
 
   void Respond(int fd, MsgHeader h, const Val* vals, uint64_t nvals) {
@@ -593,7 +661,7 @@ class KVServer {
       Respond(fd, h, nullptr, 0);
       return;
     }
-    uint64_t mask = kCapCodecInt8 | kCapTrace;
+    uint64_t mask = kCapCodecInt8 | kCapTrace | kCapEpoch;
     // sign votes only mean majority-vote through the signsgd kernel;
     // any other optimizer would apply sign-mean, so don't offer it
     if (opt_ == Opt::kSign) mask |= kCapCodecSign;
@@ -669,7 +737,7 @@ class KVServer {
     try {
       weights_.resize(max_key + 1, 0.0f);
       merge_.resize(weights_.size(), 0.0f);
-      if (opt_ == Opt::kFtrl) {
+      if (has_ftrl_) {
         z_.resize(weights_.size(), 0.0f);
         nacc_.resize(weights_.size(), 0.0f);
       }
@@ -682,14 +750,14 @@ class KVServer {
       // astronomically unlikely and only costs footprint, not state.
       weights_.resize(old_w);
       merge_.resize(old_m);
-      if (opt_ == Opt::kFtrl) {
+      if (has_ftrl_) {
         z_.resize(old_z);
         nacc_.resize(old_z);
       }
       try {
         weights_.shrink_to_fit();
         merge_.shrink_to_fit();
-        if (opt_ == Opt::kFtrl) {
+        if (has_ftrl_) {
           z_.shrink_to_fit();
           nacc_.shrink_to_fit();
         }
@@ -719,6 +787,18 @@ class KVServer {
                   ((fp_.beta + std::sqrt(n_new)) / fp_.alpha + fp_.l2);
   }
 
+  // The optimizer governing one coordinate: the --opt_segments map when
+  // present (per-namespace optimizers: keys < end_i use opt_i, in
+  // ascending-end order), else the global --optimizer.  Segment lists
+  // are tiny (one entry per hosted namespace), so a linear scan beats
+  // anything clever.
+  inline Opt OptFor(Key k) const {
+    for (const auto& seg : opt_segments_) {
+      if (k < seg.first) return seg.second;
+    }
+    return opt_;
+  }
+
   // Apply one gradient value to one coordinate under the configured
   // optimizer — THE pluggable update this server exists to serialize.
   // FTRL skips zero gradients (no information; and re-deriving w from
@@ -726,9 +806,10 @@ class KVServer {
   // seeds weights_ directly and leaves z/n at 0 until real traffic).
   // signSGD async is the one-voter majority: w -= lr * sign(g).
   inline void ApplyGrad(Key k, float g) {
-    if (opt_ == Opt::kFtrl) {
+    const Opt o = opt_segments_.empty() ? opt_ : OptFor(k);
+    if (o == Opt::kFtrl) {
       if (g != 0.0f) FtrlStep(k, g);
-    } else if (opt_ == Opt::kSign) {
+    } else if (o == Opt::kSign) {
       if (g > 0.0f) weights_[k] -= lr_;
       else if (g < 0.0f) weights_[k] += lr_;
     } else {
@@ -831,6 +912,18 @@ class KVServer {
           for (size_t i = 0; i < pick->keys.size(); ++i)
             weights_[pick->keys[i]] -= lr_ * pick->vals[i] / w;
         }
+      } else if (!opt_segments_.empty()) {
+        // Per-namespace optimizers (sgd|ftrl segments): dispatch the
+        // round's mean gradient per coordinate.  Uniform groups keep
+        // the verbatim loops below — those trajectories are
+        // oracle-pinned and must not change by a single operation.
+        for (size_t i = 0; i < merge_.size(); ++i) {
+          if (OptFor(i) == Opt::kFtrl) {
+            if (merge_[i] != 0.0f) FtrlStep(i, merge_[i] / w);
+          } else {
+            weights_[i] -= lr_ * merge_[i] / w;
+          }
+        }
       } else if (opt_ == Opt::kFtrl) {
         // FTRL BSP: ONE optimizer step on the round's mean gradient,
         // untouched (zero-merge) coordinates skipped — see ApplyGrad.
@@ -907,7 +1000,7 @@ class KVServer {
   // 2x vals per expanded key, both directions. ---
   void HandleOptStatePull(int fd, const MsgHeader& h,
                           const std::vector<Key>& keys, Key max_key) {
-    if (opt_ != Opt::kFtrl) {
+    if (!has_ftrl_) {  // any FTRL segment allocates z/n (zeros elsewhere)
       RespondError(fd, h);
       return;
     }
@@ -930,7 +1023,7 @@ class KVServer {
                           const std::vector<Val>& vals, Key max_key) {
     // ServeLoop enforced kInitPush: this is the idempotent seed form
     // only, replied immediately, never merged (mirrors weight init).
-    if (opt_ != Opt::kFtrl) {
+    if (!has_ftrl_) {
       RespondError(fd, h);
       return;
     }
@@ -985,6 +1078,9 @@ class KVServer {
       stats[3] = static_cast<double>(waiters);
       stats[4] = static_cast<double>(n_push_);
       stats[5] = static_cast<double>(n_pull_);
+      // slot 10 (the membership round): this rank's layout epoch — a
+      // health probe of a migrating group reads the flip rank by rank
+      stats[kStatsValsV1 + kCpuSlots] = static_cast<double>(epoch_);
     }
     // per-handler thread-CPU seconds (the continuous-profiling
     // extension; atomic — no mu_ needed)
@@ -1142,6 +1238,15 @@ class KVServer {
 
   std::mutex mu_;
   bool initialized_ = false;
+  //: membership epoch (kv_protocol.h kEpoch; guarded by mu_): flipped
+  //: by the coordinator's admin SET, fencing announced connections
+  uint16_t epoch_;
+  //: per-connection announced epoch (fd -> epoch; guarded by mu_)
+  std::unordered_map<int, uint16_t> conn_epoch_;
+  //: per-local-key-range optimizer map (--opt_segments; immutable after
+  //: construction) and whether ANY coordinate runs FTRL (z_/nacc_ live)
+  std::vector<std::pair<uint64_t, Opt>> opt_segments_;
+  bool has_ftrl_ = false;
   uint64_t n_push_ = 0;
   uint64_t n_pull_ = 0;
   std::vector<Val> weights_;
@@ -1254,9 +1359,77 @@ int main(int argc, char** argv) {
                  "(got %g)\n", prof_window);
     return 2;
   }
+  // Membership epoch (kv_protocol.h kEpoch): elastic groups spawn each
+  // rank at the layout epoch it belongs to; 0 is reserved ("no
+  // announcement"), so epochs live in [1, 65535].
+  const long epoch = Arg(argc, argv, "epoch", 1);
+  if (epoch < 1 || epoch > 0xFFFF) {
+    std::fprintf(stderr, "[distlr_kv_server] --epoch must be in "
+                 "[1, 65535], got %ld\n", epoch);
+    return 2;
+  }
+  // Per-local-key-range optimizer map (--opt_segments=end:opt,...):
+  // ascending ends, sgd|ftrl only (sign votes only mean majority vote
+  // through a uniform signsgd group — a mixed group cannot advertise
+  // the codec honestly, so segments reject it outright).
+  std::vector<std::pair<uint64_t, distlr::Opt>> opt_segments;
+  const std::string seg_spec = ArgS(argc, argv, "opt_segments", "");
+  if (!seg_spec.empty()) {
+    if (opt == distlr::Opt::kSign || last_gradient) {
+      std::fprintf(stderr, "[distlr_kv_server] --opt_segments is "
+                   "incompatible with --optimizer=signsgd and "
+                   "--last_gradient=1\n");
+      return 2;
+    }
+    size_t pos = 0;
+    uint64_t prev_end = 0;
+    while (pos < seg_spec.size()) {
+      size_t comma = seg_spec.find(',', pos);
+      const std::string part = seg_spec.substr(
+          pos, comma == std::string::npos ? comma : comma - pos);
+      pos = comma == std::string::npos ? seg_spec.size() : comma + 1;
+      const size_t colon = part.find(':');
+      const char* bad = nullptr;
+      uint64_t end = 0;
+      if (colon == std::string::npos || colon == 0) {
+        bad = "want end:opt";
+      } else {
+        end = static_cast<uint64_t>(std::atoll(part.c_str()));
+        if (end <= prev_end) bad = "segment ends must ascend from > 0";
+      }
+      const std::string opt_name =
+          colon == std::string::npos ? "" : part.substr(colon + 1);
+      distlr::Opt seg_opt = distlr::Opt::kSgd;
+      if (bad == nullptr) {
+        if (opt_name == "sgd") seg_opt = distlr::Opt::kSgd;
+        else if (opt_name == "ftrl") seg_opt = distlr::Opt::kFtrl;
+        else bad = "segment optimizer must be sgd|ftrl";
+      }
+      if (bad != nullptr) {
+        std::fprintf(stderr, "[distlr_kv_server] bad --opt_segments "
+                     "entry %s (%s)\n", part.c_str(), bad);
+        return 2;
+      }
+      prev_end = end;
+      opt_segments.emplace_back(end, seg_opt);
+    }
+    bool any_ftrl = false;
+    for (const auto& seg : opt_segments) {
+      if (seg.second == distlr::Opt::kFtrl) any_ftrl = true;
+    }
+    if (any_ftrl &&
+        (fp.alpha <= 0.0f || fp.beta < 0.0f || fp.l1 < 0.0f ||
+         fp.l2 < 0.0f)) {
+      std::fprintf(stderr, "[distlr_kv_server] bad FTRL params for "
+                   "--opt_segments: need alpha > 0 and beta/l1/l2 >= 0\n");
+      return 2;
+    }
+  }
   distlr::KVServer server(port, num_workers, static_cast<uint64_t>(dim),
                           static_cast<float>(lr), sync, last_gradient,
                           bind_any, max_dim, opt, fp, compress,
-                          trace_journal, prof_journal, prof_window);
+                          trace_journal, prof_journal, prof_window,
+                          static_cast<uint16_t>(epoch),
+                          std::move(opt_segments));
   return server.Run();
 }

@@ -8,6 +8,7 @@ Replaces the reference launcher's server-spawning half
 
 from __future__ import annotations
 
+import dataclasses
 import os
 import subprocess
 import threading
@@ -61,6 +62,40 @@ _SNAPSHOT_SECONDS = _reg.histogram(
     "distlr_ps_supervisor_snapshot_seconds",
     "wall seconds per supervisor rolling-snapshot cycle",
 )
+_MEMBERSHIP_SERVERS = _reg.gauge(
+    "distlr_membership_servers",
+    "server ranks in the group's CURRENT layout (moves on an elastic "
+    "resize, not on crashes — crash visibility is distlr_ps_server_up)",
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class ResizePlan:
+    """One membership change, computed by :meth:`ServerGroup.plan_resize`
+    and executed by the :class:`~distlr_tpu.ps.membership.
+    MembershipCoordinator`: which old processes survive as which new
+    ranks, which new ranks need spawning, which old ranks retire, and
+    exactly which global key sub-ranges must MOVE (drained from their
+    old owner via keyed pulls, seeded into the new owner via a forced
+    keyed init-push)."""
+
+    new_num_servers: int
+    #: global key slice per NEW rank
+    new_ranges: list[tuple[int, int]]
+    #: new_rank -> old_rank whose process survives as it (same
+    #: range_begin, so the server's local key rebase stays valid; its
+    #: resident slice never crosses the wire)
+    reuse: dict[int, int]
+    #: new ranks that need a fresh process
+    spawn: list[int]
+    #: old ranks with no new identity (retired after the drain)
+    retire: list[int]
+    #: (old_rank, global_lo, global_hi, new_rank) — the data that moves
+    moves: list[tuple[int, int, int, int]]
+
+    @property
+    def moved_keys(self) -> int:
+        return sum(hi - lo for _, lo, hi, _ in self.moves)
 
 
 class ServerGroup:
@@ -99,10 +134,34 @@ class ServerGroup:
         trace_journal_dir: str | None = None,
         prof_journal_dir: str | None = None,
         prof_window_s: float | None = None,
+        epoch: int = 1,
+        opt_segments: list[tuple[int, str]] | None = None,
     ):
         if optimizer not in ("sgd", "ftrl", "signsgd"):
             raise ValueError(
                 f"optimizer must be sgd|ftrl|signsgd, got {optimizer!r}")
+        if not 1 <= epoch <= 0xFFFF:
+            raise ValueError(f"epoch must be in [1, 65535], got {epoch}")
+        if opt_segments:
+            # per-namespace optimizers (GLOBAL (end, opt) pairs, ascending,
+            # covering [0, dim)): each rank gets the intersection with its
+            # key range as a LOCAL --opt_segments map
+            if optimizer == "signsgd" or last_gradient:
+                raise ValueError(
+                    "opt_segments is incompatible with optimizer='signsgd' "
+                    "and last_gradient (uniform-group semantics)")
+            prev = 0
+            for end, opt in opt_segments:
+                if opt not in ("sgd", "ftrl"):
+                    raise ValueError(
+                        f"segment optimizer must be sgd|ftrl, got {opt!r}")
+                if end <= prev:
+                    raise ValueError(
+                        f"opt_segments ends must ascend, got {opt_segments}")
+                prev = end
+            if prev != dim:
+                raise ValueError(
+                    f"opt_segments must cover [0, dim={dim}), got end {prev}")
         if optimizer != "sgd" and last_gradient:
             # Q1 is a reference-SGD parity quirk; there is no "last
             # worker's FTRL step / majority vote / W" reference behavior
@@ -118,6 +177,23 @@ class ServerGroup:
         self.dim = dim
         self.ports: list[int] = ports or []
         self.procs: list[subprocess.Popen] = []
+        #: membership epoch new spawns (incl. supervisor respawns) carry
+        #: (kv_protocol.h kEpoch); the coordinator bumps it per resize.
+        #: 1 = the static default — spawn command lines stay byte-
+        #: identical to every earlier round's.
+        self.epoch = int(epoch)
+        #: global key slice per rank — the ps-lite equal partition at
+        #: spawn, REWRITTEN by an elastic resize (commit_resize); every
+        #: range consumer reads this, never re-derives dim*r/S
+        self.ranges: list[tuple[int, int]] = [
+            (dim * r // num_servers, dim * (r + 1) // num_servers)
+            for r in range(num_servers)
+        ]
+        self._opt_segments = list(opt_segments or [])
+        #: per-rank chaos links when via_chaos is set (rank order; the
+        #: fabric's own list keeps creation order, which diverges from
+        #: rank order after a resize)
+        self._chaos_links: list = []
         # Fault-injection hook: a FaultPlan (distlr_tpu.chaos) interposes
         # one ChaosFabric link per server rank between clients and the
         # native processes — `hosts` then names the PROXIED ports, so
@@ -176,7 +252,8 @@ class ServerGroup:
         proxy ports — the drop-in property that puts every client
         behind the plan; :attr:`direct_hosts` bypasses it."""
         if self.chaos is not None:
-            return self.chaos.hosts
+            return ",".join(f"127.0.0.1:{lk.port}"
+                            for lk in self._chaos_links)
         return self.direct_hosts
 
     @property
@@ -184,14 +261,35 @@ class ServerGroup:
         """The native server processes' own ports (chaos-free path)."""
         return ",".join(f"127.0.0.1:{p}" for p in self.ports)
 
-    def key_range(self, rank: int) -> tuple[int, int]:
-        """Global key slice ``[lo, hi)`` owned by server ``rank``."""
-        lo = self.dim * rank // self.num_servers
-        hi = self.dim * (rank + 1) // self.num_servers
-        return lo, hi
+    @property
+    def has_ftrl(self) -> bool:
+        """Whether ANY coordinate of the group runs FTRL (the uniform
+        optimizer or an opt_segments namespace) — gates the supervisor's
+        opt-state snapshot/restore and the drain's opt-state migration."""
+        return (self._args["optimizer"] == "ftrl"
+                or any(opt == "ftrl" for _, opt in self._opt_segments))
 
-    def _spawn(self, rank: int, port: int) -> tuple[subprocess.Popen, int]:
-        lo, hi = self.key_range(rank)
+    def key_range(self, rank: int) -> tuple[int, int]:
+        """Global key slice ``[lo, hi)`` owned by server ``rank`` in the
+        CURRENT layout."""
+        return self.ranges[rank]
+
+    def _local_opt_segments(self, lo: int, hi: int) -> str:
+        """--opt_segments value for a rank owning global [lo, hi): the
+        global per-namespace map intersected and rebased to local keys."""
+        parts = []
+        for end, opt in self._opt_segments:
+            start = max(0, min(end, hi) - lo)
+            if start > 0 and (not parts or start > int(parts[-1].split(":")[0])):
+                parts.append(f"{start}:{opt}")
+            if end >= hi:
+                break
+        return ",".join(parts)
+
+    def _spawn(self, rank: int, port: int, *,
+               key_range: tuple[int, int] | None = None,
+               epoch: int | None = None) -> tuple[subprocess.Popen, int]:
+        lo, hi = key_range if key_range is not None else self.key_range(rank)
         cmd = [
             self._binary,
             f"--port={port}",
@@ -204,6 +302,14 @@ class ServerGroup:
         ]
         if self._args["max_dim"] is not None:
             cmd.append(f"--max_dim={self._args['max_dim']}")
+        epoch = self.epoch if epoch is None else epoch
+        if epoch != 1:
+            # non-default only: static groups keep byte-identical spawns
+            cmd.append(f"--epoch={epoch}")
+        if self._opt_segments:
+            segs = self._local_opt_segments(lo, hi)
+            if segs:
+                cmd.append(f"--opt_segments={segs}")
         if self._args["optimizer"] == "ftrl":
             # only non-default optimizers touch the command line, so sgd
             # spawns stay byte-identical to every earlier round's
@@ -216,6 +322,17 @@ class ServerGroup:
             ]
         elif self._args["optimizer"] != "sgd":
             cmd.append(f"--optimizer={self._args['optimizer']}")
+        elif self.has_ftrl:
+            # sgd group default + FTRL opt_segments: the segments' FTRL
+            # coordinates must still run the CONFIGURED hyperparameters
+            # — without these flags they would silently train on the
+            # native defaults
+            cmd += [
+                f"--ftrl_alpha={self._args['ftrl_alpha']}",
+                f"--ftrl_beta={self._args['ftrl_beta']}",
+                f"--ftrl_l1={self._args['ftrl_l1']}",
+                f"--ftrl_l2={self._args['ftrl_l2']}",
+            ]
         if not self._args["compress"]:
             # non-default only: default spawns stay byte-identical
             cmd.append("--compress=0")
@@ -263,6 +380,8 @@ class ServerGroup:
             # supervisor respawn reuses the original port, so the link
             # stays valid across server deaths
             self.chaos = ChaosFabric(self.direct_hosts, self._chaos_plan)
+            self._chaos_links = list(self.chaos.links)
+        _MEMBERSHIP_SERVERS.set(self.num_servers)
         return self
 
     def respawn(self, rank: int) -> bool:
@@ -295,6 +414,144 @@ class ServerGroup:
                 )
             self.procs[rank] = proc
             return True
+
+    # -- elastic membership (the live-resharding round) --------------------
+    def plan_resize(self, new_num_servers: int) -> ResizePlan:
+        """Compute the membership change from the current layout to
+        ``new_num_servers`` equal ranges — WITHOUT touching anything.
+
+        A surviving old process is REUSED as the new rank whose range
+        starts where its own did (the server stores local keys rebased
+        by range_begin, so a matching start keeps every resident slot
+        addressable; a grown range extends elastically, a shrunk one
+        simply stops being addressed).  Doubling reuses every old rank
+        and moves half the table; halving reuses every even rank and
+        drains the odd ones.  Groups with per-coordinate optimizer
+        state (FTRL — uniform or via opt_segments) never reuse: the
+        kOptState wire only seeds FULL ranges, so their resharding is a
+        full rebuild (every new rank fresh, weights AND z/n migrated).
+        """
+        if self._args["sync"]:
+            raise ValueError(
+                "elastic resize supports async (Hogwild) groups only — "
+                "a sync BSP round cannot straddle a membership change")
+        if new_num_servers < 1:
+            raise ValueError(
+                f"new_num_servers must be >= 1, got {new_num_servers}")
+        if new_num_servers > self.dim:
+            raise ValueError(
+                f"cannot shard dim={self.dim} over {new_num_servers} "
+                "servers (empty ranges)")
+        S2 = int(new_num_servers)
+        new_ranges = [(self.dim * r // S2, self.dim * (r + 1) // S2)
+                      for r in range(S2)]
+        reuse: dict[int, int] = {}
+        if not self.has_ftrl and not self._opt_segments:
+            old_by_begin = {lo: r for r, (lo, _hi) in enumerate(self.ranges)
+                            if self.procs[r].poll() is None}
+            claimed: set[int] = set()
+            for nr, (lo, _hi) in enumerate(new_ranges):
+                r = old_by_begin.get(lo)
+                if r is not None and r not in claimed:
+                    reuse[nr] = r
+                    claimed.add(r)
+        moves: list[tuple[int, int, int, int]] = []
+        for nr, (lo, hi) in enumerate(new_ranges):
+            res_hi = lo  # end of the resident (reused) prefix
+            if nr in reuse:
+                res_hi = min(self.ranges[reuse[nr]][1], hi)
+            if res_hi >= hi:
+                continue
+            for o, (olo, ohi) in enumerate(self.ranges):
+                mlo, mhi = max(olo, res_hi), min(ohi, hi)
+                if mlo < mhi:
+                    moves.append((o, mlo, mhi, nr))
+        return ResizePlan(
+            new_num_servers=S2,
+            new_ranges=new_ranges,
+            reuse=reuse,
+            spawn=[nr for nr in range(S2) if nr not in reuse],
+            retire=[r for r in range(self.num_servers)
+                    if r not in reuse.values()],
+            moves=moves,
+        )
+
+    def spawn_for_resize(self, plan: ResizePlan,
+                         epoch: int) -> dict[int, tuple]:
+        """Spawn the plan's fresh ranks at the NEW epoch (ephemeral
+        ports).  Returns ``{new_rank: (proc, port)}`` — staged, not yet
+        part of the layout; :meth:`commit_resize` installs them, or the
+        caller terminates them on an aborted migration."""
+        staged: dict[int, tuple] = {}
+        try:
+            for nr in plan.spawn:
+                staged[nr] = self._spawn(nr, 0,
+                                         key_range=plan.new_ranges[nr],
+                                         epoch=epoch)
+        except Exception:
+            for proc, _port in staged.values():
+                proc.terminate()
+                if proc.stdout:
+                    proc.stdout.close()
+                proc.wait()
+            raise
+        return staged
+
+    def commit_resize(self, plan: ResizePlan, staged: dict[int, tuple],
+                      epoch: int) -> None:
+        """Install the new layout: reused processes take their new rank
+        ids, staged spawns join, retiring processes terminate, and
+        (under a chaos plan) the per-rank proxy links follow — new
+        ranks get fresh links, so the plan's faults keep applying to
+        the grown fleet."""
+        with self._lock:
+            old_count = self.num_servers
+            new_procs: list[subprocess.Popen] = []
+            new_ports: list[int] = []
+            new_links: list = []
+            for nr in range(plan.new_num_servers):
+                if nr in plan.reuse:
+                    r = plan.reuse[nr]
+                    new_procs.append(self.procs[r])
+                    new_ports.append(self.ports[r])
+                    if self.chaos is not None:
+                        new_links.append(self._chaos_links[r])
+                else:
+                    proc, port = staged[nr]
+                    new_procs.append(proc)
+                    new_ports.append(port)
+                    if self.chaos is not None:
+                        new_links.append(
+                            self.chaos.add_upstream("127.0.0.1", port))
+            retiring = [(r, self.procs[r]) for r in plan.retire]
+            retiring_links = ([self._chaos_links[r] for r in plan.retire]
+                              if self.chaos is not None else [])
+            self.procs = new_procs
+            self.ports = new_ports
+            self.ranges = list(plan.new_ranges)
+            self.num_servers = plan.new_num_servers
+            self._chaos_links = new_links
+            self.epoch = int(epoch)
+        # teardown of the retired ranks happens outside the lock (the
+        # supervisor is paused during a resize; nothing else spawns)
+        for _r, proc in retiring:
+            if proc.poll() is None:
+                proc.terminate()
+        for _r, proc in retiring:
+            try:
+                proc.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                proc.wait()
+            if proc.stdout:
+                proc.stdout.close()
+        for lk in retiring_links:
+            lk.stop()
+        for rank in range(plan.new_num_servers):
+            _UP.labels(rank=rank).set(1)
+        for rank in range(plan.new_num_servers, old_count):
+            _UP.labels(rank=rank).set(0)
+        _MEMBERSHIP_SERVERS.set(self.num_servers)
 
     def alive(self) -> list[bool]:
         """Process-level liveness, one flag per server rank."""
@@ -337,13 +594,21 @@ class ServerGroup:
         return sum(s["total_pushes"] for s in stats) / max(len(stats), 1)
 
     def wait(self) -> None:
-        """Block until every server process exits — they do after a
-        client's ``shutdown_servers()``.  This is the foreground mode
-        ``launch ps-server`` uses on a dedicated server host.  A Ctrl-C
-        propagates (the context manager tears the group down) so an
-        interrupted run stays distinguishable from a clean one."""
-        for p in self.procs:
-            p.wait()
+        """Block until every server process of the CURRENT layout exits
+        — they do after a client's ``shutdown_servers()``.  This is the
+        foreground mode ``launch ps-server`` uses on a dedicated server
+        host.  A Ctrl-C propagates (the context manager tears the group
+        down) so an interrupted run stays distinguishable from a clean
+        one.  Elastic groups swap the process list mid-wait
+        (commit_resize): a RETIRED rank's exit must not end the wait,
+        so the loop re-checks whether the layout moved under it and
+        waits the new ranks too."""
+        while True:
+            snapshot = self.procs
+            for p in list(snapshot):
+                p.wait()
+            if self.procs is snapshot:
+                return
 
     def stop(self) -> None:
         with self._lock:
@@ -431,12 +696,16 @@ class ServerSupervisor:
         # respawned FTRL rank silently degrades to a warm restart: its
         # per-coordinate learning rates reset to the aggressive t=0
         # schedule and every L1 dual is forgotten.
-        self._ftrl = group._args["optimizer"] == "ftrl"
+        self._ftrl = group.has_ftrl
         self._opt_z: np.ndarray | None = None
         self._opt_n: np.ndarray | None = None
         self._respawns = [0] * group.num_servers
         self._needs_reseed: set[int] = set()
         self._stop = threading.Event()
+        # elastic resize coordination: while paused the loop idles (a
+        # retiring rank's exit must not read as a crash, and respawn
+        # must not race commit_resize's procs swap)
+        self._paused = threading.Event()
         self._thread: threading.Thread | None = None
         #: (monotonic time, rank, event) audit trail — "respawned",
         #: "reseeded", "seeded-zeros", "gave-up", "respawn-failed"
@@ -459,6 +728,28 @@ class ServerSupervisor:
         if self._thread is not None:
             self._thread.join()
             self._thread = None
+
+    def pause(self) -> None:
+        """Idle the supervision loop (elastic resize window): retiring
+        ranks' exits must not respawn, and the procs/ranges swap must
+        not race a poll cycle.  In-flight cycles finish first — calls
+        only return semantics, the loop checks per cycle."""
+        self._paused.set()
+
+    def resume(self) -> None:
+        self._paused.clear()
+
+    def reset_layout(self) -> None:
+        """Re-bind to the group's CURRENT layout after a resize: per-
+        rank snapshot/respawn state re-initializes (every range must be
+        re-captured — rank ids now mean different key slices), the
+        full-dim snapshot buffer survives (dim never changes)."""
+        n = self._group.num_servers
+        self._snap_valid = [False] * n
+        self._snap_pushes = [-1] * n
+        self._snap_at = [0.0] * n
+        self._respawns = [0] * n
+        self._needs_reseed.clear()
 
     def __enter__(self):
         return self.start()
@@ -524,9 +815,21 @@ class ServerSupervisor:
                         # so the inconsistency self-heals per coordinate
                         # (the same bounded-staleness class the
                         # snapshot itself already accepts)
-                        z, n = kv.pull_opt_state()
-                        self._opt_z[lo:hi] = z
-                        self._opt_n[lo:hi] = n
+                        from distlr_tpu.ps.client import PSRejectedError  # noqa: PLC0415
+
+                        try:
+                            z, n = kv.pull_opt_state()
+                        except PSRejectedError:
+                            # has_ftrl is GROUP-wide; an opt_segments
+                            # rank hosting no FTRL slice rejects the op
+                            # — its weights capture above still counts
+                            # (a generic except here would invalidate
+                            # the whole rank and zero-reseed its slice
+                            # on every crash)
+                            pass
+                        else:
+                            self._opt_z[lo:hi] = z
+                            self._opt_n[lo:hi] = n
                     # The counter was read BEFORE the pull, so it may
                     # undercount what the pull captured — the safe
                     # direction (worst case: one redundant re-pull next
@@ -554,14 +857,19 @@ class ServerSupervisor:
             with self._probe_rank(rank) as kv:
                 kv.push_init(vals, force=True)
                 if self._ftrl and self._snap_valid[rank]:
+                    from distlr_tpu.ps.client import PSRejectedError  # noqa: PLC0415
+
                     # restore the FTRL accumulators captured with this
                     # slice — the respawn keeps its per-coordinate
                     # learning-rate schedule and L1 duals instead of
                     # degrading to a warm restart.  (seeded-zeros case:
                     # a fresh server's z/n are already zeros.)
-                    kv.push_init_opt_state(self._opt_z[lo:hi],
-                                           self._opt_n[lo:hi],
-                                           force=True)
+                    try:
+                        kv.push_init_opt_state(self._opt_z[lo:hi],
+                                               self._opt_n[lo:hi],
+                                               force=True)
+                    except PSRejectedError:
+                        pass  # opt_segments rank with no FTRL slice
         except Exception as e:
             # retried next poll (_needs_reseed): an unseeded-but-alive
             # server would otherwise install the first gradient push AS
@@ -580,6 +888,10 @@ class ServerSupervisor:
         self._try_snapshot()
         while not self._stop.wait(self._poll_interval):
             now = time.monotonic()
+            if self._paused.is_set():
+                # elastic resize in flight: the coordinator owns the
+                # group until resume() — see pause()
+                continue
             if self._group._stopped:
                 # intentional teardown (group.stop(), e.g. run_ps_workers'
                 # on_error): SIGTERMed ranks exit nonzero but are not

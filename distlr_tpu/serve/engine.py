@@ -30,6 +30,7 @@ from __future__ import annotations
 
 import functools
 import threading
+import time
 
 import jax
 import numpy as np
@@ -60,6 +61,21 @@ _BUCKET_HITS = _reg.counter(
 _WEIGHT_SWAPS = _reg.counter(
     "distlr_serve_weight_swaps_total",
     "atomic weight publishes into serving engines",
+)
+_EVICTIONS = _reg.counter(
+    "distlr_serve_engine_evictions_total",
+    "idle engines that released their device weight table to host "
+    "memory (--engine-idle-evict; the next request lazily re-loads)",
+)
+_EVICT_RELOADS = _reg.counter(
+    "distlr_serve_engine_evict_reloads_total",
+    "lazy device re-loads of an evicted engine's weight table on the "
+    "first request after an idle window",
+)
+_RESIDENT = _reg.gauge(
+    "distlr_serve_engine_resident",
+    "engines currently holding their weight table in DEVICE memory "
+    "(an evicted cold model version counts 0 until its next request)",
 )
 
 
@@ -127,9 +143,14 @@ class ScoringEngine:
 
     def __init__(self, cfg: Config, weights=None, *,
                  max_batch_size: int = 1024,
-                 buckets: tuple[int, ...] = DEFAULT_BUCKETS):
+                 buckets: tuple[int, ...] = DEFAULT_BUCKETS,
+                 idle_evict_s: float = 0.0):
         if max_batch_size <= 0:
             raise ValueError(f"max_batch_size must be positive, got {max_batch_size}")
+        if idle_evict_s < 0:
+            raise ValueError(
+                f"idle_evict_s must be >= 0 (0 = never evict), "
+                f"got {idle_evict_s}")
         if cfg.model == "blocked_lr" and cfg.block_size == 0:
             raise ValueError(
                 "block_size=0 (auto) must be resolved before serving — pin "
@@ -147,6 +168,22 @@ class ScoringEngine:
         self._bucket_hits: dict[int, int] = {}
         self.batches_scored = 0
         self.rows_scored = 0
+        # idle eviction (the cold-model-version satellite): after
+        # ``idle_evict_s`` seconds with no score() the device table
+        # moves to a host copy (HBM freed); the next request lazily
+        # re-loads it.  A hot-reloading cold version keeps publishing
+        # into the HOST copy, so staying evicted costs no device work.
+        self.idle_evict_s = float(idle_evict_s)
+        self._host_weights: np.ndarray | None = None
+        self._last_score_at = time.monotonic()
+        self._inflight = 0
+        self.evictions = 0
+        self._evict_stop: threading.Event | None = None
+        if self.idle_evict_s > 0:
+            self._evict_stop = threading.Event()
+            t = threading.Thread(target=self._evict_loop, daemon=True,
+                                 name="distlr-engine-evict")
+            t.start()
         if weights is not None:
             self.set_weights(weights)
 
@@ -155,13 +192,27 @@ class ScoringEngine:
         """Publish new weights (host or device array, flat or shaped);
         returns the new version.  Swaps are atomic wrt ``score``: calls
         already past the reference read finish on the old weights, the
-        next batch sees the new ones."""
+        next batch sees the new ones.  An EVICTED engine's publish
+        stays host-side (no device work for a cold version)."""
         with trace_phase("weight_swap"):
-            w = jax.device_put(
-                np.asarray(weights, dtype=np.float32).reshape(self.model.param_shape)
-            )
+            host = np.asarray(weights,
+                              dtype=np.float32).reshape(self.model.param_shape)
             with self._lock:
+                if (self.idle_evict_s > 0 and self._weights is None
+                        and self._host_weights is not None):
+                    # evicted: keep the fresh table host-side — the next
+                    # request's lazy re-load will device_put it
+                    self._host_weights = host
+                    self.weights_version += 1
+                    _WEIGHT_SWAPS.inc()
+                    return self.weights_version
+            w = jax.device_put(host)
+            with self._lock:
+                if self._weights is None:
+                    _RESIDENT.inc()
                 self._weights = w
+                if self.idle_evict_s > 0:
+                    self._host_weights = host
                 self.weights_version += 1
                 _WEIGHT_SWAPS.inc()
                 version = self.weights_version
@@ -173,12 +224,55 @@ class ScoringEngine:
 
     @property
     def has_weights(self) -> bool:
+        return self._weights is not None or self._host_weights is not None
+
+    @property
+    def resident(self) -> bool:
+        """Whether the weight table is in DEVICE memory right now
+        (False = evicted cold version awaiting its next request)."""
         return self._weights is not None
 
     def get_weights(self) -> np.ndarray:
-        if self._weights is None:
-            raise RuntimeError("engine has no weights loaded")
-        return np.asarray(self._weights)
+        if self._weights is not None:
+            return np.asarray(self._weights)
+        if self._host_weights is not None:
+            return np.array(self._host_weights)
+        raise RuntimeError("engine has no weights loaded")
+
+    # -- idle eviction -----------------------------------------------------
+    def _evict_loop(self) -> None:
+        tick = max(self.idle_evict_s / 4.0, 0.05)
+        while not self._evict_stop.wait(tick):
+            self.maybe_evict()
+
+    def maybe_evict(self, now: float | None = None) -> bool:
+        """Release the device table if this engine has been idle past
+        ``idle_evict_s`` (no-op otherwise; also callable directly by
+        tests/ops).  Returns True when an eviction happened."""
+        if self.idle_evict_s <= 0:
+            return False
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            if (self._weights is None or self._inflight
+                    or now - self._last_score_at < self.idle_evict_s):
+                return False
+            # the host copy is maintained by set_weights; an engine
+            # seeded before eviction support still snapshots here
+            if self._host_weights is None:
+                self._host_weights = np.asarray(self._weights)
+            self._weights = None
+            self.evictions += 1
+            _EVICTIONS.inc()
+            _RESIDENT.dec()
+        jaxrt.maybe_sample_device_bytes()
+        return True
+
+    def _ensure_resident_locked(self) -> None:
+        """Lazy re-load of an evicted table (caller holds the lock)."""
+        if self._weights is None and self._host_weights is not None:
+            self._weights = jax.device_put(self._host_weights)
+            _EVICT_RELOADS.inc()
+            _RESIDENT.inc()
 
     # -- scoring ----------------------------------------------------------
     def _pad_rows(self, rows: tuple[np.ndarray, ...], bucket: int):
@@ -213,7 +307,7 @@ class ScoringEngine:
         already be at an engine NNZ width (``encode_lines`` guarantees
         this; direct callers should pad with ``_nnz_width``).
         """
-        if self._weights is None:
+        if not self.has_weights:
             raise RuntimeError(
                 "engine has no weights loaded yet (set_weights / a weight "
                 "source must publish before scoring)"
@@ -221,18 +315,30 @@ class ScoringEngine:
         n = rows[0].shape[0]
         if n == 0:
             return np.empty(0, np.int32), np.empty(0, np.float32)
-        labels_out, scores_out = [], []
-        # the infer span nests under the batcher's serve.batch span (the
-        # flush thread's current context); direct callers with no
-        # context pay nothing
-        with _SCORE_SECONDS.time(), dtrace.span(
-                "serve.infer",
-                tags={"rows": n, "version": self.weights_version}):
-            for lo in range(0, n, self.max_batch_size):
-                chunk = tuple(leaf[lo:lo + self.max_batch_size] for leaf in rows)
-                lab, sc = self._score_bucket(chunk)
-                labels_out.append(lab)
-                scores_out.append(sc)
+        # lazy re-load of an evicted cold version, and an in-flight
+        # guard so the evictor can never pull the table out from under
+        # a running batch
+        with self._lock:
+            self._ensure_resident_locked()
+            self._inflight += 1
+        try:
+            labels_out, scores_out = [], []
+            # the infer span nests under the batcher's serve.batch span
+            # (the flush thread's current context); direct callers with
+            # no context pay nothing
+            with _SCORE_SECONDS.time(), dtrace.span(
+                    "serve.infer",
+                    tags={"rows": n, "version": self.weights_version}):
+                for lo in range(0, n, self.max_batch_size):
+                    chunk = tuple(leaf[lo:lo + self.max_batch_size]
+                                  for leaf in rows)
+                    lab, sc = self._score_bucket(chunk)
+                    labels_out.append(lab)
+                    scores_out.append(sc)
+        finally:
+            with self._lock:
+                self._inflight -= 1
+                self._last_score_at = time.monotonic()
         self.batches_scored += 1
         self.rows_scored += n
         _BATCHES_SCORED.inc()
@@ -332,10 +438,16 @@ class ScoringEngine:
 
     # -- stats -------------------------------------------------------------
     def stats(self) -> dict:
-        return {
+        out = {
             "weights_version": self.weights_version,
             "batches_scored": self.batches_scored,
             "rows_scored": self.rows_scored,
             "bucket_hits": dict(sorted(self._bucket_hits.items())),
             "buckets": list(self.buckets),
         }
+        if self.idle_evict_s > 0:
+            # additive, like every stats extension: only evict-enabled
+            # engines grow the schema
+            out["resident"] = self.resident
+            out["evictions"] = self.evictions
+        return out

@@ -101,12 +101,12 @@ class LivePSWatcher:
     #: client_id for serving pulls — out of the way of trainer worker ranks
     SERVE_CLIENT_ID = 4095
 
-    def __init__(self, hosts: str, dim: int, *, vals_per_key: int = 1,
+    def __init__(self, hosts: str | None, dim: int, *, vals_per_key: int = 1,
                  chunk_rows: int = 1 << 16, timeout_ms: int = 10_000,
                  client_id: int | None = None, hot_tracker=None,
                  min_coverage: float = 0.95, full_refresh_every: int = 10,
                  retry=None, ns_base: int = 0,
-                 ns_total_dim: int | None = None):
+                 ns_total_dim: int | None = None, route=None):
         from distlr_tpu.ps import KVWorker  # noqa: PLC0415
 
         self.hosts = hosts
@@ -134,6 +134,12 @@ class LivePSWatcher:
             # PS blip mid-poll costs a reconnect+retry INSIDE the poll
             # instead of failing the cycle
             retry=retry,
+            # elastic fleet: with a membership route provider, serving
+            # pulls follow a live reshard in-place (re-route, not a
+            # dead watcher).  NB: a resize that breaks vals_per_key
+            # range alignment falls back like construction did — equal
+            # ranges over dim % (vpk * S) == 0 always stay aligned.
+            route=route,
         )
         self.kv = (worker if self._wire_dim == dim and not self.ns_base
                    else worker.namespace(self.ns_base, dim))

@@ -86,15 +86,44 @@ def parse_stages(spec: str) -> list[tuple[float, float]]:
     return stages
 
 
+#: alert label keys that ATTRIBUTE an alert to a model version — the
+#: scoped SLO-gating contract (`launch rollout` defaults to scoping):
+#: an alert carrying any of these labels belongs to the named model(s)
+#: and only gates ramps of those models; an alert carrying none is
+#: unattributed (fleet-wide).
+ATTRIBUTION_KEYS = ("model", "tenant", "candidate", "namespace")
+
+
+def attributable(alert: dict, model: str) -> bool:
+    """Whether a /fleet.json alert is attributable to ``model``: it
+    names the model in one of its :data:`ATTRIBUTION_KEYS` labels.
+    Alerts with no attribution label return False — they are
+    FLEET-scoped, not model-scoped (callers decide whether those gate;
+    a candidate-scoped ramp deliberately ignores them, because "the
+    primary is drifting" must not roll the candidate back)."""
+    labels = alert.get("labels") or {}
+    named = [str(labels[k]) for k in ATTRIBUTION_KEYS if k in labels]
+    return bool(named) and str(model) in named
+
+
 def fleet_alert_poller(fleet_url: str, *, names=None,
                        prefix: str = "distlr_alert_",
-                       timeout_s: float = 2.0):
+                       timeout_s: float = 2.0,
+                       scope_model: str | None = None):
     """An ``alert_poll`` callable over a running ``launch obs-agg``:
     returns the firing alert names (``name{labels}``) bound by ``names``
     (exact names) or ``prefix``.  An UNREACHABLE aggregator reports a
     synthetic ``rollout_fleet_unreachable`` alert — ramping blind is
     exactly when a bad candidate does the most damage, so a dead
-    observability plane fails the ramp safe."""
+    observability plane fails the ramp safe.
+
+    ``scope_model`` (the scoped SLO-gating satellite): only alerts
+    ATTRIBUTABLE to that model (:func:`attributable` — e.g. the
+    candidate's ``distlr_alert_shadow_psi{candidate=...}`` series)
+    count as firing; alerts attributed to a DIFFERENT model (the
+    primary's drift, another tenant's quota storm) and unattributed
+    fleet-wide alerts are skipped.  The synthetic unreachable alert
+    always gates — a blind ramp is never safe."""
     url = fleet_url.rstrip("/") + "/fleet.json"
     bound = set(names) if names else None
 
@@ -113,6 +142,8 @@ def fleet_alert_poller(fleet_url: str, *, names=None,
                 if name not in bound:
                     continue
             elif not name.startswith(prefix):
+                continue
+            if scope_model is not None and not attributable(a, scope_model):
                 continue
             labels = a.get("labels") or {}
             shown = ",".join(f"{k}={v}" for k, v in sorted(labels.items())

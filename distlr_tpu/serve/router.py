@@ -336,6 +336,7 @@ class ScoringRouter:
                 rep.models.add(model)
                 reps.append(rep)
             self._model_replicas[model] = reps
+        self._by_addr = by_addr
         self.replicas = list(by_addr.values())
         self.model_ids = list(models)
         self.default_model = self.model_ids[0]
@@ -365,6 +366,7 @@ class ScoringRouter:
         self.health_interval_s = float(health_interval_s)
         self.probe_backoff_s = float(probe_backoff_s)
         self.probe_backoff_max_s = float(probe_backoff_max_s)
+        self.backend_timeout_s = float(backend_timeout_s)
         self.probe_timeout_s = min(float(backend_timeout_s), 2.0)
         self._retries = int(retries)
         self._lock = threading.Lock()   # health state + rotation counter
@@ -497,7 +499,8 @@ class ScoringRouter:
         tick = max(0.01, min(self.health_interval_s, 0.25))
         while not self._stop.wait(tick):
             now = time.monotonic()
-            for rep in self.replicas:
+            # snapshot: ADDREPLICA/DELREPLICA mutate the list mid-run
+            for rep in list(self.replicas):
                 with self._lock:
                     if rep.healthy:
                         due = (now - max(rep.last_ok, rep.last_probe)
@@ -632,6 +635,58 @@ class ScoringRouter:
             self._shadows.pop(tenant, None)
         log.info("promoted: %s now serves %s's replicas", tenant, candidate)
 
+    def add_replica(self, model: str, addr: str) -> None:
+        """Elastic scale-up: register a (possibly brand-new) replica
+        address under ``model`` mid-run.  The new replica enters
+        rotation immediately and rides the existing health machinery —
+        a dead address is probed, ejected, and backoff-reinstated like
+        any launch-time replica.  An unknown ``model`` id creates a new
+        registry slice (a new version joining the fleet)."""
+        with self._lock:
+            rep = self._by_addr.get(addr)
+            if rep is None:
+                rep = _Replica(addr, max_inflight=self.max_inflight,
+                               timeout_s=self.backend_timeout_s)
+                self._by_addr[addr] = rep
+                self.replicas.append(rep)
+            if model not in self._model_replicas:
+                self._model_replicas[model] = []
+                self.model_ids.append(model)
+                self._per_model[model] = {"requests": 0, "shed": 0}
+                _tenant.set_model_count(len(self.model_ids))
+            pool = self._model_replicas[model]
+            if rep in pool:
+                raise ValueError(
+                    f"replica {addr} already registered under {model!r}")
+            rep.models.add(model)
+            pool.append(rep)
+        log.info("replica %s added under model %s", addr, model)
+
+    def remove_replica(self, model: str, addr: str) -> None:
+        """Elastic scale-down: take the replica out of ``model``'s
+        rotation.  In-flight requests on it complete (the budget object
+        lives until released) — removal never fails an accepted
+        request; new traffic simply stops selecting it.  An address
+        registered under no model afterwards is fully forgotten (pool
+        drained)."""
+        with self._lock:
+            rep = self._by_addr.get(addr)
+            pool = self._model_replicas.get(model)
+            if rep is None or pool is None or rep not in pool:
+                raise ValueError(
+                    f"replica {addr} not registered under {model!r}")
+            pool.remove(rep)
+            rep.models.discard(model)
+            gone = not any(rep in p for p in self._model_replicas.values())
+            if gone:
+                self.replicas.remove(rep)
+                del self._by_addr[addr]
+                rep._up_g.set(0.0)
+        if gone:
+            rep.drain_pool()
+        log.info("replica %s removed from model %s%s", addr, model,
+                 " (forgotten)" if gone else "")
+
     def _handle_admin(self, line: str) -> str:
         parts = line.split()
         verb = parts[0]
@@ -644,6 +699,12 @@ class ScoringRouter:
                 (self.set_split if verb == "SPLIT"
                  else self.set_shadow)(parts[1], parts[2], frac)
                 return f"OK {verb} {parts[1]} {parts[2]} {frac:g}"
+            if verb in ("ADDREPLICA", "DELREPLICA"):
+                if len(parts) != 3:
+                    raise ValueError(f"need {verb} <model> <host:port>")
+                (self.add_replica if verb == "ADDREPLICA"
+                 else self.remove_replica)(parts[1], parts[2])
+                return f"OK {verb} {parts[1]} {parts[2]}"
             if len(parts) != 3:
                 raise ValueError("need PROMOTE <tenant> <candidate>")
             self.promote(parts[1], parts[2])
@@ -703,7 +764,8 @@ class ScoringRouter:
             return json.dumps(self.stats())
         if line == "MODELS":
             return json.dumps(self.models_json())
-        if line.startswith(("SPLIT ", "SHADOW ", "PROMOTE ")):
+        if line.startswith(("SPLIT ", "SHADOW ", "PROMOTE ",
+                            "ADDREPLICA ", "DELREPLICA ")):
             return self._handle_admin(line)
         if line.startswith("@"):
             # a model-ADDRESSED label must broadcast to that model's

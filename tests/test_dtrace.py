@@ -342,6 +342,13 @@ class TestKVWire:
             finally:
                 w.close()
             dtrace.flush()
+            # the server journals a handler span AFTER sending its reply
+            # (TraceLog rides the handler thread, off the reply path), so
+            # the client's round trip completing does not prove the span
+            # line exists yet — a SIGTERM landing in that window loses
+            # the tail span (observed as a loaded-machine flake).  Give
+            # the handler thread a beat before tearing the group down.
+            time.sleep(0.1)
         # the server's journal flush is batched; its SIGTERM/exit path
         # flushes the tail — read AFTER the group stops
         py = _read_journal(run, "w-0")

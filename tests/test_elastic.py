@@ -1,0 +1,976 @@
+"""Elastic fleet (ISSUE 12): live PS resharding, membership epochs, and
+worker/engine autoscaling under chaos.
+
+The tentpole contract under test:
+
+* the native kEpoch protocol — announce / fence / admin set — and the
+  client's automatic re-routing (epoch mismatch OR a retired rank's
+  dead socket both recover through the membership coordinator, never a
+  restart);
+* :meth:`ServerGroup.plan_resize` reuse/move math (doubling reuses
+  every rank and moves half the table; halving drains the odd ranks);
+* live grow/shrink preserving every weight — and for FTRL groups the
+  full z/n optimizer state, bit-identically;
+* push-clock safety: applied pushes never exceed issued across
+  migrations (per-coordinate audit via known-gradient SGD);
+* per-namespace optimizers (``--namespaces v1:ftrl,v2:sgd``);
+* engine idle eviction + lazy re-load;
+* router ADDREPLICA/DELREPLICA under live traffic;
+* candidate-scoped rollout SLO gating (attributable alerts only);
+* the acceptance e2e: async training + serving live against ONE group
+  through the chaos proxy, double then halve the server ranks AND the
+  worker/engine replicas mid-run — zero process restarts, zero failed
+  accepted requests, applied <= issued, final quality within 1pt of a
+  static-fleet run.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from distlr_tpu.chaos import parse_plan
+from distlr_tpu.config import Config
+from distlr_tpu.obs.registry import MetricsRegistry, get_registry
+from distlr_tpu.ps import (
+    KVWorker,
+    MembershipCoordinator,
+    MembershipServer,
+    PSEpochError,
+    ServerGroup,
+    ServerSupervisor,
+    layout_client,
+)
+from distlr_tpu.ps.membership import MembershipError, ctl_request
+
+D = 32
+
+
+def _counter_total(name: str) -> float:
+    fam = get_registry().snapshot().get(name)
+    if not fam:
+        return 0.0
+    return sum(s["value"] for s in fam.get("series", []))
+
+
+def _libsvm(x) -> str:
+    return " ".join(f"{i + 1}:{v:g}" for i, v in enumerate(x) if v)
+
+
+def _make_rows(n, w_true, rng, *, min_margin=3.0):
+    """Dense 0/1 rows with an unambiguous label under ``w_true``."""
+    X, y = [], []
+    while len(X) < n:
+        x = np.zeros(len(w_true), np.float32)
+        x[rng.choice(len(w_true), size=4, replace=False)] = 1.0
+        m = float(x @ w_true)
+        if abs(m) < min_margin:
+            continue
+        X.append(x)
+        y.append(1 if m > 0 else 0)
+    return np.stack(X), np.asarray(y, np.int32)
+
+
+def _write_shards(shard_dir, X, y, per_shard, start_seq=0) -> int:
+    os.makedirs(shard_dir, exist_ok=True)
+    seq = start_seq
+    for lo in range(0, len(y), per_shard):
+        path = os.path.join(shard_dir, f"shard-{seq:06d}.libsvm")
+        with open(path + ".tmp", "w") as f:
+            for i in range(lo, min(lo + per_shard, len(y))):
+                f.write(f"{y[i]} {_libsvm(X[i])}\n")
+        os.replace(path + ".tmp", path)
+        seq += 1
+    return seq
+
+
+# ---------------------------------------------------------------------------
+# resize planning (reuse / move math)
+# ---------------------------------------------------------------------------
+
+class TestResizePlan:
+    def test_double_reuses_all_and_moves_half(self):
+        with ServerGroup(2, 1, D, sync=False) as g:
+            plan = g.plan_resize(4)
+            assert plan.new_num_servers == 4
+            assert plan.reuse == {0: 0, 2: 1}  # same range starts survive
+            assert plan.spawn == [1, 3]
+            assert plan.retire == []
+            # exactly the upper half of each old range moves
+            assert plan.moves == [(0, 8, 16, 1), (1, 24, 32, 3)]
+            assert plan.moved_keys == D // 2
+
+    def test_halve_reuses_even_and_drains_odd(self):
+        with ServerGroup(4, 1, D, sync=False) as g:
+            plan = g.plan_resize(2)
+            assert plan.reuse == {0: 0, 1: 2}
+            assert plan.spawn == []
+            assert plan.retire == [1, 3]
+            assert plan.moves == [(1, 8, 16, 0), (3, 24, 32, 1)]
+
+    def test_ftrl_group_never_reuses(self):
+        with ServerGroup(2, 1, D, sync=False, optimizer="ftrl") as g:
+            plan = g.plan_resize(4)
+            assert plan.reuse == {}
+            assert plan.spawn == [0, 1, 2, 3]
+            assert plan.retire == [0, 1]
+            assert plan.moved_keys == D  # full rebuild
+
+    def test_sync_group_refuses(self):
+        with ServerGroup(1, 1, D, sync=True) as g:
+            with pytest.raises(ValueError, match="async"):
+                g.plan_resize(2)
+
+    def test_bad_targets_refused(self):
+        with ServerGroup(1, 1, D, sync=False) as g:
+            with pytest.raises(ValueError):
+                g.plan_resize(0)
+            with pytest.raises(ValueError):
+                g.plan_resize(D + 1)
+
+
+# ---------------------------------------------------------------------------
+# native epoch protocol
+# ---------------------------------------------------------------------------
+
+class TestEpochProtocol:
+    def test_fence_and_reannounce(self):
+        with ServerGroup(1, 1, D, sync=False) as g:
+            with KVWorker(g.hosts, D, client_id=1, sync_group=False,
+                          epoch=1) as kv:
+                kv.push_init(np.zeros(D, np.float32))
+                kv.pull()  # announced at 1 == server epoch: passes
+                with KVWorker(g.hosts, D, client_id=2,
+                              sync_group=False) as admin:
+                    admin.set_epoch(2)
+                    # admin (never announced) passes the fence
+                    admin.pull()
+                with pytest.raises(PSEpochError) as ei:
+                    kv.pull()
+                assert ei.value.epoch == 2
+                assert kv.stats(0)["epoch"] == 2  # stats never fenced
+
+    def test_connect_time_mismatch_raises(self):
+        with ServerGroup(1, 1, D, sync=False, epoch=3) as g:
+            with pytest.raises(PSEpochError) as ei:
+                KVWorker(g.hosts, D, sync_group=False, epoch=2)
+            assert ei.value.epoch == 3
+
+    def test_pre_epoch_server_degrades_gracefully(self):
+        # --compress=0 hides every capability (simulates an old binary):
+        # the client logs a fallback and runs unfenced, like codec/trace
+        with ServerGroup(1, 1, D, sync=False, compress=False) as g:
+            with KVWorker(g.hosts, D, sync_group=False, epoch=1) as kv:
+                assert not kv._epoch_armed
+                kv.push_init(np.zeros(D, np.float32))
+                kv.pull()  # no fencing, no failure
+
+    def test_wire_unchanged_without_epoch(self):
+        # a client that never announces sees byte-identical behavior
+        with ServerGroup(1, 1, D, sync=False) as g:
+            with KVWorker(g.hosts, D, sync_group=False) as kv:
+                kv.push_init(np.ones(D, np.float32))
+                np.testing.assert_array_equal(kv.pull(),
+                                              np.ones(D, np.float32))
+                assert kv.group_epoch() == 0  # never negotiated
+
+
+# ---------------------------------------------------------------------------
+# live resize
+# ---------------------------------------------------------------------------
+
+class TestLiveResize:
+    def test_grow_then_shrink_preserves_weights(self):
+        with ServerGroup(2, 1, D, sync=False) as g:
+            coord = MembershipCoordinator(g)
+            w0 = np.arange(D, dtype=np.float32)
+            with KVWorker(g.hosts, D, client_id=1, sync_group=False) as s:
+                s.push_init(w0)
+            with KVWorker(None, D, client_id=2, sync_group=False,
+                          route=coord.layout) as kv:
+                for target, epoch in ((4, 2), (2, 3), (1, 4)):
+                    stats = coord.resize(target)
+                    assert stats["ok"] and stats["epoch"] == epoch
+                    np.testing.assert_array_equal(kv.pull(), w0)
+                    assert kv._epoch == epoch
+                    assert g.num_servers == target
+                # noop resize is a noop
+                assert coord.resize(1).get("noop")
+
+    def test_client_survives_resize_under_concurrent_pulls(self):
+        with ServerGroup(2, 1, D, sync=False) as g:
+            coord = MembershipCoordinator(g)
+            w0 = np.linspace(-1, 1, D).astype(np.float32)
+            with KVWorker(g.hosts, D, client_id=1, sync_group=False) as s:
+                s.push_init(w0)
+            kv = KVWorker(None, D, client_id=2, sync_group=False,
+                          route=coord.layout)
+            errs, stop = [], threading.Event()
+
+            def hammer():
+                while not stop.is_set():
+                    try:
+                        np.testing.assert_array_equal(kv.pull(), w0)
+                    except Exception as e:  # noqa: BLE001
+                        errs.append(e)
+                        return
+
+            t = threading.Thread(target=hammer)
+            t.start()
+            try:
+                coord.resize(4)
+                coord.resize(2)
+            finally:
+                time.sleep(0.1)
+                stop.set()
+                t.join()
+                kv.close()
+            assert not errs, errs
+
+    def test_ftrl_reshard_trajectory_bit_identical(self):
+        rng = np.random.default_rng(0)
+        grads = [rng.normal(size=D).astype(np.float32) for _ in range(8)]
+        with ServerGroup(2, 1, D, sync=False, optimizer="ftrl",
+                         ftrl_alpha=0.1) as g:
+            coord = MembershipCoordinator(g)
+            with KVWorker(None, D, sync_group=False,
+                          route=coord.layout) as kv:
+                kv.push_init(np.zeros(D, np.float32))
+                for gv in grads[:5]:
+                    kv.push(gv)
+                w_before = kv.pull()
+                stats = coord.resize(4)
+                assert stats["reused"] == 0 and stats["spawned"] == 4
+                # weights AND z/n survived: pull identical, trajectory
+                # continues exactly
+                np.testing.assert_array_equal(kv.pull(), w_before)
+                for gv in grads[5:]:
+                    kv.push(gv)
+                w_elastic = kv.pull()
+        with ServerGroup(1, 1, D, sync=False, optimizer="ftrl",
+                         ftrl_alpha=0.1) as g:
+            with KVWorker(g.hosts, D, sync_group=False) as kv:
+                kv.push_init(np.zeros(D, np.float32))
+                for gv in grads:
+                    kv.push(gv)
+                w_static = kv.pull()
+        np.testing.assert_array_equal(w_elastic, w_static)
+
+    def test_push_clock_applied_never_exceeds_issued(self):
+        """Per-coordinate audit across TWO migrations: every coordinate's
+        SGD apply count (read off the weights, lr and gradient known)
+        must sit in [pushes_ok, pushes_ok + unknowns] — a double-applied
+        migration push would overshoot, a lost confirmed push undershoot."""
+        lr = 0.25
+        with ServerGroup(2, 1, D, sync=False, learning_rate=lr) as g:
+            coord = MembershipCoordinator(g)
+            with KVWorker(None, D, sync_group=False,
+                          route=coord.layout) as kv:
+                kv.push_init(np.zeros(D, np.float32))
+                ones = np.ones(D, np.float32)
+                issued_ok = 0
+                unknown0 = _counter_total(
+                    "distlr_ps_push_outcome_unknown_total")
+                stop = threading.Event()
+
+                def pusher():
+                    nonlocal issued_ok
+                    while not stop.is_set():
+                        if kv.push(ones) >= 0:
+                            issued_ok += 1
+
+                t = threading.Thread(target=pusher)
+                t.start()
+                try:
+                    time.sleep(0.15)
+                    coord.resize(4)
+                    time.sleep(0.15)
+                    coord.resize(2)
+                    time.sleep(0.15)
+                finally:
+                    stop.set()
+                    t.join()
+                unknowns = (_counter_total(
+                    "distlr_ps_push_outcome_unknown_total") - unknown0)
+                applied = -kv.pull() / lr  # applies per coordinate
+        assert applied.max() <= issued_ok + unknowns + 1e-3, (
+            f"double-apply: {applied.max()} > {issued_ok} + {unknowns}")
+        assert applied.min() >= issued_ok - 1e-3, (
+            f"confirmed push lost: {applied.min()} < {issued_ok}")
+
+    def test_route_provider_overrides_stale_hosts(self):
+        """A caller-supplied hosts list that predates a resize must NOT
+        be used for range slicing: the stale list announced with the
+        CURRENT epoch would pass every fence while addressing the wrong
+        layout (regression: the constructor kept caller hosts and only
+        adopted the coordinator's epoch)."""
+        with ServerGroup(2, 1, D, sync=False) as g:
+            coord = MembershipCoordinator(g)
+            stale_hosts = g.hosts
+            w0 = np.arange(D, dtype=np.float32)
+            with KVWorker(g.hosts, D, sync_group=False) as s:
+                s.push_init(w0)
+            coord.resize(4)  # reuses both old ranks: stale hosts stay live
+            with KVWorker(stale_hosts, D, sync_group=False,
+                          route=coord.layout) as kv:
+                assert kv.num_servers == 4 and kv._epoch == 2
+                np.testing.assert_array_equal(kv.pull(), w0)
+
+    def test_push_without_retry_policy_never_double_applies(self):
+        """Route provider + NO RetryPolicy (the default config): a push
+        whose frames were delivered before the transport died must be
+        absorbed as unknown-outcome, never re-issued after the re-route
+        (regression: the membership layer re-issued it blindly)."""
+        lr = 0.25
+        plan = parse_plan({"faults": [
+            # deliver frame 8 upstream, then sever before its reply —
+            # the push-outcome-unknown shape, mid-run
+            {"kind": "reset", "links": [0], "after_ops": 8},
+        ]})
+        unknown0 = _counter_total("distlr_ps_push_outcome_unknown_total")
+        with ServerGroup(1, 1, D, sync=False, learning_rate=lr,
+                         via_chaos=plan) as g:
+            coord = MembershipCoordinator(g)
+            with KVWorker(None, D, sync_group=False,
+                          route=coord.layout) as kv:
+                kv.push_init(np.zeros(D, np.float32))
+                ones = np.ones(D, np.float32)
+                ok = 0
+                for _ in range(12):
+                    try:
+                        if kv.push(ones) >= 0:
+                            ok += 1
+                    except OSError:
+                        pass  # allowed to surface; must not double-apply
+                applied = -kv.pull() / lr
+            unknowns = (_counter_total(
+                "distlr_ps_push_outcome_unknown_total") - unknown0)
+        assert applied.max() <= ok + unknowns + 1e-3, (
+            f"double-apply: {applied.max()} > {ok} + {unknowns}")
+        assert applied.min() >= ok - 1e-3
+
+    def test_failed_resize_rolls_back_and_alerts(self):
+        with ServerGroup(2, 1, D, sync=False) as g:
+            coord = MembershipCoordinator(g)
+            with KVWorker(g.hosts, D, sync_group=False) as s:
+                s.push_init(np.arange(D, dtype=np.float32))
+            # sabotage the drain: monkeypatch the drain to blow up
+            orig = coord._drain
+            coord._drain = lambda *a, **k: (_ for _ in ()).throw(
+                OSError("injected drain failure"))
+            with pytest.raises(MembershipError, match="rolled back"):
+                coord.resize(4)
+            coord._drain = orig
+            # old layout still serves, alert fires, status active again
+            assert g.num_servers == 2 and coord.epoch == 1
+            snap = get_registry().snapshot()
+            alert = snap["distlr_alert_reshard_failed"]["series"]
+            assert any(s["value"] == 1.0 for s in alert)
+            with KVWorker(g.hosts, D, sync_group=False) as kv:
+                np.testing.assert_array_equal(
+                    kv.pull(), np.arange(D, dtype=np.float32))
+            # and the next resize succeeds and clears the alert
+            assert coord.resize(4)["ok"]
+            snap = get_registry().snapshot()
+            alert = snap["distlr_alert_reshard_failed"]["series"]
+            assert all(s["value"] == 0.0 for s in alert)
+
+    def test_group_wait_survives_resize(self):
+        """A RETIRED rank's exit must not end ServerGroup.wait() — the
+        ps-server foreground mode would otherwise tear the freshly
+        resized group down the moment the first migration retired a
+        process (regression: wait() iterated the pre-resize list)."""
+        with ServerGroup(2, 1, D, sync=False) as g:
+            coord = MembershipCoordinator(g)
+            with KVWorker(g.hosts, D, sync_group=False) as s:
+                s.push_init(np.zeros(D, np.float32))
+            done = threading.Event()
+
+            def waiter():
+                g.wait()
+                done.set()
+
+            t = threading.Thread(target=waiter)
+            t.start()
+            coord.resize(1)  # retires rank 1
+            time.sleep(0.3)
+            assert not done.is_set(), "retired rank's exit ended wait()"
+            with KVWorker(g.hosts, D, sync_group=False) as kv:
+                kv.shutdown_servers()
+            t.join(timeout=10)
+            assert done.is_set()
+
+    def test_ps_ctl_wire(self):
+        with ServerGroup(2, 1, D, sync=False) as g:
+            coord = MembershipCoordinator(g)
+            with MembershipServer(coord) as ctl:
+                addr = f"127.0.0.1:{ctl.port}"
+                doc = ctl_request(addr, "LAYOUT")
+                assert doc["epoch"] == 1 and doc["num_servers"] == 2
+                assert doc["status"] == "active" and doc["dim"] == D
+                st = ctl_request(addr, "STATUS")
+                assert st["last_resize"] is None
+                out = ctl_request(addr, "RESIZE 4")
+                assert out["ok"] and out["num_servers"] == 4
+                # route provider follows
+                assert layout_client(addr)()["num_servers"] == 4
+                bad = ctl_request(addr, "RESIZE 0")
+                assert not bad["ok"]
+                unknown = ctl_request(addr, "FROB")
+                assert not unknown["ok"] and "unknown" in unknown["error"]
+
+
+# ---------------------------------------------------------------------------
+# per-namespace optimizers (satellite)
+# ---------------------------------------------------------------------------
+
+class TestNamespaceOptimizers:
+    def test_ftrl_and_sgd_side_by_side(self):
+        # v1 (keys 0..15) runs FTRL, v2 (keys 16..31) plain SGD, on the
+        # SAME 2-rank group — the per-namespace-optimizer satellite
+        segs = [(16, "ftrl"), (32, "sgd")]
+        with ServerGroup(2, 1, D, sync=False, learning_rate=0.5,
+                         ftrl_alpha=0.1, opt_segments=segs) as g:
+            with KVWorker(g.hosts, D, sync_group=False) as kv:
+                kv.push_init(np.zeros(D, np.float32))
+                kv.push(np.ones(D, np.float32))
+                w = kv.pull()
+        # sgd half: w = -lr * g
+        np.testing.assert_allclose(w[16:], -0.5)
+        # ftrl half after one unit gradient: z=1, n=1,
+        # w = -(z)/((beta + sqrt(n))/alpha) = -1/(2/0.1) = -0.05
+        np.testing.assert_allclose(w[:16], -0.05, rtol=1e-5)
+
+    def test_segment_ftrl_params_reach_the_server(self):
+        """An sgd-default group with FTRL segments must spawn with the
+        CONFIGURED FTRL hyperparameters (regression: only group-wide
+        --optimizer=ftrl groups passed them, so segment slices silently
+        trained on the native defaults)."""
+        segs = [(16, "ftrl"), (32, "sgd")]
+        with ServerGroup(1, 1, D, sync=False, learning_rate=0.5,
+                         ftrl_alpha=0.5, opt_segments=segs) as g:
+            with KVWorker(g.hosts, D, sync_group=False) as kv:
+                kv.push_init(np.zeros(D, np.float32))
+                kv.push(np.ones(D, np.float32))
+                w = kv.pull()
+        # alpha=0.5 (NOT the native default 0.1): z=1, n=1,
+        # w = -z / ((beta + sqrt(n)) / alpha) = -1 / (2 / 0.5) = -0.25
+        np.testing.assert_allclose(w[:16], -0.25, rtol=1e-5)
+        np.testing.assert_allclose(w[16:], -0.5)
+
+    def test_supervisor_respawn_restores_sgd_rank_of_mixed_group(self):
+        """A mixed opt_segments group's pure-sgd rank must stay
+        snapshot-covered (regression: the supervisor's opt-state pull is
+        REJECTED by a rank hosting no FTRL slice, and a generic except
+        invalidated the whole capture — every crash of that rank then
+        reseeded ZEROS over its trained slice)."""
+        segs = [(16, "ftrl"), (32, "sgd")]
+        with ServerGroup(2, 1, D, sync=False, learning_rate=0.5,
+                         ftrl_alpha=0.1, opt_segments=segs) as g:
+            with KVWorker(g.hosts, D, sync_group=False) as kv:
+                kv.push_init(np.zeros(D, np.float32))
+                kv.push(np.ones(D, np.float32))
+                w1 = kv.pull()
+            assert np.any(w1[16:] != 0)
+            with ServerSupervisor(g, poll_interval=0.05,
+                                  snapshot_interval=0.1) as sup:
+                time.sleep(0.6)  # both ranks captured
+                g.procs[1].kill()  # the pure-sgd rank dies hard
+                deadline = time.monotonic() + 15
+                reseeded = []
+                while time.monotonic() < deadline and not reseeded:
+                    reseeded = [e for _t, r, e in sup.events
+                                if r == 1 and e in ("reseeded",
+                                                    "seeded-zeros")]
+                    time.sleep(0.05)
+                assert reseeded == ["reseeded"], sup.events
+                with KVWorker(g.hosts, D, sync_group=False) as kv:
+                    np.testing.assert_array_equal(kv.pull(), w1)
+
+    def test_segment_validation(self):
+        with pytest.raises(ValueError, match="ascend"):
+            ServerGroup(1, 1, D, sync=False,
+                        opt_segments=[(16, "sgd"), (8, "ftrl")])
+        with pytest.raises(ValueError, match="cover"):
+            ServerGroup(1, 1, D, sync=False, opt_segments=[(8, "sgd")])
+        with pytest.raises(ValueError, match="sgd\\|ftrl"):
+            ServerGroup(1, 1, D, sync=False,
+                        opt_segments=[(D, "signsgd")])
+        with pytest.raises(ValueError, match="uniform"):
+            ServerGroup(1, 1, D, sync=False, optimizer="signsgd",
+                        opt_segments=[(D, "sgd")])
+
+    def test_namespace_spec_parsing(self):
+        from distlr_tpu.ps import namespace_layout, parse_namespace_optimizers
+
+        assert parse_namespace_optimizers("v1:ftrl,v2:sgd,v3") == {
+            "v1": "ftrl", "v2": "sgd"}
+        assert parse_namespace_optimizers("v1,v2") == {}
+        with pytest.raises(ValueError, match="sgd\\|ftrl"):
+            parse_namespace_optimizers("v1:adam")
+        # layout strips the optimizer suffix (clients repeat the spec)
+        assert namespace_layout("v1:ftrl,v2:sgd", 8) == {
+            "v1": (0, 8), "v2": (8, 8)}
+
+    def test_elastic_reshard_with_segments_full_rebuild(self):
+        segs = [(16, "ftrl"), (32, "sgd")]
+        with ServerGroup(2, 1, D, sync=False, learning_rate=0.5,
+                         ftrl_alpha=0.1, opt_segments=segs) as g:
+            coord = MembershipCoordinator(g)
+            with KVWorker(None, D, sync_group=False,
+                          route=coord.layout) as kv:
+                kv.push_init(np.zeros(D, np.float32))
+                kv.push(np.ones(D, np.float32))
+                w1 = kv.pull()
+                stats = coord.resize(4)
+                assert stats["reused"] == 0  # segment maps pin ranges
+                np.testing.assert_array_equal(kv.pull(), w1)
+                # the FTRL namespace keeps its accumulators: a second
+                # unit gradient steps from (z=1, n=1), not from scratch
+                kv.push(np.ones(D, np.float32))
+                w2 = kv.pull()
+        # sgd half stepped again by -lr
+        np.testing.assert_allclose(w2[16:], -1.0)
+        # ftrl half: n=2, sigma=(sqrt2-1)/0.1, z=2-sigma*(-0.05),
+        # w = -(z - 0)/((1+sqrt2)/0.1) — just assert it moved PAST the
+        # from-scratch value (accumulators survived)
+        assert np.all(w2[:16] < -0.05)
+
+
+# ---------------------------------------------------------------------------
+# engine idle eviction (satellite)
+# ---------------------------------------------------------------------------
+
+class TestEngineEviction:
+    def _engine(self, idle_s):
+        from distlr_tpu.serve import ScoringEngine
+
+        cfg = Config(num_feature_dim=8, model="binary_lr", l2_c=0.0)
+        eng = ScoringEngine(cfg, max_batch_size=64, idle_evict_s=idle_s)
+        eng.set_weights(np.linspace(-1.0, 1.0, 8).astype(np.float32))
+        return eng
+
+    def test_idle_engine_evicts_and_lazily_reloads(self):
+        eng = self._engine(0.15)
+        X = np.eye(8, dtype=np.float32)
+        _, s1 = eng.score((X,))
+        assert eng.resident
+        deadline = time.monotonic() + 5.0
+        while eng.resident and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert not eng.resident and eng.has_weights
+        assert eng.evictions == 1
+        assert eng.stats()["resident"] is False
+        # the next request lazily re-loads and scores identically
+        _, s2 = eng.score((X,))
+        assert eng.resident
+        np.testing.assert_array_equal(s1, s2)
+
+    def test_evicted_engine_accepts_publishes_host_side(self):
+        eng = self._engine(0.1)
+        X = np.eye(8, dtype=np.float32)
+        eng.score((X,))
+        deadline = time.monotonic() + 5.0
+        while eng.resident and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert not eng.resident
+        v = eng.weights_version
+        eng.set_weights(np.ones(8, np.float32))  # hot reload while cold
+        assert eng.weights_version == v + 1
+        assert not eng.resident  # publish stayed host-side
+        _, scores = eng.score((X[:1],))
+        assert eng.resident
+        np.testing.assert_allclose(
+            scores, 1.0 / (1.0 + np.exp(-1.0)), rtol=1e-6)
+
+    def test_zero_means_never_evict(self):
+        eng = self._engine(0.0)
+        eng.score((np.eye(8, dtype=np.float32),))
+        assert not eng.maybe_evict()
+        assert eng.resident
+
+
+# ---------------------------------------------------------------------------
+# router elasticity (satellite-in-tentpole: prove, don't assume)
+# ---------------------------------------------------------------------------
+
+class TestRouterElastic:
+    def _replica(self):
+        from distlr_tpu.serve import ScoringEngine, ScoringServer
+
+        cfg = Config(num_feature_dim=8, model="binary_lr", l2_c=0.0)
+        eng = ScoringEngine(cfg, max_batch_size=64)
+        eng.set_weights(np.linspace(-1.0, 1.0, 8).astype(np.float32))
+        return ScoringServer(eng, max_wait_ms=0.5).start()
+
+    def test_add_and_remove_replicas_under_traffic(self):
+        from distlr_tpu.serve import ScoringRouter
+        from distlr_tpu.serve.server import score_lines_over_tcp
+
+        a = self._replica()
+        b = self._replica()
+        router = ScoringRouter([f"{a.host}:{a.port}"]).start()
+        errs, stop = [], threading.Event()
+
+        def traffic():
+            while not stop.is_set():
+                for r in score_lines_over_tcp(router.host, router.port,
+                                              ["1:1 3:1"]):
+                    if r.startswith("ERR"):
+                        errs.append(r)
+                        return
+
+        t = threading.Thread(target=traffic)
+        t.start()
+        try:
+            time.sleep(0.2)
+            addr_b = f"{b.host}:{b.port}"
+            reply = score_lines_over_tcp(
+                router.host, router.port, [f"ADDREPLICA default {addr_b}"])
+            assert reply[0].startswith("OK ADDREPLICA")
+            time.sleep(0.3)
+            st = json.loads(score_lines_over_tcp(router.host, router.port,
+                                                 ["STATS"])[0])
+            assert st["replica_count"] == 2 and st["replicas_up"] == 2
+            # the NEW replica actually takes traffic
+            deadline = time.monotonic() + 10.0
+            while b.stats()["requests"] == 0 and time.monotonic() < deadline:
+                time.sleep(0.05)
+            assert b.stats()["requests"] > 0
+            # scale back down: remove the ORIGINAL replica mid-traffic
+            reply = score_lines_over_tcp(
+                router.host, router.port,
+                [f"DELREPLICA default {a.host}:{a.port}"])
+            assert reply[0].startswith("OK DELREPLICA")
+            time.sleep(0.3)
+            st = json.loads(score_lines_over_tcp(router.host, router.port,
+                                                 ["STATS"])[0])
+            assert st["replica_count"] == 1
+        finally:
+            stop.set()
+            t.join()
+            router.stop()
+            a.stop()
+            b.stop()
+        assert not errs, errs
+
+    def test_admin_validation(self):
+        from distlr_tpu.serve import ScoringRouter
+
+        a = self._replica()
+        router = ScoringRouter([f"{a.host}:{a.port}"]).start()
+        try:
+            assert router.handle_line("ADDREPLICA default").startswith(
+                "ERR ADDREPLICA")
+            assert router.handle_line(
+                f"ADDREPLICA default {a.host}:{a.port}").startswith(
+                    "ERR ADDREPLICA")  # already registered
+            assert router.handle_line(
+                "DELREPLICA default 1.2.3.4:9").startswith("ERR DELREPLICA")
+            # a NEW model id via ADDREPLICA joins the registry
+            assert router.handle_line(
+                f"ADDREPLICA v2 {a.host}:{a.port}").startswith("OK")
+            assert "v2" in router.model_ids
+        finally:
+            router.stop()
+            a.stop()
+
+
+# ---------------------------------------------------------------------------
+# scoped rollout SLO gating (satellite)
+# ---------------------------------------------------------------------------
+
+class TestRolloutScoping:
+    def test_attributable(self):
+        from distlr_tpu.serve.rollout import attributable
+
+        cand = {"name": "distlr_alert_shadow_psi", "firing": True,
+                "labels": {"tenant": "v1", "candidate": "v2",
+                           "threshold": "0.25"}}
+        assert attributable(cand, "v2")
+        assert attributable(cand, "v1")  # the tenant's own ramp too
+        assert not attributable(cand, "v3")
+        fleet = {"name": "distlr_alert_ps_push_errors", "firing": True,
+                 "labels": {"threshold": "0.01"}}
+        assert not attributable(fleet, "v2")  # unattributed = fleet-wide
+
+    def test_shadow_psi_alert_is_candidate_attributed(self):
+        from distlr_tpu.obs.federate import AlertThresholds, evaluate_alerts
+
+        reg = MetricsRegistry()
+        g = reg.gauge("distlr_tenant_shadow_psi", "test",
+                      ("tenant", "candidate"))
+        g.labels(tenant="v1", candidate="v2").set(0.9)
+        g.labels(tenant="v1", candidate="v3").set(0.01)
+        alerts = evaluate_alerts(reg, thresholds=AlertThresholds())
+        shadow = [a for a in alerts
+                  if a["name"] == "distlr_alert_shadow_psi"]
+        assert len(shadow) == 2
+        by_cand = {a["labels"]["candidate"]: a for a in shadow}
+        assert by_cand["v2"]["firing"] and not by_cand["v3"]["firing"]
+        assert by_cand["v2"]["labels"]["tenant"] == "v1"
+
+    def test_scoped_poller_ignores_other_models(self):
+        import http.server
+
+        from distlr_tpu.serve.rollout import fleet_alert_poller
+
+        doc = {"alerts": [
+            {"name": "distlr_alert_shadow_psi", "firing": True,
+             "labels": {"tenant": "v1", "candidate": "v2"}},
+            {"name": "distlr_alert_shadow_psi", "firing": True,
+             "labels": {"tenant": "v1", "candidate": "v9"}},
+            {"name": "distlr_alert_score_drift", "firing": True,
+             "labels": {"threshold": "0.25"}},
+        ]}
+
+        class H(http.server.BaseHTTPRequestHandler):
+            def do_GET(self):
+                body = json.dumps(doc).encode()
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):
+                pass
+
+        httpd = http.server.ThreadingHTTPServer(("127.0.0.1", 0), H)
+        threading.Thread(target=httpd.serve_forever, daemon=True).start()
+        url = f"http://127.0.0.1:{httpd.server_address[1]}"
+        try:
+            # unscoped: every firing alert gates (pre-satellite behavior)
+            assert len(fleet_alert_poller(url)()) == 3
+            # scoped to v2: only ITS shadow series; the other candidate's
+            # alert and the unattributed fleet drift are skipped
+            scoped = fleet_alert_poller(url, scope_model="v2")()
+            assert len(scoped) == 1 and "candidate=v2" in scoped[0]
+        finally:
+            httpd.shutdown()
+            httpd.server_close()
+
+    def test_scoped_poller_unreachable_still_gates(self):
+        from distlr_tpu.serve.rollout import fleet_alert_poller
+
+        poll = fleet_alert_poller("http://127.0.0.1:9", timeout_s=0.2,
+                                  scope_model="v2")
+        assert poll() == ["rollout_fleet_unreachable"]
+
+
+# ---------------------------------------------------------------------------
+# the acceptance e2e (tier-1 bar)
+# ---------------------------------------------------------------------------
+
+class TestElasticAcceptance:
+    def test_double_then_halve_fleet_under_chaos(self, tmp_path):
+        """Async training + serving live against ONE PS group through
+        the chaos proxy: double then halve the server ranks AND the
+        worker/engine replicas mid-run.  Zero process restarts, zero
+        failed accepted requests, no barrier stall (the Hogwild path is
+        barrier-free and every op completes), applied pushes never
+        exceed issued, and final quality within 1pt of the same run on
+        a static fleet."""
+        from distlr_tpu.feedback import OnlineTrainer
+        from distlr_tpu.serve import (
+            HotReloader,
+            LivePSWatcher,
+            ScoringEngine,
+            ScoringRouter,
+            ScoringServer,
+        )
+        from distlr_tpu.serve.server import score_lines_over_tcp
+
+        rng = np.random.default_rng(7)
+        w_true = np.where(np.arange(D) % 2 == 0, 1.0, -1.0).astype(np.float32)
+        X, y = _make_rows(600, w_true, rng)
+        Xt, yt = _make_rows(200, w_true, rng)
+        test_lines = [_libsvm(x) for x in Xt]
+
+        def accuracy(w) -> float:
+            return float((((Xt @ w) > 0).astype(np.int32) == yt).mean())
+
+        cfg = Config(model="binary_lr", num_feature_dim=D, batch_size=25,
+                     l2_c=0.0, sync_mode=False, learning_rate=0.5,
+                     ps_retry_attempts=6, ps_retry_backoff_ms=25,
+                     ps_retry_deadline_s=30)
+        # scripted partial partition on link 0 while the fleet doubles
+        plan = parse_plan({"seed": 3, "faults": [
+            {"kind": "partition", "links": [0], "window": [0.9, 1.6]},
+        ]})
+        shard_dir = tmp_path / "shards"
+        unknown0 = _counter_total("distlr_ps_push_outcome_unknown_total")
+
+        group = ServerGroup(2, 1, D, sync=False, learning_rate=0.5,
+                            via_chaos=plan)
+        group.start()
+        sup = ServerSupervisor(group, poll_interval=0.1).start()
+        coord = MembershipCoordinator(group, supervisor=sup)
+        trainers: list[OnlineTrainer] = []
+        threads: list[threading.Thread] = []
+        stops: list[threading.Event] = []
+        train_errs: list[Exception] = []
+        try:
+            def start_trainer(worker_id):
+                tr = OnlineTrainer(cfg, None, str(shard_dir),
+                                   poll_interval_s=0.05, idle_flush_s=0.3,
+                                   worker_id=worker_id, claim_stale_s=300,
+                                   route=coord.layout)
+                ev = threading.Event()
+
+                def run():
+                    try:
+                        tr.run(stop=ev)
+                        tr._flush_push()
+                    except Exception as e:  # noqa: BLE001
+                        train_errs.append(e)
+
+                th = threading.Thread(target=run, name=f"online-{worker_id}")
+                trainers.append(tr)
+                threads.append(th)
+                stops.append(ev)
+                th.start()
+
+            os.makedirs(shard_dir, exist_ok=True)
+            start_trainer(0)
+            start_trainer(1)
+
+            # serving: live-PS engine behind a router, traffic flowing
+            eng = ScoringEngine(cfg, max_batch_size=64)
+            watcher = LivePSWatcher(None, D, route=coord.layout,
+                                    timeout_ms=5000)
+            reloader = HotReloader(eng, watcher, interval_s=0.1).start()
+            reloader.wait_for_weights(timeout_s=30)
+            srv_a = ScoringServer(eng, max_wait_ms=0.5).start()
+            router = ScoringRouter([f"{srv_a.host}:{srv_a.port}"]).start()
+            serve_errs: list[str] = []
+            served = [0]
+            traffic_stop = threading.Event()
+
+            def traffic():
+                i = 0
+                while not traffic_stop.is_set():
+                    line = test_lines[i % len(test_lines)]
+                    i += 1
+                    for r in score_lines_over_tcp(router.host, router.port,
+                                                  [line]):
+                        if r.startswith("ERR"):
+                            serve_errs.append(r)
+                            return
+                        served[0] += 1
+                    time.sleep(0.002)
+
+            traffic_thread = threading.Thread(target=traffic)
+            traffic_thread.start()
+
+            srv_b = None
+            reloader_b = None
+            try:
+                # feed shards progressively so training spans the churn
+                seq = _write_shards(shard_dir, X[:200], y[:200], 50)
+                time.sleep(0.9)  # partition window opens
+                # --- double the server group THROUGH the partition ----
+                stats = coord.resize(4)
+                assert stats["ok"] and stats["epoch"] == 2
+                seq = _write_shards(shard_dir, X[200:400], y[200:400], 50,
+                                    start_seq=seq)
+                # --- scale the serving tier up: new engine replica ----
+                eng_b = ScoringEngine(cfg, max_batch_size=64)
+                watcher_b = LivePSWatcher(None, D, route=coord.layout,
+                                          timeout_ms=5000, client_id=4094)
+                reloader_b = HotReloader(eng_b, watcher_b,
+                                         interval_s=0.1).start()
+                reloader_b.wait_for_weights(timeout_s=30)
+                srv_b = ScoringServer(eng_b, max_wait_ms=0.5).start()
+                assert router.handle_line(
+                    f"ADDREPLICA default {srv_b.host}:{srv_b.port}"
+                ).startswith("OK")
+                # --- scale the workers up, then down ------------------
+                start_trainer(2)
+                time.sleep(0.6)
+                stops[1].set()  # retire worker 1 mid-run (scale-down)
+                # --- halve the server group ---------------------------
+                stats = coord.resize(2)
+                assert stats["ok"] and stats["epoch"] == 3
+                seq = _write_shards(shard_dir, X[400:], y[400:], 50,
+                                    start_seq=seq)
+                # --- scale the serving tier down ----------------------
+                assert router.handle_line(
+                    f"DELREPLICA default {srv_a.host}:{srv_a.port}"
+                ).startswith("OK")
+
+                # drain: all shards consumed exactly once
+                def all_consumed():
+                    return sum(1 for p in os.listdir(shard_dir)
+                               if p.endswith(".done")) == seq
+                deadline = time.monotonic() + 60
+                while not all_consumed() and time.monotonic() < deadline:
+                    assert not train_errs, train_errs
+                    time.sleep(0.1)
+                assert all_consumed(), sorted(os.listdir(shard_dir))
+                time.sleep(0.5)  # idle_flush pushes the last spans
+            finally:
+                traffic_stop.set()
+                traffic_thread.join()
+                for ev in stops:
+                    ev.set()
+                for th in threads:
+                    th.join(timeout=30)
+                reloader.stop()
+                if reloader_b is not None:
+                    reloader_b.stop()
+                router.stop()
+                srv_a.stop()
+                if srv_b is not None:
+                    srv_b.stop()
+
+            assert not train_errs, train_errs
+            # zero failed accepted requests, and real traffic flowed
+            assert not serve_errs, serve_errs[:3]
+            assert served[0] > 100
+            rstats = router.stats()
+            assert rstats["errors"] == 0
+            # zero process restarts: the supervisor never respawned (a
+            # retiring rank's exit must not read as a crash) and nothing
+            # gave up
+            assert not [e for e in sup.events], sup.events
+            # exactly-once shard consumption across worker churn
+            assert sum(t.examples for t in trainers) == len(y)
+            # membership actually churned: two reshards, epoch at 3
+            assert coord.epoch == 3 and group.num_servers == 2
+            # applied <= issued across the migrations: the group push
+            # clock (per-worker scaled, seed pushes removed) can never
+            # exceed what the trainers + watchers issued
+            issued = sum(t.pushes for t in trainers) + len(trainers)
+            unknowns = (_counter_total(
+                "distlr_ps_push_outcome_unknown_total") - unknown0)
+            applied = (group.global_pushes()
+                       - coord.seed_pushes / group.num_servers)
+            assert applied <= issued + unknowns + 1, (
+                f"applied {applied} > issued {issued} + {unknowns}")
+            with KVWorker(group.direct_hosts, D, sync_group=False) as kv:
+                w_elastic = kv.pull()
+        finally:
+            sup.stop()
+            group.stop()
+
+        # ---- the static-fleet twin: same data, no churn, no chaos ----
+        static_dir = tmp_path / "static_shards"
+        _write_shards(static_dir, X, y, 50)
+        with ServerGroup(2, 1, D, sync=False, learning_rate=0.5) as g2:
+            tr = OnlineTrainer(cfg, g2.hosts, str(static_dir),
+                               poll_interval_s=0.05)
+            tr.run(max_shards=12)
+            tr._flush_push()
+            with KVWorker(g2.hosts, D, sync_group=False) as kv:
+                w_static = kv.pull()
+            tr.close()
+
+        acc_e, acc_s = accuracy(w_elastic), accuracy(w_static)
+        assert acc_s > 0.9, f"static baseline failed to learn ({acc_s})"
+        assert acc_e >= acc_s - 0.01, (
+            f"elastic fleet lost quality: {acc_e} vs static {acc_s}")

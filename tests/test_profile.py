@@ -555,7 +555,8 @@ class TestNativeCpu:
         """Mixed-vintage pin: the kStats request's aux advertises how
         many stats the client accepts — aux 0 (a pre-extension client,
         whose strict length check demands exactly six) gets the 6-slot
-        v1 reply; the extension replies at most kStatsVals."""
+        v1 reply; the extension replies at most kStatsVals (11 since the
+        membership round appended the epoch slot)."""
         import socket
         import struct
 
@@ -566,7 +567,8 @@ class TestNativeCpu:
             with socket.create_connection(("127.0.0.1", port)) as s:
                 # MsgHeader: magic u32, op u8, flags u8, aux u16,
                 # client_id u32, ts u32, num_keys u64; op 6 = kStats
-                for aux, expect_slots in ((0, 12), (10, 20), (64, 20)):
+                for aux, expect_slots in ((0, 12), (10, 20), (11, 22),
+                              (64, 22)):
                     s.sendall(struct.pack("<IBBHIIQ", 0xD157C0DE, 6, 0,
                                           aux, 1, 1, 0))
                     hdr = s.recv(24, socket.MSG_WAITALL)
