@@ -1,0 +1,28 @@
+# Top-level developer entry points.
+#
+#   make lint             # distlr-lint: wire parity, concurrency,
+#                         # config/docs parity, metrics doc (jax-free)
+#   make lint-docs        # regenerate docs/CONFIG.md + docs/METRICS.md
+#   make sanitizers       # build the native TSan/ASan/UBSan matrix
+#   make sanitizer-smoke  # fast TSan-client + TSan-server e2e
+#                         # (delegates to benchmarks/Makefile)
+#
+# The lint passes are tier-1-enforced through tests/test_analysis.py;
+# this target is the same runner for hands/CI hooks.  See
+# docs/ANALYSIS.md for pass semantics and the suppression policy.
+
+PY ?= python
+
+lint:
+	$(PY) -m distlr_tpu.analysis
+
+lint-docs:
+	$(PY) -m distlr_tpu.analysis --write-docs
+
+sanitizers:
+	$(MAKE) -C distlr_tpu/ps/native sanitizers
+
+sanitizer-smoke:
+	$(MAKE) -C benchmarks sanitizer-smoke
+
+.PHONY: lint lint-docs sanitizers sanitizer-smoke

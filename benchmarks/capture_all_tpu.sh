@@ -30,6 +30,20 @@
 # can't burn the whole window; 3 and 5 are best-effort (failures
 # logged, not fatal). Nothing else should touch the TPU while this runs
 # (concurrent probes push subprocesses onto their CPU fallbacks).
+#
+# NOT part of this window (CPU-side gates, run them before committing a
+# capture — both are chip-free and safe while the tunnel is wedged):
+#   make lint                       # distlr-lint: wire-parity vs
+#                                   # kv_protocol.h, concurrency lint +
+#                                   # audited baseline, config/CLI/docs
+#                                   # parity, metrics doc (ISSUE 13)
+#   make -C benchmarks sanitizer-smoke
+#                                   # fast TSan-client+TSan-server e2e +
+#                                   # ASan/UBSan server e2e; the full
+#                                   # chaos/elastic suites under the
+#                                   # TSan pair are
+#                                   # tests/test_sanitizer_matrix.py -m slow
+# (see docs/ANALYSIS.md for pass semantics + the suppression policy)
 set -e
 cd "$(dirname "$0")/.."
 

@@ -1,0 +1,84 @@
+"""distlr-lint runner: ``python -m distlr_tpu.analysis`` / ``make lint``.
+
+Runs every pass (wire parity, concurrency, config/CLI/docs parity, and
+the folded-in metrics-doc lint), prints findings as
+``[pass] key: message (file:line ...)``, and exits non-zero when any
+survive the audited baselines — the single static-analysis entry point
+tier-1 enforces through ``tests/test_analysis.py``.
+
+    python -m distlr_tpu.analysis                # all passes
+    python -m distlr_tpu.analysis --pass wire    # one pass
+    python -m distlr_tpu.analysis --write-docs   # regenerate
+                                                 # docs/CONFIG.md +
+                                                 # docs/METRICS.md
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from distlr_tpu.analysis.report import Finding
+
+PASSES = ("wire", "concurrency", "config", "metrics")
+
+
+def run_pass(name: str) -> list[Finding]:
+    if name == "wire":
+        from distlr_tpu.analysis import wire_parity
+        return wire_parity.check()
+    if name == "concurrency":
+        from distlr_tpu.analysis import concurrency
+        return concurrency.check()
+    if name == "config":
+        from distlr_tpu.analysis import config_doc
+        return config_doc.check()
+    if name == "metrics":
+        # the PR-8 lint, folded under this runner (its module keeps its
+        # own __main__ for the doc generator; tests/test_metrics_doc.py
+        # keeps tier-1 coverage unchanged)
+        from distlr_tpu.obs import metrics_doc
+        return [Finding("metrics", f"metrics-drift:{i}", p)
+                for i, p in enumerate(metrics_doc.check())]
+    raise ValueError(f"unknown pass {name!r} (choose from {PASSES})")
+
+
+def run(passes=PASSES) -> list[Finding]:
+    findings: list[Finding] = []
+    for name in passes:
+        findings.extend(run_pass(name))
+    return findings
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m distlr_tpu.analysis",
+        description="distlr-lint: wire parity, concurrency, "
+                    "config/docs parity, metrics doc")
+    ap.add_argument("--pass", dest="passes", action="append",
+                    choices=PASSES,
+                    help="run only this pass (repeatable; default all)")
+    ap.add_argument("--write-docs", action="store_true",
+                    help="regenerate docs/CONFIG.md and docs/METRICS.md "
+                    "from the sources, then exit")
+    args = ap.parse_args(argv)
+    if args.write_docs:
+        from distlr_tpu.analysis import config_doc
+        from distlr_tpu.obs import metrics_doc
+        print(f"wrote {config_doc.write_doc()}")
+        metrics_doc.main([])
+        return 0
+    passes = tuple(args.passes) if args.passes else PASSES
+    findings = run(passes)
+    for f in findings:
+        print(f.render(), file=sys.stderr)
+    if findings:
+        print(f"distlr-lint: {len(findings)} finding(s) across "
+              f"{len(passes)} pass(es)", file=sys.stderr)
+        return 1
+    print(f"distlr-lint: clean ({', '.join(passes)})")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -48,6 +48,7 @@ import time
 from distlr_tpu.chaos.plan import FaultPlan, FaultSpec
 from distlr_tpu.compress import codecs
 from distlr_tpu.obs import dtrace
+from distlr_tpu.ps import wire
 from distlr_tpu.obs.registry import get_registry
 from distlr_tpu.utils.logging import get_logger
 
@@ -78,20 +79,20 @@ _DELAY_MS = _reg.counter(
     labelnames=("link",),
 )
 
-#: MsgHeader wire layout (kv_protocol.h): magic u32, op u8, flags u8,
-#: aux u16, client_id u32, timestamp u32, num_keys u64 — little-endian,
-#: packed.
-_HEADER = struct.Struct("<IBBHIIQ")
-_MAGIC = 0xD157C0DE
-_OP_PUSH, _OP_PUSHPULL = 1, 7
-#: flags fields the framing depends on (kv_protocol.h): bits 4-5 carry
-#: the gradient codec of a push-class value payload, bit 6 marks an
-#: opt-state op (2x vals per key), bit 7 a 16-byte trace trailer after
-#: the header (whose trace_id the fault events record — "this retry was
-#: caused by fault #3" readable straight off the merged trace)
-_CODEC_SHIFT, _CODEC_MASK, _OPT_STATE, _TRACED = 4, 0x30, 64, 0x80
-_TRACE_FRAME = struct.Struct("<QQ")
-_OP_HELLO = 5
+#: MsgHeader framing, op codes, and the flags bits the parser depends
+#: on — all from the ONE Python mirror of kv_protocol.h
+#: (:mod:`distlr_tpu.ps.wire`, lint-checked against the header): bits
+#: 4-5 carry the gradient codec of a push-class value payload, bit 6
+#: marks an opt-state op (2x vals per key), bit 7 a 16-byte trace
+#: trailer after the header (whose trace_id the fault events record —
+#: "this retry was caused by fault #3" readable straight off the
+#: merged trace)
+_HEADER = wire.HEADER_STRUCT
+_MAGIC = wire.MAGIC
+_OP_PUSH, _OP_PUSHPULL = wire.OP_PUSH, wire.OP_PUSH_PULL
+_OPT_STATE, _TRACED = wire.FLAG_OPT_STATE, wire.FLAG_TRACED
+_TRACE_FRAME = wire.TRACE_FRAME_STRUCT
+_OP_HELLO = wire.OP_HELLO
 _CODEC_NAMES = {v: k for k, v in codecs.CODEC_IDS.items()}
 
 
@@ -103,7 +104,7 @@ def _push_vals_bytes(flags: int, n_flat: int) -> int:
     assumed dense f32 would misframe every compressed push and degrade
     the whole stream to a raw relay, silently disabling op-offset
     faults for exactly the runs the compression bench needs them on."""
-    codec = _CODEC_NAMES.get((flags & _CODEC_MASK) >> _CODEC_SHIFT, "none")
+    codec = _CODEC_NAMES.get(wire.codec_of(flags), "none")
     mult = 2 if codec == "none" and flags & _OPT_STATE else 1
     return codecs.payload_bytes(codec, n_flat) * mult
 #: pump socket timeout: bounds stop() latency without busy-waiting
@@ -565,17 +566,32 @@ class ChaosLink:
             self._lsock.close()
         except OSError:
             pass
-        with self._lock:
-            conns = list(self._conns)
-        for down, up in conns:
-            for s in (down, up):
-                try:
-                    s.close()
-                except OSError:
-                    pass
-        self._accept_thread.join(timeout=2.0)
-        for t in self._threads:
-            t.join(timeout=2.0)
+        # Join the accept loop BEFORE snapshotting conns/threads: it is
+        # the only spawner, so once it exits the lists are final.  The
+        # old order snapshotted first (and read _threads without the
+        # lock), so a connection accepted concurrently with stop() could
+        # leak its sockets and pump threads past stop() — found by the
+        # concurrency lint (distlr_tpu.analysis), regression-tested in
+        # tests/test_analysis.py.  The loop blocks at most ~5s in an
+        # upstream connect (create_connection timeout), so 6s covers a
+        # partitioned upstream; if it is somehow still alive, sweep
+        # again rather than trusting a pre-join snapshot.
+        self._accept_thread.join(timeout=6.0)
+        for _attempt in range(2):
+            with self._lock:
+                conns = list(self._conns)
+                threads = list(self._threads)
+            for down, up in conns:
+                for s in (down, up):
+                    try:
+                        s.close()
+                    except OSError:
+                        pass
+            for t in threads:
+                t.join(timeout=2.0)
+            if not self._accept_thread.is_alive():
+                break
+            self._accept_thread.join(timeout=2.0)
 
 
 class ChaosFabric:

@@ -30,25 +30,28 @@ from __future__ import annotations
 
 import numpy as np
 
-#: int8 block-quantization granularity (values per f32 scale) — must
-#: match kQuantBlock in ps/native/kv_protocol.h
-QUANT_BLOCK = 256
+from distlr_tpu.ps import wire
+
+#: int8 block-quantization granularity (values per f32 scale) — the
+#: named mirror of kQuantBlock (distlr_tpu.ps.wire, lint-checked
+#: against ps/native/kv_protocol.h)
+QUANT_BLOCK = wire.QUANT_BLOCK
 
 #: wire codec ids (kv_protocol.h Codec) keyed by the --ps-compress name
-CODEC_IDS = {"none": 0, "int8": 1, "signsgd": 2}
+CODEC_IDS = {
+    "none": wire.CODEC_NONE,
+    "int8": wire.CODEC_INT8,
+    "signsgd": wire.CODEC_SIGN,
+}
 CODECS = tuple(CODEC_IDS)
 
 
 def payload_bytes(codec: str, n: int) -> int:
     """Exact value-payload bytes of a coded frame carrying ``n`` values
     (the native ``CodecPayloadBytes``)."""
-    if codec == "int8":
-        return ((n + QUANT_BLOCK - 1) // QUANT_BLOCK) * 4 + n
-    if codec == "signsgd":
-        return (n + 7) // 8
-    if codec == "none":
-        return 4 * n
-    raise ValueError(f"unknown codec {codec!r} (choose from {CODECS})")
+    if codec not in CODEC_IDS:
+        raise ValueError(f"unknown codec {codec!r} (choose from {CODECS})")
+    return wire.codec_payload_bytes(CODEC_IDS[codec], n)
 
 
 def encode_int8(v: np.ndarray) -> tuple[np.ndarray, np.ndarray]:

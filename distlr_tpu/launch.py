@@ -166,6 +166,17 @@ def _add_config_flags(p: argparse.ArgumentParser) -> None:
     p.add_argument("--hash-seed", dest="hash_seed", type=int,
                    help="seed of the load-time feature hash")
     p.add_argument("--compat-mode", dest="compat_mode", choices=["correct", "reference"])
+    p.add_argument("--random-seed", dest="random_seed", type=int,
+                   help="RNG seed for data shuffling/synthetic draws "
+                   "(default 10, the reference's RANDOM_SEED contract)")
+    p.add_argument("--prefetch", dest="prefetch", type=int,
+                   help="host->device streaming depth in Trainer.fit "
+                   "(default 2 = double buffering; 1 = strictly serial, "
+                   "the reference's DataIter shape)")
+    p.add_argument("--ps-timeout", dest="ps_timeout_ms", type=int,
+                   help="per-op KV receive timeout, ms (default 600000; "
+                   "0 = block forever — the reference semantics, where a "
+                   "sync straggler deadlocks the job)")
     p.add_argument("--feature-dtype", dest="feature_dtype",
                    choices=["float32", "bfloat16", "int8", "int8_dot"],
                    help="device-resident storage dtype for dense features "
@@ -318,6 +329,7 @@ def _config_from_args(args: argparse.Namespace) -> Config:
             "profile_dir", "num_workers", "num_servers", "ps_compute_backend",
             "feature_dtype", "block_size", "block_groups", "ctr_fields",
             "hash_seed", "ps_pipeline", "obs_metrics_port",
+            "random_seed", "prefetch", "ps_timeout_ms",
             "obs_metrics_host", "obs_trace_path", "obs_run_dir",
             "ps_retry_attempts", "ps_retry_backoff_ms",
             "ps_retry_backoff_max_ms", "ps_retry_deadline_s",

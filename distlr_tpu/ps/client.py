@@ -18,6 +18,7 @@ import numpy as np
 
 from distlr_tpu.obs import dtrace
 from distlr_tpu.obs.registry import family_total, get_registry
+from distlr_tpu.ps import wire
 from distlr_tpu.ps.build import build_native, client_lib
 from distlr_tpu.utils.logging import get_logger
 
@@ -163,6 +164,13 @@ STATS_FIELDS = (
     # flip rank by rank
     "epoch",
 )
+
+# The field list IS a wire mirror: its length must track kStatsVals and
+# its v1 prefix kStatsValsV1 (distlr_tpu.ps.wire, lint-checked against
+# the header) — the exact drift class that pinned kStats lengths wrong
+# in earlier rounds.
+assert len(STATS_FIELDS) == wire.STATS_VALS
+assert STATS_FIELDS[wire.STATS_VALS_V1 - 1] == "total_pulls"
 
 
 class PSTimeoutError(TimeoutError):
@@ -1000,7 +1008,7 @@ class KVWorker:
         to the flat keys when no divisor aligns."""
         if self._dense_rows is None:
             best = 1
-            for v in range(min(4096, self.dim), 1, -1):
+            for v in range(min(wire.MAX_VALS_PER_KEY, self.dim), 1, -1):
                 if self.dim % v == 0 and self.supports_vals_per_key(v):
                     best = v
                     break
@@ -1309,9 +1317,10 @@ class KVWorker:
         equivalent, reference src/main.cc:150).  ``barrier_id`` is the
         generation: a late vote for an already-released generation
         returns immediately (restart safety — kv_protocol.h)."""
-        if not 0 <= barrier_id < (1 << 16):
-            # the wire field is u16; silent truncation could alias a
-            # released generation and turn a real barrier into a no-op
+        if not 0 <= barrier_id <= wire.AUX_MAX:
+            # the wire field is u16 (MsgHeader::aux); silent truncation
+            # could alias a released generation and turn a real barrier
+            # into a no-op
             raise ValueError(f"barrier_id must fit in uint16, got {barrier_id}")
 
         def _issue():

@@ -56,6 +56,7 @@ import numpy as np
 
 from distlr_tpu.obs import dtrace
 from distlr_tpu.obs.registry import get_registry
+from distlr_tpu.ps import wire
 from distlr_tpu.ps.client import KVWorker
 from distlr_tpu.ps.server import ResizePlan, ServerGroup
 from distlr_tpu.utils.logging import get_logger
@@ -145,7 +146,12 @@ class MembershipCoordinator:
     # -- layout publishing -------------------------------------------------
     @property
     def epoch(self) -> int:
-        return self._epoch
+        # under the lock like every other published view: resize()
+        # commits _epoch from its own thread, and an unlocked read here
+        # was the concurrency lint's first confirmed finding (benign on
+        # CPython today, but the lock is the documented contract)
+        with self._lock:
+            return self._epoch
 
     def layout(self) -> dict:
         """The routing contract clients follow (the ``route=`` provider
@@ -290,16 +296,23 @@ class MembershipCoordinator:
             if new_num_servers == self.group.num_servers:
                 return {"epoch": self._epoch, "noop": True,
                         "num_servers": self.group.num_servers}
-            if self._epoch >= 0xFFFF:
-                raise MembershipError("epoch space exhausted (65535)")
+            if self._epoch >= wire.AUX_MAX:
+                # the epoch rides the u16 MsgHeader::aux field
+                raise MembershipError(
+                    f"epoch space exhausted ({wire.AUX_MAX})")
             try:
                 plan = self.group.plan_resize(new_num_servers)
             except ValueError as e:
                 raise MembershipError(str(e)) from e
             self._status = "migrating"
+            # derive the successor epoch while still holding the lock:
+            # read lock-free (as this originally was) it relied on the
+            # "migrating" guard for exclusion — a coupling the
+            # concurrency lint rightly flagged
+            old_epoch = self._epoch
         direction = ("grow" if new_num_servers > self.group.num_servers
                      else "shrink")
-        new_epoch = self._epoch + 1
+        new_epoch = old_epoch + 1
         t0 = time.monotonic()
         self._record("resize_start", direction=direction,
                      old=self.group.num_servers, new=new_num_servers,
@@ -327,7 +340,7 @@ class MembershipCoordinator:
                 if proc.stdout:
                     proc.stdout.close()
                 proc.wait()
-            self._unfence(self._epoch)
+            self._unfence(old_epoch)
             with self._lock:
                 self._status = "active"
             if self.supervisor is not None:

@@ -89,6 +89,7 @@
 #include <arpa/inet.h>
 #include <netinet/in.h>
 #include <netinet/tcp.h>
+#include <pthread.h>
 #include <sys/socket.h>
 #include <sys/time.h>
 #include <time.h>
@@ -255,7 +256,6 @@ class KVServer {
             : opt_ == Opt::kSign ? "signsgd" : "sgd",
             lr_, compress_ ? 1 : 0);
     fflush(stderr);
-    std::thread prof_thread;
     if (!prof_journal_.empty()) {
       prof_f_ = fopen(prof_journal_.c_str(), "a");
       if (prof_f_ == nullptr) {
@@ -264,11 +264,29 @@ class KVServer {
                 prof_journal_.c_str());
       } else {
         prof_t0_ = WallNowS();
-        prof_thread = std::thread(&KVServer::ProfLoop, this);
+        // Detached like the handler threads (the TSan matrix round):
+        // ServerGroup.stop() SIGTERMs ranks that are MID-clean-shutdown
+        // too, and a joinable prof thread that finished between
+        // shutdown_ flipping and the epilogue's join showed up as a
+        // thread leak at the handler's _exit.  The epilogue waits on
+        // prof_loop_done_ (bounded) before the final window write.
+        prof_loop_done_.store(false);
+        if (!SpawnDetached(&KVServer::ProfTrampoline, this)) {
+          prof_loop_done_.store(true);
+          fprintf(stderr, "[distlr_kv_server] cannot start profiler "
+                  "thread; profile windows will not be recorded\n");
+        }
       }
     }
 
-    std::vector<std::thread> conns;
+    // Handler threads are DETACHED and tracked by a live counter
+    // instead of accumulating std::thread objects per connection: the
+    // old join-at-shutdown vector retained every finished handler's
+    // stack for the life of the process, an unbounded zombie-thread
+    // leak under elastic reroute/reconnect churn — the first confirmed
+    // finding of the TSan matrix round (it reports finished joinable
+    // threads at exit).  Shutdown waits the counter to zero, which is
+    // exactly what the join loop provided.
     while (!shutdown_.load()) {
       int fd = accept(listen_fd_, nullptr, nullptr);
       if (fd < 0) {
@@ -277,18 +295,50 @@ class KVServer {
       }
       setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
       {
+        // Registration re-checks shutdown_ UNDER mu_: the kShutdown
+        // handler stores shutdown_ before sweeping active_fds_ under
+        // this same mutex, so a connection accept() handed over
+        // concurrently with shutdown either lands in the sweep or is
+        // closed here — never a Serve thread parked in ReadFull that
+        // nobody will unblock (which wedged the drain below until
+        // teardown escalated to SIGTERM).
         std::lock_guard<std::mutex> lock(mu_);
+        if (shutdown_.load()) {
+          close(fd);
+          break;
+        }
         active_fds_.push_back(fd);
+        ++live_serves_;
       }
-      conns.emplace_back(&KVServer::Serve, this, fd);
+      auto* arg = new ServeArg{this, fd};
+      if (!SpawnDetached(&KVServer::ServeTrampoline, arg)) {
+        delete arg;
+        close(fd);
+        std::lock_guard<std::mutex> lock(mu_);
+        active_fds_.pop_back();
+        --live_serves_;
+      }
     }
-    for (auto& t : conns) t.join();
+    {
+      std::unique_lock<std::mutex> lock(mu_);
+      serves_done_.wait(lock, [this] { return live_serves_ == 0; });
+    }
     close(listen_fd_);
-    if (prof_thread.joinable()) prof_thread.join();
-    if (prof_f_ != nullptr) {
+    // bounded wait for the detached profiler loop (it polls shutdown_
+    // every 100ms) so the final window write below cannot race it
+    for (int i = 0; i < 30 && !prof_loop_done_.load(); ++i) {
+      usleep(100 * 1000);
+    }
+    if (prof_f_ != nullptr && prof_loop_done_.load()) {
       ProfWriteWindow(true);  // final partial window of a clean shutdown
       fclose(prof_f_);
       prof_f_ = nullptr;
+    } else if (prof_f_ != nullptr) {
+      // loop still wedged (e.g. a stalled filesystem inside its own
+      // write): leak the FILE rather than fclose it out from under an
+      // in-flight fprintf — the process is exiting anyway
+      fprintf(stderr, "[distlr_kv_server] profiler loop still busy at "
+              "shutdown; final window skipped\n");
     }
     if (trace_f_ != nullptr) {
       if (trace_dropped_) {
@@ -348,6 +398,43 @@ class KVServer {
     return true;
   }
 
+  // Threads are created ALREADY-DETACHED (PTHREAD_CREATE_DETACHED)
+  // rather than std::thread(...).detach(): a child that finishes
+  // between pthread_create and pthread_detach leaves this toolchain's
+  // TSan runtime a window to account it as a finished-joinable thread
+  // at exit (a flaky "thread leak" report the matrix caught); born-
+  // detached threads have no such transition.
+  static bool SpawnDetached(void* (*fn)(void*), void* arg) {
+    pthread_attr_t attr;
+    if (pthread_attr_init(&attr) != 0) return false;
+    pthread_attr_setdetachstate(&attr, PTHREAD_CREATE_DETACHED);
+    pthread_t tid;
+    const int rc = pthread_create(&tid, &attr, fn, arg);
+    pthread_attr_destroy(&attr);
+    return rc == 0;
+  }
+
+  struct ServeArg {
+    KVServer* self;
+    int fd;
+  };
+
+  static void* ServeTrampoline(void* p) {
+    ServeArg* a = static_cast<ServeArg*>(p);
+    KVServer* self = a->self;
+    const int fd = a->fd;
+    delete a;
+    self->Serve(fd);
+    return nullptr;
+  }
+
+  static void* ProfTrampoline(void* p) {
+    auto* self = static_cast<KVServer*>(p);
+    self->ProfLoop();
+    self->prof_loop_done_.store(true);
+    return nullptr;
+  }
+
   void Serve(int fd) {
     try {
       ServeLoop(fd);
@@ -364,6 +451,16 @@ class KVServer {
                    "for requested capacity failed\n");
     }
     FinishConnection(fd);
+    {
+      // notify UNDER the mutex: the shutdown waiter may destroy this
+      // whole object the moment it observes live_serves_ == 0, and it
+      // cannot reacquire mu_ (and thus return from wait) until this
+      // thread releases it — which is strictly after notify_all() has
+      // finished touching the condition variable
+      std::lock_guard<std::mutex> lock(mu_);
+      --live_serves_;
+      serves_done_.notify_all();
+    }
   }
 
   void ServeLoop(int fd) {
@@ -1235,6 +1332,12 @@ class KVServer {
   int listen_fd_ = -1;
   std::atomic<bool> shutdown_{false};
   std::vector<int> active_fds_;
+  //: detached handler threads still running (guarded by mu_); Run()'s
+  //: shutdown waits it to zero — the join the detach pattern replaces
+  size_t live_serves_ = 0;
+  std::condition_variable serves_done_;
+  //: the detached profiler loop has exited (true when never started)
+  std::atomic<bool> prof_loop_done_{true};
 
   std::mutex mu_;
   bool initialized_ = false;

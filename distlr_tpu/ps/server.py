@@ -17,7 +17,8 @@ import time
 import numpy as np
 
 from distlr_tpu.obs.registry import get_registry
-from distlr_tpu.ps.build import build_native, server_binary
+from distlr_tpu.ps import wire
+from distlr_tpu.ps.build import build_native, sanitizer_environ, server_binary
 from distlr_tpu.utils.logging import get_logger
 
 log = get_logger(__name__)
@@ -140,8 +141,10 @@ class ServerGroup:
         if optimizer not in ("sgd", "ftrl", "signsgd"):
             raise ValueError(
                 f"optimizer must be sgd|ftrl|signsgd, got {optimizer!r}")
-        if not 1 <= epoch <= 0xFFFF:
-            raise ValueError(f"epoch must be in [1, 65535], got {epoch}")
+        if not 1 <= epoch <= wire.AUX_MAX:
+            # membership epochs ride the u16 MsgHeader::aux field
+            raise ValueError(
+                f"epoch must be in [1, {wire.AUX_MAX}], got {epoch}")
         if opt_segments:
             # per-namespace optimizers (GLOBAL (end, opt) pairs, ascending,
             # covering [0, dim)): each rank gets the intersection with its
@@ -348,7 +351,12 @@ class ServerGroup:
                        + os.path.join(d, f"kvserver-{rank}.jsonl"))
             if self._args["prof_window_s"] is not None:
                 cmd.append(f"--prof_window={self._args['prof_window_s']}")
-        proc = subprocess.Popen(cmd, stdout=subprocess.PIPE, text=True)
+        # DISTLR_NATIVE_VARIANT spawns ride the sanitizer environment
+        # (suppressions wired in, caller's log_path preserved); the
+        # standard build passes env=None — the spawn stays byte-
+        # identical to every earlier round's.
+        proc = subprocess.Popen(cmd, stdout=subprocess.PIPE, text=True,
+                                env=sanitizer_environ())
         # The server prints "PORT <n>" once listening; blocking on that
         # line doubles as the readiness wait.
         line = proc.stdout.readline().strip()

@@ -1,0 +1,375 @@
+"""distlr-lint self-tests (ISSUE 13 tentpole).
+
+Three kinds of coverage, per the acceptance criteria:
+
+* the runner exits non-zero on a SEEDED wire-constant mismatch, a
+  seeded unlocked-shared-write, and a seeded lock-order cycle (fixture
+  trees built here — a lint that cannot fail is worse than no lint);
+* the repo itself is CLEAN under every pass, with a baseline whose
+  every entry carries a justification (hygiene is itself linted);
+* regression tests for the two highest-severity concurrency fixes the
+  first run of the pass produced (ChaosLink.stop's teardown race and
+  MembershipCoordinator's unlocked epoch reads).
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import socket
+import textwrap
+import threading
+import time
+
+import pytest
+
+from distlr_tpu.analysis import baseline, concurrency, config_doc, wire_parity
+from distlr_tpu.analysis.__main__ import main as lint_main
+from distlr_tpu.analysis.report import repo_root
+
+REPO = repo_root()
+
+
+# ---------------------------------------------------------------------------
+# wire parity
+# ---------------------------------------------------------------------------
+
+
+def _wire_fixture(tmp_path, mutate_header=None, mutate_client=None):
+    """A minimal tree the wire pass can run against: the real header +
+    mirrors, with optional seeded mutations."""
+    for rel in ("distlr_tpu/ps/native", "distlr_tpu/compress"):
+        os.makedirs(tmp_path / rel, exist_ok=True)
+    for rel in ("distlr_tpu/ps/wire.py", "distlr_tpu/ps/client.py",
+                "distlr_tpu/ps/membership.py", "distlr_tpu/ps/server.py",
+                "distlr_tpu/compress/codecs.py",
+                "distlr_tpu/chaos/proxy.py"):
+        os.makedirs((tmp_path / rel).parent, exist_ok=True)
+        shutil.copy(os.path.join(REPO, rel), tmp_path / rel)
+    hdr = open(os.path.join(
+        REPO, "distlr_tpu/ps/native/kv_protocol.h")).read()
+    if mutate_header:
+        hdr = mutate_header(hdr)
+    (tmp_path / "distlr_tpu/ps/native/kv_protocol.h").write_text(hdr)
+    if mutate_client:
+        cpath = tmp_path / "distlr_tpu/ps/client.py"
+        cpath.write_text(mutate_client(cpath.read_text()))
+    return str(tmp_path)
+
+
+class TestWireParity:
+    def test_repo_is_clean(self):
+        assert wire_parity.check() == []
+
+    def test_header_parser_sees_the_protocol(self):
+        hdr = wire_parity.parse_header()
+        assert hdr["kMagic"][0] == 0xD157C0DE
+        assert hdr["kEpoch"][0] == 8
+        assert hdr["kStatsVals"][0] == 11
+        assert hdr["kCapEpoch"][0] == 1 << 9       # 1ull << evaluation
+        assert hdr["sizeof(MsgHeader)"][0] == 24   # static_assert twin
+
+    def test_seeded_value_mismatch_fails(self, tmp_path):
+        root = _wire_fixture(
+            tmp_path,
+            mutate_header=lambda h: h.replace(
+                "kQuantBlock = 256", "kQuantBlock = 128"))
+        keys = {f.key for f in wire_parity.check(root=root)}
+        assert "value-mismatch:kQuantBlock" in keys
+
+    def test_seeded_one_sided_constant_fails(self, tmp_path):
+        root = _wire_fixture(
+            tmp_path,
+            mutate_header=lambda h: h.replace(
+                "constexpr uint64_t kQuantBlock = 256;",
+                "constexpr uint64_t kQuantBlock = 256;\n"
+                "constexpr uint64_t kNewKnob = 7;"))
+        keys = {f.key for f in wire_parity.check(root=root)}
+        assert "header-only:kNewKnob" in keys
+
+    def test_seeded_raw_literal_fails(self, tmp_path):
+        root = _wire_fixture(
+            tmp_path,
+            mutate_client=lambda s: s.replace(
+                "range(min(wire.MAX_VALS_PER_KEY, self.dim), 1, -1)",
+                "range(min(4096, self.dim), 1, -1)"))
+        keys = {f.key for f in wire_parity.check(root=root)}
+        assert any(k.startswith("raw-literal:distlr_tpu/ps/client.py:"
+                                "kMaxValsPerKey") for k in keys)
+
+    def test_seeded_stats_fields_drift_fails(self, tmp_path):
+        root = _wire_fixture(
+            tmp_path,
+            mutate_client=lambda s: s.replace('    "epoch",\n', ""))
+        keys = {f.key for f in wire_parity.check(root=root)}
+        assert "stats-fields-length" in keys
+
+
+# ---------------------------------------------------------------------------
+# concurrency
+# ---------------------------------------------------------------------------
+
+
+def _pkg(tmp_path, source: str) -> str:
+    pkg = tmp_path / "fixture_pkg"
+    pkg.mkdir()
+    (pkg / "mod.py").write_text(textwrap.dedent(source))
+    return str(pkg)
+
+
+class TestConcurrencyLint:
+    def test_repo_is_clean_under_baseline(self):
+        assert concurrency.check() == []
+
+    def test_every_baseline_entry_has_a_justification(self):
+        entries, problems = baseline.load_baseline()
+        assert problems == []
+        assert entries, "baseline unexpectedly empty"
+        assert all(e.justification.strip() for e in entries)
+
+    def test_seeded_unlocked_write_fails(self, tmp_path):
+        pkg = _pkg(tmp_path, """
+            import threading
+
+            class Counter:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.n = 0
+
+                def safe_bump(self):
+                    with self._lock:
+                        self.n += 1
+
+                def racy_bump(self):
+                    self.n += 1
+        """)
+        fs = concurrency.check(pkg_dir=pkg,
+                               baseline_path=str(tmp_path / "none.toml"))
+        keys = {f.key for f in fs}
+        assert any(k.startswith("unlocked-write:fixture_pkg/mod.py:"
+                                "Counter.n:racy_bump") for k in keys)
+
+    def test_seeded_lock_cycle_fails(self, tmp_path):
+        pkg = _pkg(tmp_path, """
+            import threading
+
+            class A:
+                def __init__(self, b: "B"):
+                    self._lock = threading.Lock()
+                    self.b = b
+
+                def outer(self):
+                    with self._lock:
+                        self.b.enter()
+
+                def enter(self):
+                    with self._lock:
+                        pass
+
+            class B:
+                def __init__(self, a: A):
+                    self._lock = threading.Lock()
+                    self.a = a
+
+                def outer(self):
+                    with self._lock:
+                        self.a.enter()
+
+                def enter(self):
+                    with self._lock:
+                        pass
+        """)
+        fs = concurrency.check(pkg_dir=pkg,
+                               baseline_path=str(tmp_path / "none.toml"))
+        assert any(f.key.startswith("lock-cycle:") for f in fs), \
+            [f.key for f in fs]
+
+    def test_locked_suffix_convention_is_understood(self, tmp_path):
+        pkg = _pkg(tmp_path, """
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.x = 0
+
+                def bump(self):
+                    with self._lock:
+                        self._bump_locked()
+
+                def _bump_locked(self):
+                    self.x += 1
+        """)
+        fs = concurrency.check(pkg_dir=pkg,
+                               baseline_path=str(tmp_path / "none.toml"))
+        assert fs == [], [f.key for f in fs]
+
+    def test_baseline_requires_justification(self, tmp_path):
+        p = tmp_path / "b.toml"
+        p.write_text('[[suppress]]\nkey = "unlocked-write:x"\n')
+        _entries, problems = baseline.load_baseline(str(p))
+        assert any(f.key.startswith("baseline-no-justification")
+                   for f in problems)
+
+    def test_stale_baseline_entry_fails(self, tmp_path):
+        pkg = _pkg(tmp_path, "class Empty:\n    pass\n")
+        p = tmp_path / "b.toml"
+        p.write_text('[[suppress]]\nkey = "unlocked-write:gone"\n'
+                     'justification = "was real once"\n')
+        fs = concurrency.check(pkg_dir=pkg, baseline_path=str(p))
+        assert any(f.key.startswith("baseline-stale:") for f in fs)
+
+
+# ---------------------------------------------------------------------------
+# config / docs parity + the runner
+# ---------------------------------------------------------------------------
+
+
+class TestConfigDocLint:
+    def test_repo_is_clean(self):
+        assert config_doc.check() == []
+
+    def test_doc_is_current(self):
+        with open(config_doc.doc_path()) as f:
+            assert f.read() == config_doc.generate(), \
+                "docs/CONFIG.md stale — run " \
+                "`python -m distlr_tpu.analysis --write-docs`"
+
+    def test_cli_reaches_new_fields(self):
+        """The drift this lint fixed on day one must stay fixed: the
+        fields that had silently lost (or never had) flags."""
+        dests = config_doc.launch_dests()
+        for field in ("random_seed", "ps_timeout_ms", "prefetch"):
+            assert field in dests, field
+
+
+class TestRunner:
+    def test_all_passes_clean_on_repo(self, capsys):
+        assert lint_main([]) == 0
+        assert "clean" in capsys.readouterr().out
+
+    def test_single_pass_selection(self, capsys):
+        assert lint_main(["--pass", "wire"]) == 0
+        out = capsys.readouterr().out
+        assert "wire" in out and "concurrency" not in out
+
+
+# ---------------------------------------------------------------------------
+# regression tests for the two fixed concurrency findings
+# ---------------------------------------------------------------------------
+
+
+class _ProbeLock:
+    """Context-manager lock stand-in recording acquisitions."""
+
+    def __init__(self):
+        self.acquired = 0
+
+    def __enter__(self):
+        self.acquired += 1
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+class TestConcurrencyFixes:
+    def test_membership_epoch_reads_under_lock(self):
+        """`unlocked-read:...MembershipCoordinator._epoch:epoch` — the
+        published epoch view must take the coordinator lock (resize
+        commits it from another thread)."""
+        from distlr_tpu.ps.membership import MembershipCoordinator
+
+        coord = MembershipCoordinator.__new__(MembershipCoordinator)
+        coord._lock = _ProbeLock()
+        coord._epoch = 7
+        assert coord.epoch == 7
+        assert coord._lock.acquired == 1
+
+    def test_chaos_stop_reaps_storming_connections(self):
+        """`unlocked-read:...ChaosLink._threads:stop` — stop() used to
+        snapshot conns/threads BEFORE joining the accept loop (and read
+        _threads without the lock), so a connection accepted
+        concurrently with stop() could leak pump threads and sockets
+        past stop().  Post-fix invariant: after stop() returns under a
+        connect storm, the accept thread and every pump thread are
+        dead."""
+        from distlr_tpu.chaos.plan import FaultPlan
+        from distlr_tpu.chaos.proxy import ChaosFabric
+
+        # upstream: accept-and-hold echo-nothing server
+        upstream = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        upstream.bind(("127.0.0.1", 0))
+        upstream.listen(64)
+        upstream.settimeout(0.1)
+        up_conns: list[socket.socket] = []
+        up_stop = threading.Event()
+
+        def up_loop():
+            while not up_stop.is_set():
+                try:
+                    c, _ = upstream.accept()
+                    up_conns.append(c)
+                except socket.timeout:
+                    continue
+                except OSError:
+                    return
+
+        up_thread = threading.Thread(target=up_loop, daemon=True)
+        up_thread.start()
+        port = upstream.getsockname()[1]
+
+        try:
+            for _round in range(3):
+                fabric = ChaosFabric([("127.0.0.1", port)],
+                                     FaultPlan(faults=[]))
+                link = fabric.links[0]
+                storm_stop = threading.Event()
+
+                def storm():
+                    while not storm_stop.is_set():
+                        try:
+                            with socket.create_connection(
+                                    ("127.0.0.1", link.port),
+                                    timeout=0.5) as s:
+                                s.sendall(b"x" * 8)
+                        except OSError:
+                            return
+
+                stormers = [threading.Thread(target=storm, daemon=True)
+                            for _ in range(4)]
+                for t in stormers:
+                    t.start()
+                time.sleep(0.05)  # let connections churn
+                fabric.stop()
+                # the fixed invariant: nothing survives stop()
+                assert not link._accept_thread.is_alive()
+                assert not any(t.is_alive() for t in link._threads), \
+                    "pump thread leaked past stop()"
+                storm_stop.set()
+                for t in stormers:
+                    t.join(timeout=5)
+        finally:
+            up_stop.set()
+            try:
+                upstream.close()
+            except OSError:
+                pass
+            up_thread.join(timeout=5)
+            for c in up_conns:
+                try:
+                    c.close()
+                except OSError:
+                    pass
+
+
+# ---------------------------------------------------------------------------
+# the Makefile entry point
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.skipif(shutil.which("make") is None, reason="no make")
+def test_make_lint_target_exists():
+    with open(os.path.join(REPO, "Makefile")) as f:
+        text = f.read()
+    assert "lint:" in text and "distlr_tpu.analysis" in text
