@@ -1,14 +1,20 @@
 # Top-level developer entry points.
 #
 #   make lint             # distlr-lint: wire parity, concurrency,
-#                         # config/docs parity, metrics doc (jax-free)
+#                         # config/docs parity, metrics doc, protocol
+#                         # model checking (jax-free)
 #   make lint-docs        # regenerate docs/CONFIG.md + docs/METRICS.md
+#   make verify-protocol  # KV-protocol model checking to closure:
+#                         # exhaustive interleaving search + mutant
+#                         # rediscovery (counterexample schedules
+#                         # printed) + fixture trace conformance
 #   make sanitizers       # build the native TSan/ASan/UBSan matrix
 #   make sanitizer-smoke  # fast TSan-client + TSan-server e2e
 #                         # (delegates to benchmarks/Makefile)
 #
-# The lint passes are tier-1-enforced through tests/test_analysis.py;
-# this target is the same runner for hands/CI hooks.  See
+# The lint passes are tier-1-enforced through tests/test_analysis.py
+# (the protocol pass through tests/test_protocol_model.py); these
+# targets are the same runners for hands/CI hooks.  See
 # docs/ANALYSIS.md for pass semantics and the suppression policy.
 
 PY ?= python
@@ -19,10 +25,17 @@ lint:
 lint-docs:
 	$(PY) -m distlr_tpu.analysis --write-docs
 
+verify-protocol:
+	$(PY) -m distlr_tpu.analysis.protocol
+
+verify-protocol-full:
+	$(PY) -m distlr_tpu.analysis.protocol --full
+
 sanitizers:
 	$(MAKE) -C distlr_tpu/ps/native sanitizers
 
 sanitizer-smoke:
 	$(MAKE) -C benchmarks sanitizer-smoke
 
-.PHONY: lint lint-docs sanitizers sanitizer-smoke
+.PHONY: lint lint-docs verify-protocol verify-protocol-full sanitizers \
+	sanitizer-smoke

@@ -1,6 +1,6 @@
 """distlr-lint — the repo's jax-free static-analysis subsystem.
 
-One runner (``python -m distlr_tpu.analysis``, ``make lint``), four
+One runner (``python -m distlr_tpu.analysis``, ``make lint``), five
 passes, each tier-1-enforced the way the PR-8 metrics-doc lint made
 metric drift impossible:
 
@@ -25,6 +25,12 @@ metric drift impossible:
 * **metrics doc** — the PR-8 :mod:`distlr_tpu.obs.metrics_doc` drift
   lint, folded under this runner so ``make lint`` is the single entry
   point (``tests/test_metrics_doc.py`` stays as the tier-1 shim).
+* **protocol model checking** (:mod:`distlr_tpu.analysis.protocol`) —
+  the SEMANTIC pass: an executable small-step spec of the KV state
+  machine, exhaustive interleaving search with invariant checks,
+  mutant rediscovery of the named historical bugs, and trace
+  conformance of real runs' journals.  Full-depth entry point:
+  ``make verify-protocol``.
 
 The native half of the same story is the sanitizer matrix
 (``make -C distlr_tpu/ps/native sanitizers``, ``DISTLR_NATIVE_VARIANT``

@@ -1,10 +1,11 @@
 """distlr-lint runner: ``python -m distlr_tpu.analysis`` / ``make lint``.
 
-Runs every pass (wire parity, concurrency, config/CLI/docs parity, and
-the folded-in metrics-doc lint), prints findings as
-``[pass] key: message (file:line ...)``, and exits non-zero when any
-survive the audited baselines — the single static-analysis entry point
-tier-1 enforces through ``tests/test_analysis.py``.
+Runs every pass (wire parity, concurrency, config/CLI/docs parity, the
+folded-in metrics-doc lint, and the protocol model-checking pass),
+prints findings as ``[pass] key: message (file:line ...)``, and exits
+non-zero when any survive the audited baselines — the single
+static-analysis entry point tier-1 enforces through
+``tests/test_analysis.py``.
 
     python -m distlr_tpu.analysis                # all passes
     python -m distlr_tpu.analysis --pass wire    # one pass
@@ -20,7 +21,7 @@ import sys
 
 from distlr_tpu.analysis.report import Finding
 
-PASSES = ("wire", "concurrency", "config", "metrics")
+PASSES = ("wire", "concurrency", "config", "metrics", "protocol")
 
 
 def run_pass(name: str) -> list[Finding]:
@@ -33,6 +34,13 @@ def run_pass(name: str) -> list[Finding]:
     if name == "config":
         from distlr_tpu.analysis import config_doc
         return config_doc.check()
+    if name == "protocol":
+        # ISSUE 14: bounded exhaustive search of the KV state machine,
+        # mutant rediscovery, and fixture trace conformance — the
+        # semantic pass next to the four syntactic ones (full-depth:
+        # `make verify-protocol`)
+        from distlr_tpu.analysis.protocol import lint
+        return lint.check()
     if name == "metrics":
         # the PR-8 lint, folded under this runner (its module keeps its
         # own __main__ for the doc generator; tests/test_metrics_doc.py
@@ -54,7 +62,8 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m distlr_tpu.analysis",
         description="distlr-lint: wire parity, concurrency, "
-                    "config/docs parity, metrics doc")
+                    "config/docs parity, metrics doc, protocol model "
+                    "checking")
     ap.add_argument("--pass", dest="passes", action="append",
                     choices=PASSES,
                     help="run only this pass (repeatable; default all)")

@@ -85,13 +85,20 @@ STATS_V1_ORDER = ("dim", "initialized", "pending_sync_pushes",
                   "barrier_waiters", "total_pushes", "total_pulls")
 
 #: Python files that mirror wire framing (repo-relative) — the raw-
-#: literal scan targets.  wire.py itself is the definition site.
+#: literal scan targets.  wire.py itself is the definition site.  The
+#: protocol MODEL (analysis/protocol/, ISSUE 14) is a framing site like
+#: any other: its op/flag/capability identities must come from wire.py,
+#: so the executable spec can never drift from the header it verifies.
 MIRROR_SITES = (
     "distlr_tpu/ps/client.py",
     "distlr_tpu/ps/membership.py",
     "distlr_tpu/ps/server.py",
     "distlr_tpu/compress/codecs.py",
     "distlr_tpu/chaos/proxy.py",
+    "distlr_tpu/analysis/protocol/spec.py",
+    "distlr_tpu/analysis/protocol/checker.py",
+    "distlr_tpu/analysis/protocol/mutants.py",
+    "distlr_tpu/analysis/protocol/conformance.py",
 )
 
 #: distinctive protocol values that must never appear as bare literals

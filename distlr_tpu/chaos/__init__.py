@@ -17,4 +17,9 @@ from distlr_tpu.chaos.plan import (  # noqa: F401
     load_plan,
     parse_plan,
 )
-from distlr_tpu.chaos.proxy import ChaosFabric, ChaosLink  # noqa: F401
+from distlr_tpu.chaos.proxy import (  # noqa: F401
+    EVENT_SCHEMA,
+    ChaosFabric,
+    ChaosLink,
+    load_events_doc,
+)

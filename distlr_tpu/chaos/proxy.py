@@ -112,6 +112,37 @@ _TICK_S = 0.1
 #: event-log cap — a runaway plan must not grow memory unboundedly
 _MAX_EVENTS = 100_000
 
+#: canonical event-log SCHEMA version (the ``launch chaos
+#: --events-path`` file format).  Pinned so replay tooling — the
+#: protocol conformance pass (distlr_tpu/analysis/protocol/
+#: conformance.py mirrors this as CHAOS_SCHEMA; cross-pinned by test)
+#: — can refuse an unrecognized log instead of silently misparsing it.
+#: Schema 1 document shape:
+#:   {"schema": 1, "seed": <plan seed>, "truncated": <bool>,
+#:    "events": [[link, kind, {detail}], ...]}
+#: with detail fields per kind documented in docs/ANALYSIS.md.
+EVENT_SCHEMA = 1
+
+
+def load_events_doc(path: str) -> dict:
+    """Read a canonical event log back, REJECTING unknown schemas
+    loudly: a replayer guessing at an old or future format would
+    vacuously 'conform'.  Raises :class:`ValueError` on a headerless
+    (pre-pinning) or mismatched-schema file."""
+    import json  # noqa: PLC0415 — only replay tooling pays for it
+
+    with open(path) as f:
+        doc = json.load(f)
+    if not isinstance(doc, dict) or "schema" not in doc:
+        raise ValueError(
+            f"{path}: chaos event log has no schema header (pre-pinning "
+            f"format?) — this reader speaks schema {EVENT_SCHEMA} only")
+    if doc["schema"] != EVENT_SCHEMA:
+        raise ValueError(
+            f"{path}: chaos event log schema {doc['schema']!r} != the "
+            f"pinned {EVENT_SCHEMA} — refusing to misparse")
+    return doc
+
 
 def _unit(seed: int, *parts) -> float:
     """Deterministic uniform draw in [0, 1) from a hash of the
@@ -693,6 +724,20 @@ class ChaosFabric:
         arrival order)."""
         with self._events_lock:
             return sorted(self._events)
+
+    def events_doc(self) -> dict:
+        """The canonical event log as a schema-pinned document (what
+        ``launch chaos --events-path`` writes; ``load_events_doc`` is
+        the matching reader)."""
+        with self._events_lock:
+            events = sorted(self._events)
+            truncated = self.events_truncated
+        return {
+            "schema": EVENT_SCHEMA,
+            "seed": self.plan.seed,
+            "truncated": truncated,
+            "events": [list(e[:2]) + [dict(e[2:])] for e in events],
+        }
 
     def stop(self) -> None:
         for lk in self.links:

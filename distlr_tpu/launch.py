@@ -1033,13 +1033,16 @@ def cmd_chaos(args: argparse.Namespace) -> int:
     except KeyboardInterrupt:
         return 130
     finally:
-        events = fabric.events()
-        log.info("chaos: %d fault events injected", len(events))
+        doc = fabric.events_doc()
+        log.info("chaos: %d fault events injected", len(doc["events"]))
         if args.events_path:
+            # schema-pinned canonical log (chaos.proxy.EVENT_SCHEMA):
+            # replay tooling — the protocol conformance pass — rejects
+            # headerless/unknown-schema files instead of misparsing
             with open(args.events_path, "w") as f:
-                json.dump([list(e[:2]) + [dict(e[2:])] for e in events], f,
-                          indent=1)
-            log.info("chaos event log -> %s", args.events_path)
+                json.dump(doc, f, indent=1)
+            log.info("chaos event log -> %s (schema %d)",
+                     args.events_path, doc["schema"])
     return 0
 
 

@@ -776,6 +776,15 @@ class KVWorker:
         rank applied nothing, but a peer whose epoch flipped a moment
         later may have applied its slice — re-issuing would
         double-apply it.
+
+        PROTOCOL ASSERTION (checked, not just prose): this ladder is
+        modeled step for step in
+        :mod:`distlr_tpu.analysis.protocol.spec` (the delivery-proof
+        rule, the absorb-never-reissue rule, the reroute layer), and
+        ``make verify-protocol`` exhaustively searches the
+        interleavings — reverting the absorption rule is the
+        ``reissue-straddling-push`` mutant, rediscovered as a
+        double-apply counterexample in tier-1.
         """
         if not idempotent and self._sync_group:
             return fn()  # BSP pushes: fail fast, no retry, no re-route
@@ -1442,15 +1451,55 @@ def namespace_layout(models, per_model_dim: int) -> dict[str, tuple[int, int]]:
     or one server, always are).  Entries may carry a per-namespace
     optimizer suffix (``"v1:ftrl,v2:sgd"`` — see
     :func:`parse_namespace_optimizers`); the layout strips it, so
-    clients can repeat the server's spec verbatim."""
-    if isinstance(models, str):
-        models = [m.strip().partition(":")[0].strip()
-                  for m in models.split(",") if m.strip()]
+    clients can repeat the server's spec verbatim.
+
+    The layout is EQUAL-WIDTH ONLY.  A spec that asks for per-model
+    dims (``"v1=8192,v2=1024"`` or a ``{model: dim}`` mapping) is
+    rejected loudly instead of silently hashing every model into the
+    same width: heterogeneous widths need a packed layout (per-model
+    bases derived from a cumulative-sum table, plus range boundaries
+    re-aligned per namespace) — the ROADMAP's packed-``namespace_
+    layout`` follow-on.  Equal explicit dims are accepted as a
+    self-documenting spelling of the uniform case."""
+    explicit_dims: dict[str, int] = {}
+    if isinstance(models, dict):
+        explicit_dims = {str(m): int(d) for m, d in models.items()}
+        models = list(models)
+    elif isinstance(models, str):
+        parsed = []
+        for part in models.split(","):
+            part = part.strip()
+            if not part:
+                continue
+            mid, eq, dim = part.partition("=")
+            mid = mid.partition(":")[0].strip()
+            parsed.append(mid)
+            if eq:
+                try:
+                    explicit_dims[mid] = int(dim)
+                except ValueError:
+                    raise ValueError(
+                        f"bad namespace dim in {part!r} "
+                        "(want <model>=<int>)") from None
+        models = parsed
     models = list(models)
     if not models:
         raise ValueError("namespace layout needs at least one model id")
     if len(set(models)) != len(models):
         raise ValueError(f"duplicate model ids in {models}")
+    if explicit_dims:
+        widths = sorted(set(explicit_dims.values()))
+        if len(widths) > 1 or (per_model_dim and
+                               widths != [int(per_model_dim)]):
+            raise ValueError(
+                "heterogeneous-dim namespaces are not supported by the "
+                f"equal-width layout (asked for {explicit_dims}, "
+                f"uniform width {per_model_dim}): per-model widths need "
+                "the packed namespace_layout follow-on (cumulative-sum "
+                "bases + per-namespace range alignment) tracked in "
+                "ROADMAP.md 'Carried minor debts' — until then give "
+                "every model the same dim")
+        per_model_dim = widths[0]
     if per_model_dim <= 0:
         raise ValueError(
             f"per_model_dim must be positive, got {per_model_dim}")

@@ -42,6 +42,15 @@ the endpoint into the ``route=`` provider a
 Deliberately jax-free (like the router, obs-agg, and the chaos proxy):
 the scheduler is control-plane and must keep working while the data
 plane is on fire.
+
+PROTOCOL ASSERTION (checked, not just prose): the
+spawn -> fence -> drain -> commit -> activate staging, the
+fence-before-drain ordering, and the straddling-push absorption are
+modeled in :mod:`distlr_tpu.analysis.protocol.spec` and exhaustively
+interleaved by ``make verify-protocol`` — including the FTRL z/n
+multiset-preservation invariant (I5) across a live reshard, and the
+live-resize conformance witness that replays a REAL resize run's
+journals through the model in tier-1.
 """
 
 from __future__ import annotations

@@ -17,6 +17,12 @@ or raw re-inlined literal in a mirror site.
 Deliberately import-free (stdlib ``struct`` only): the chaos proxy and
 the membership coordinator are control-plane and must stay jax-free and
 cheap to import.
+
+These constants are also what the executable protocol SPEC
+(:mod:`distlr_tpu.analysis.protocol.spec`) is written against: the
+model checker's op/flag/capability identities — and therefore every
+invariant it proves — resolve through this module, so a drifted
+constant fails wire parity before it can mis-model the protocol.
 """
 
 from __future__ import annotations

@@ -35,15 +35,21 @@ REPO = repo_root()
 # ---------------------------------------------------------------------------
 
 
-def _wire_fixture(tmp_path, mutate_header=None, mutate_client=None):
+def _wire_fixture(tmp_path, mutate_header=None, mutate_client=None,
+                  mutate_spec=None):
     """A minimal tree the wire pass can run against: the real header +
-    mirrors, with optional seeded mutations."""
+    mirrors (the protocol model included — it is a framing site like
+    any other), with optional seeded mutations."""
     for rel in ("distlr_tpu/ps/native", "distlr_tpu/compress"):
         os.makedirs(tmp_path / rel, exist_ok=True)
     for rel in ("distlr_tpu/ps/wire.py", "distlr_tpu/ps/client.py",
                 "distlr_tpu/ps/membership.py", "distlr_tpu/ps/server.py",
                 "distlr_tpu/compress/codecs.py",
-                "distlr_tpu/chaos/proxy.py"):
+                "distlr_tpu/chaos/proxy.py",
+                "distlr_tpu/analysis/protocol/spec.py",
+                "distlr_tpu/analysis/protocol/checker.py",
+                "distlr_tpu/analysis/protocol/mutants.py",
+                "distlr_tpu/analysis/protocol/conformance.py"):
         os.makedirs((tmp_path / rel).parent, exist_ok=True)
         shutil.copy(os.path.join(REPO, rel), tmp_path / rel)
     hdr = open(os.path.join(
@@ -54,6 +60,9 @@ def _wire_fixture(tmp_path, mutate_header=None, mutate_client=None):
     if mutate_client:
         cpath = tmp_path / "distlr_tpu/ps/client.py"
         cpath.write_text(mutate_client(cpath.read_text()))
+    if mutate_spec:
+        spath = tmp_path / "distlr_tpu/analysis/protocol/spec.py"
+        spath.write_text(mutate_spec(spath.read_text()))
     return str(tmp_path)
 
 
@@ -103,6 +112,23 @@ class TestWireParity:
             mutate_client=lambda s: s.replace('    "epoch",\n', ""))
         keys = {f.key for f in wire_parity.check(root=root)}
         assert "stats-fields-length" in keys
+
+    def test_protocol_model_is_a_framing_site(self, tmp_path):
+        """ISSUE 14 satellite: a protocol literal re-inlined inside
+        analysis/protocol/ fails the existing raw-literal lint like
+        any other mirror module."""
+        src = open(os.path.join(
+            REPO, "distlr_tpu/analysis/protocol/spec.py")).read()
+        assert "wire.MAGIC" in src  # the mutation below stays honest
+        root = _wire_fixture(
+            tmp_path,
+            mutate_spec=lambda s: s.replace(
+                "wire.HEADER_STRUCT.pack(wire.MAGIC,",
+                "wire.HEADER_STRUCT.pack(0xD157C0DE,"))
+        keys = {f.key for f in wire_parity.check(root=root)}
+        assert any(
+            k.startswith("raw-literal:distlr_tpu/analysis/protocol/"
+                         "spec.py:kMagic") for k in keys), keys
 
 
 # ---------------------------------------------------------------------------
