@@ -8,6 +8,13 @@
 #                         # exhaustive interleaving search + mutant
 #                         # rediscovery (counterexample schedules
 #                         # printed) + fixture trace conformance
+#   make verify-sched     # schedcheck: the REAL fleet classes under
+#                         # controlled interleavings — fast-tier DFS
+#                         # + fuzz per scenario + both historical-race
+#                         # mutants rediscovered as replayable
+#                         # schedules
+#   make verify-sched-full# deep tier (higher preemption bound / run
+#                         # budgets; the pytest `slow` twin)
 #   make sanitizers       # build the native TSan/ASan/UBSan matrix
 #   make sanitizer-smoke  # fast TSan-client + TSan-server e2e
 #                         # (delegates to benchmarks/Makefile)
@@ -31,11 +38,19 @@ verify-protocol:
 verify-protocol-full:
 	$(PY) -m distlr_tpu.analysis.protocol --full
 
+verify-sched:
+	$(PY) -m distlr_tpu.analysis.schedcheck
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_schedcheck.py \
+	  -m 'not slow' -q -p no:cacheprovider
+
+verify-sched-full:
+	$(PY) -m distlr_tpu.analysis.schedcheck --full --fuzz 200
+
 sanitizers:
 	$(MAKE) -C distlr_tpu/ps/native sanitizers
 
 sanitizer-smoke:
 	$(MAKE) -C benchmarks sanitizer-smoke
 
-.PHONY: lint lint-docs verify-protocol verify-protocol-full sanitizers \
-	sanitizer-smoke
+.PHONY: lint lint-docs verify-protocol verify-protocol-full \
+	verify-sched verify-sched-full sanitizers sanitizer-smoke

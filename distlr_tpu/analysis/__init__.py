@@ -1,8 +1,9 @@
 """distlr-lint — the repo's jax-free static-analysis subsystem.
 
-One runner (``python -m distlr_tpu.analysis``, ``make lint``), five
-passes, each tier-1-enforced the way the PR-8 metrics-doc lint made
-metric drift impossible:
+One runner (``python -m distlr_tpu.analysis``, ``make lint``;
+``--only <pass>`` runs one in isolation, ``--list-passes`` lists
+them), six passes, each tier-1-enforced the way the PR-8 metrics-doc
+lint made metric drift impossible:
 
 * **wire parity** (:mod:`distlr_tpu.analysis.wire_parity`) — parse
   ``ps/native/kv_protocol.h`` (op codes, flag bits, capability bits,
@@ -31,6 +32,15 @@ metric drift impossible:
   mutant rediscovery of the named historical bugs, and trace
   conformance of real runs' journals.  Full-depth entry point:
   ``make verify-protocol``.
+* **schedcheck** (:mod:`distlr_tpu.analysis.schedcheck`) — the
+  IMPLEMENTATION pass: the real fleet classes (batcher, joiner,
+  spool, router, reloader, membership coordinator, shadow mirror,
+  chaos link) execute under a cooperative deterministic scheduler via
+  the :mod:`distlr_tpu.sync` facade — preemption-bounded exhaustive
+  DFS + seeded fuzzing per scenario, deadlock detection with wait-for
+  cycles, and mutant rediscovery of the PR-6 joiner and PR-13
+  ChaosLink teardown races as replayable ≤ 20-step schedules.
+  Full-depth entry point: ``make verify-sched-full``.
 
 The native half of the same story is the sanitizer matrix
 (``make -C distlr_tpu/ps/native sanitizers``, ``DISTLR_NATIVE_VARIANT``

@@ -1,14 +1,15 @@
 """distlr-lint runner: ``python -m distlr_tpu.analysis`` / ``make lint``.
 
 Runs every pass (wire parity, concurrency, config/CLI/docs parity, the
-folded-in metrics-doc lint, and the protocol model-checking pass),
-prints findings as ``[pass] key: message (file:line ...)``, and exits
-non-zero when any survive the audited baselines — the single
-static-analysis entry point tier-1 enforces through
-``tests/test_analysis.py``.
+folded-in metrics-doc lint, the protocol model-checking pass, and the
+schedcheck interleaving pass), prints findings as
+``[pass] key: message (file:line ...)``, and exits non-zero when any
+survive the audited baselines — the single static-analysis entry point
+tier-1 enforces through ``tests/test_analysis.py``.
 
     python -m distlr_tpu.analysis                # all passes
-    python -m distlr_tpu.analysis --pass wire    # one pass
+    python -m distlr_tpu.analysis --only wire    # one pass in isolation
+    python -m distlr_tpu.analysis --list-passes  # what exists
     python -m distlr_tpu.analysis --write-docs   # regenerate
                                                  # docs/CONFIG.md +
                                                  # docs/METRICS.md
@@ -21,7 +22,24 @@ import sys
 
 from distlr_tpu.analysis.report import Finding
 
-PASSES = ("wire", "concurrency", "config", "metrics", "protocol")
+PASSES = ("wire", "concurrency", "config", "metrics", "protocol", "sched")
+
+#: one-line summaries for --list-passes (kept here, not in the pass
+#: modules, so listing passes never imports them)
+PASS_SUMMARIES = {
+    "wire": "kv_protocol.h <-> ps/wire.py mirror parity "
+            "(analysis/wire_parity.py)",
+    "concurrency": "shared-state registry + lock-order cycles + "
+                   "audited baseline (analysis/concurrency.py)",
+    "config": "Config <-> launch CLI <-> docs/CONFIG.md parity "
+              "(analysis/config_doc.py)",
+    "metrics": "metric-series <-> docs/METRICS.md drift "
+               "(obs/metrics_doc.py)",
+    "protocol": "KV state-machine model checking + mutants + trace "
+                "conformance (analysis/protocol/)",
+    "sched": "deterministic-interleaving execution of the real fleet "
+             "classes + mutants (analysis/schedcheck/)",
+}
 
 
 def run_pass(name: str) -> list[Finding]:
@@ -40,6 +58,12 @@ def run_pass(name: str) -> list[Finding]:
         # semantic pass next to the four syntactic ones (full-depth:
         # `make verify-protocol`)
         from distlr_tpu.analysis.protocol import lint
+        return lint.check()
+    if name == "sched":
+        # ISSUE 15: the real Python classes under controlled
+        # interleavings — scenario DFS/fuzz + the two historical-race
+        # mutants (full-depth: `make verify-sched-full`)
+        from distlr_tpu.analysis.schedcheck import lint
         return lint.check()
     if name == "metrics":
         # the PR-8 lint, folded under this runner (its module keeps its
@@ -63,14 +87,26 @@ def main(argv=None) -> int:
         prog="python -m distlr_tpu.analysis",
         description="distlr-lint: wire parity, concurrency, "
                     "config/docs parity, metrics doc, protocol model "
-                    "checking")
+                    "checking, schedcheck interleavings")
     ap.add_argument("--pass", dest="passes", action="append",
                     choices=PASSES,
                     help="run only this pass (repeatable; default all)")
+    ap.add_argument("--only", dest="passes", action="append",
+                    choices=PASSES, metavar="PASS",
+                    help="alias of --pass: run one pass in isolation "
+                    "(the now-six-pass runner takes a while end to "
+                    "end; see --list-passes)")
+    ap.add_argument("--list-passes", action="store_true",
+                    help="list the passes with one-line summaries, "
+                    "then exit")
     ap.add_argument("--write-docs", action="store_true",
                     help="regenerate docs/CONFIG.md and docs/METRICS.md "
                     "from the sources, then exit")
     args = ap.parse_args(argv)
+    if args.list_passes:
+        for name in PASSES:
+            print(f"{name}: {PASS_SUMMARIES[name]}")
+        return 0
     if args.write_docs:
         from distlr_tpu.analysis import config_doc
         from distlr_tpu.obs import metrics_doc

@@ -11,15 +11,20 @@ was written for.
 The file is parsed by the tiny TOML-subset reader below (this
 container's Python predates ``tomllib`` and nothing may be pip
 installed): ``[[suppress]]`` table arrays of ``key = "..."`` /
-``justification = "..."`` string pairs, comments, and blank lines —
-which is the entire grammar the baseline needs.  A trailing ``*`` in a
-key glob-matches, so one entry can cover every method of one attribute.
+``justification = "..."`` / ``schedcheck_scenario = "..."`` string
+triples (all three REQUIRED since ISSUE 15 — the scenario names the
+:mod:`distlr_tpu.analysis.schedcheck` scenario exercising the race, or
+``"-"`` for classes schedcheck cannot run), comments, and blank lines
+— which is the entire grammar the baseline needs.  A trailing ``*`` in
+a key glob-matches, so one entry can cover every method of one
+attribute.
 """
 
 from __future__ import annotations
 
 import dataclasses
 import os
+import re
 
 from distlr_tpu.analysis.report import Finding, rel, repo_root
 
@@ -29,6 +34,12 @@ class Entry:
     key: str
     justification: str
     line: int
+    #: the ISSUE-15 cross-reference: the schedcheck scenario that
+    #: exercises this intentional race under controlled interleavings,
+    #: or ``"-"`` for classes schedcheck cannot run (jax-holding,
+    #: process-spawning) — an explicit, audited statement either way
+    scenario: str | None = None
+    scenario_line: int = 0
 
     def matches(self, finding_key: str) -> bool:
         if self.key.endswith("*"):
@@ -69,6 +80,7 @@ def load_baseline(path: str | None = None
             return
         key = cur.get("key")
         just = cur.get("justification")
+        scen = cur.get("schedcheck_scenario")
         if key is None:
             problems.append(Finding(
                 "concurrency", f"baseline-no-key:{at_line}",
@@ -79,8 +91,22 @@ def load_baseline(path: str | None = None
                 f"baseline entry {key[0]!r} carries no justification — "
                 "every suppression must say WHY the race is intentional",
                 ((prel, key[1]),)))
+        elif scen is None or not scen[0].strip():
+            # ISSUE 15: every intentional race names the schedcheck
+            # scenario that exercises it (or "-" with the class's
+            # reason schedcheck cannot run it) — suppressions must be
+            # tied to the machinery that would catch them going wrong
+            problems.append(Finding(
+                "concurrency", f"baseline-no-scenario:{key[0]}",
+                f"baseline entry {key[0]!r} names no "
+                "schedcheck_scenario — point it at the scenario that "
+                "exercises this class under controlled interleavings, "
+                "or '-' if the class cannot run under schedcheck "
+                "(say why in the justification)",
+                ((prel, key[1]),)))
         else:
-            entries.append(Entry(key[0], just[0], key[1]))
+            entries.append(Entry(key[0], just[0], key[1],
+                                 scenario=scen[0], scenario_line=scen[1]))
         cur = None
 
     i = 0
@@ -140,3 +166,49 @@ def apply_baseline(findings: list[Finding], entries: list[Entry]
         for idx, e in enumerate(entries) if idx not in used
     ]
     return kept, stale
+
+
+_CLASS_RE = re.compile(r"^[a-z-]+:(?P<mod>[\w/.]+\.py):(?P<cls>\w+)")
+
+
+def _entry_class(key: str) -> str | None:
+    """``unlocked-read:path/mod.py:Class.attr[:method]`` ->
+    ``path/mod.py:Class`` (None for keys not in that shape)."""
+    m = _CLASS_RE.match(key)
+    return f"{m.group('mod')}:{m.group('cls')}" if m else None
+
+
+def scenario_crossref(entries: list[Entry]) -> list[Finding]:
+    """Validate each entry's ``schedcheck_scenario`` against the live
+    scenario registry — the PR-13 staleness rule applied to the
+    ISSUE-15 cross-reference.  ``"-"`` is the audited opt-out; a named
+    scenario must exist AND declare the entry's class among the
+    classes it exercises (a renamed/deleted scenario, or one that
+    stopped covering the class, fails loudly instead of silently
+    un-verifying the race)."""
+    from distlr_tpu.analysis.schedcheck import scenarios as sched_scenarios
+
+    prel = rel(default_path())
+    out: list[Finding] = []
+    for e in entries:
+        if e.scenario is None or e.scenario == "-":
+            continue
+        s = sched_scenarios.SCENARIOS.get(e.scenario)
+        if s is None:
+            out.append(Finding(
+                "concurrency", f"baseline-stale-scenario:{e.key}",
+                f"baseline entry {e.key!r} names schedcheck scenario "
+                f"{e.scenario!r}, which does not exist (have: "
+                f"{', '.join(sched_scenarios.names())}) — the "
+                "cross-reference went stale",
+                ((prel, e.scenario_line or e.line),)))
+            continue
+        cls = _entry_class(e.key)
+        if cls is not None and cls not in s.classes:
+            out.append(Finding(
+                "concurrency", f"baseline-scenario-mismatch:{e.key}",
+                f"baseline entry {e.key!r} names scenario "
+                f"{e.scenario!r}, but that scenario does not exercise "
+                f"{cls} (it covers: {', '.join(s.classes)})",
+                ((prel, e.scenario_line or e.line),)))
+    return out

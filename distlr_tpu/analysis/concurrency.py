@@ -418,7 +418,11 @@ def check(pkg_dir: str | None = None,
     """Run both concurrency passes, apply the audited baseline, and
     return the unsuppressed findings plus any baseline hygiene problems
     (missing justification, stale entry)."""
-    from distlr_tpu.analysis.baseline import apply_baseline, load_baseline
+    from distlr_tpu.analysis.baseline import (
+        apply_baseline,
+        load_baseline,
+        scenario_crossref,
+    )
 
     classes = collect_classes(pkg_dir)
     findings = shared_state_findings(classes) + lock_order_findings(classes)
@@ -426,4 +430,4 @@ def check(pkg_dir: str | None = None,
     kept, stale = apply_baseline(findings, entries)
     for f in stale:
         kept.append(f)
-    return kept + problems
+    return kept + problems + scenario_crossref(entries)

@@ -1,0 +1,37 @@
+"""schedcheck — deterministic-interleaving execution of the real
+Python fleet (distlr-lint pass 6).
+
+PR 13's concurrency lint finds lock-discipline smells *syntactically*
+and PR 14 model-checks the protocol *spec*; this package verifies the
+*implementation*: the real ``MicroBatcher``/``LabelJoiner``/
+``FeedbackSpool``/``ScoringRouter``/``HotReloader``/
+``MembershipCoordinator``/``ShadowMirror``/``ChaosLink`` classes run
+single-stream under a cooperative scheduler
+(:mod:`~distlr_tpu.analysis.schedcheck.runtime`), with every
+interleaving choice made by an explorer
+(:mod:`~distlr_tpu.analysis.schedcheck.explore`) instead of the OS:
+
+* bounded-exhaustive DFS with CHESS-style preemption bounding;
+* seeded random-schedule fuzzing, every run replayable by id;
+* a deadlock detector printing the minimal wait-for cycle;
+* per-scenario invariants
+  (:mod:`~distlr_tpu.analysis.schedcheck.scenarios`), cross-checked
+  against the concurrency lint's shared-state registry;
+* mutant mode (:mod:`~distlr_tpu.analysis.schedcheck.mutants`):
+  reverting the PR-6 joiner check-then-insert fix and the PR-13
+  ``ChaosLink.stop()`` snapshot fix must each be REDISCOVERED as a
+  ≤ 20-step replayable counterexample schedule.
+
+Production code opts in by creating its primitives through the
+:mod:`distlr_tpu.sync` facade (zero-overhead stdlib passthrough in
+normal runs).  Entry points: ``python -m
+distlr_tpu.analysis.schedcheck`` / ``make verify-sched`` (fast) /
+``make verify-sched-full`` (deep DFS), and pass 6 of ``python -m
+distlr_tpu.analysis``.  Everything is jax-free.
+"""
+
+from distlr_tpu.analysis.schedcheck.runtime import (  # noqa: F401
+    InvariantViolation,
+    RunResult,
+    parse_schedule_id,
+)

@@ -42,9 +42,7 @@ from __future__ import annotations
 import hashlib
 import socket
 import struct
-import threading
-import time
-
+from distlr_tpu import sync
 from distlr_tpu.chaos.plan import FaultPlan, FaultSpec
 from distlr_tpu.compress import codecs
 from distlr_tpu.obs import dtrace
@@ -183,7 +181,7 @@ class ChaosLink:
         self._throttle_faults = plan.for_link(link, "throttle")
         self._reset_faults = plan.for_link(link, "reset")
         self._partition_faults = plan.for_link(link, "partition")
-        self._lock = threading.Lock()
+        self._lock = sync.Lock()
         # cumulative per-LINK traffic state (across reconnects), so
         # after_ops/after_bytes offsets mean "the Nth op/byte on this
         # link", not "on this connection"
@@ -192,18 +190,28 @@ class ChaosLink:
         self._fired: set[int] = set()      # one-shot reset fault indices
         self._announced: set[tuple] = set()  # (fault, window) activations
         self._conns: list[tuple[socket.socket, socket.socket]] = []
-        self._threads: list[threading.Thread] = []
-        self._stop = threading.Event()
-        self._lsock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
-        self._lsock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        self._lsock.bind(("127.0.0.1", 0))
-        self._lsock.listen(64)
-        self._lsock.settimeout(_TICK_S)
+        self._threads: list[sync.Thread] = []
+        self._stop = sync.Event()
+        self._lsock = self._listen()
         self.port = self._lsock.getsockname()[1]
-        self._accept_thread = threading.Thread(
+        self._accept_thread = sync.Thread(
             target=self._accept_loop, daemon=True,
             name=f"chaos-accept-{link}")
         self._accept_thread.start()
+
+    # -- endpoint seams (schedcheck substitutes scripted twins here so
+    # the accept/stop teardown runs under a controlled interleaving —
+    # everything that RACES stays this class's real code) --------------
+    def _listen(self) -> socket.socket:
+        s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        s.bind(("127.0.0.1", 0))
+        s.listen(64)
+        s.settimeout(_TICK_S)
+        return s
+
+    def _connect_upstream(self) -> socket.socket:
+        return socket.create_connection(self.upstream, timeout=5.0)
 
     # -- fault predicates -------------------------------------------------
     def _now(self) -> float:
@@ -251,18 +259,18 @@ class ChaosLink:
                 down.close()
                 continue
             try:
-                up = socket.create_connection(self.upstream, timeout=5.0)
+                up = self._connect_upstream()
             except OSError:
                 down.close()
                 continue
             for s in (down, up):
                 s.settimeout(_TICK_S)
                 s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-            severed = threading.Event()
-            t1 = threading.Thread(target=self._pump_c2s,
+            severed = sync.Event()
+            t1 = sync.Thread(target=self._pump_c2s,
                                   args=(down, up, severed), daemon=True,
                                   name=f"chaos-c2s-{self.link}")
-            t2 = threading.Thread(target=self._pump_s2c,
+            t2 = sync.Thread(target=self._pump_s2c,
                                   args=(down, up, severed), daemon=True,
                                   name=f"chaos-s2c-{self.link}")
             with self._lock:
@@ -277,7 +285,7 @@ class ChaosLink:
             t2.start()
 
     def _read_exact(self, sock: socket.socket, n: int,
-                    severed: threading.Event) -> bytes | None:
+                    severed: sync.Event) -> bytes | None:
         buf = b""
         while len(buf) < n:
             if self._stop.is_set() or severed.is_set():
@@ -293,33 +301,33 @@ class ChaosLink:
             buf += chunk
         return buf
 
-    def _stall_while_partitioned(self, severed: threading.Event) -> None:
+    def _stall_while_partitioned(self, severed: sync.Event) -> None:
         while not (self._stop.is_set() or severed.is_set()):
             part = self._partition_active()
             if part is None:
                 return
             self._announce(part, "partition")
-            time.sleep(min(_TICK_S, 0.02))
+            sync.sleep(min(_TICK_S, 0.02))
 
-    def _throttle(self, nbytes: int, severed: threading.Event) -> None:
+    def _throttle(self, nbytes: int, severed: sync.Event) -> None:
         t = self._now()
         for f in self._throttle_faults:
             if f.active_at(t):
                 self._announce(f, "throttle")
                 pause = nbytes / f.bytes_per_sec
-                end = time.monotonic() + pause
-                while (time.monotonic() < end
+                end = sync.monotonic() + pause
+                while (sync.monotonic() < end
                        and not (self._stop.is_set() or severed.is_set())):
                     # re-read the clock for the sleep arg: the deadline
                     # can pass between the while-check and here, and a
                     # negative sleep raises, killing the pump thread
                     # (observed as a spurious severed link under a
                     # high-rate throttle)
-                    time.sleep(min(_TICK_S, max(0.0, end - time.monotonic())))
+                    sync.sleep(min(_TICK_S, max(0.0, end - sync.monotonic())))
                 return
 
     def _sever(self, down: socket.socket, up: socket.socket,
-               severed: threading.Event, *, hard: bool) -> None:
+               severed: sync.Event, *, hard: bool) -> None:
         severed.set()
         if hard:
             # RST both ways: queued bytes are DISCARDED (the mid-frame
@@ -337,7 +345,7 @@ class ChaosLink:
                 pass
 
     def _read_line_frame(self, sock: socket.socket,
-                         severed: threading.Event,
+                         severed: sync.Event,
                          buf: bytearray) -> bytes | None:
         """One serve-protocol frame: a newline-terminated request line
         (newline included — byte offsets stay exact).  ``buf`` holds
@@ -376,7 +384,7 @@ class ChaosLink:
             return None
 
     def _pump_c2s(self, down: socket.socket, up: socket.socket,
-                  severed: threading.Event) -> None:
+                  severed: sync.Event) -> None:
         """Framed client->server pump — all op-offset faults live here."""
         link = str(self.link)
         linebuf = bytearray()  # serve-protocol cross-read remainder
@@ -481,11 +489,15 @@ class ChaosLink:
                     _DELAY_MS.labels(link=link).inc(ms)
                     # sliced like the stall/throttle waits: a multi-second
                     # delay must not outlive stop()'s thread joins
-                    end = time.monotonic() + ms / 1000.0
-                    while (time.monotonic() < end
+                    end = sync.monotonic() + ms / 1000.0
+                    while (sync.monotonic() < end
                            and not (self._stop.is_set()
                                     or severed.is_set())):
-                        time.sleep(min(_TICK_S, end - time.monotonic()))
+                        # same clamp as the throttle loop: the deadline
+                        # can pass between the while-check and here, and
+                        # a negative sleep raises, killing the pump
+                        sync.sleep(min(_TICK_S,
+                                       max(0.0, end - sync.monotonic())))
 
                 # reset at byte offset: forward only up to the offset,
                 # then hard-kill mid-frame (frame NOT delivered)
@@ -539,7 +551,7 @@ class ChaosLink:
                     pass
 
     def _relay_raw(self, down: socket.socket, up: socket.socket,
-                   severed: threading.Event) -> None:
+                   severed: sync.Event) -> None:
         while not (self._stop.is_set() or severed.is_set()):
             try:
                 chunk = down.recv(1 << 16)
@@ -555,7 +567,7 @@ class ChaosLink:
                 return
 
     def _pump_s2c(self, down: socket.socket, up: socket.socket,
-                  severed: threading.Event) -> None:
+                  severed: sync.Event) -> None:
         """Raw server->client relay: responses are delayed only by
         stalls/throttle, never reframed.
 
@@ -662,13 +674,13 @@ class ChaosFabric:
                 f"fault[{bad[0]}].links names a link >= the fabric's "
                 f"{len(pairs)} upstream(s)")
         self._events: list[tuple] = []
-        self._events_lock = threading.Lock()
+        self._events_lock = sync.Lock()
         #: the log hit _MAX_EVENTS and dropped events: past the cap the
         #: surviving set depends on thread arrival order, so the
         #: determinism contract no longer holds — comparisons must check
         #: this flag instead of silently diffing a truncated log
         self.events_truncated = False
-        self.started_at = time.monotonic()
+        self.started_at = sync.monotonic()
         self.links = [ChaosLink(i, up, plan, self, protocol=protocol)
                       for i, up in enumerate(pairs)]
 
@@ -694,7 +706,7 @@ class ChaosFabric:
         return lk
 
     def now(self) -> float:
-        return time.monotonic() - self.started_at
+        return sync.monotonic() - self.started_at
 
     def record(self, link: int, kind: str, **detail) -> None:
         # wall-clock twin for the merged timeline: when this process is

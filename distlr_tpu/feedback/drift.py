@@ -25,7 +25,7 @@ boundaries are request-count-driven, so tests replay exact traffic.
 
 from __future__ import annotations
 
-import threading
+from distlr_tpu import sync
 
 import numpy as np
 
@@ -65,7 +65,7 @@ class ScoreDriftDetector:
         self.bins = int(bins)
         self.threshold = float(threshold)
         self.smoothing = float(smoothing)
-        self._lock = threading.Lock()
+        self._lock = sync.Lock()
         self._cur = np.zeros(self.bins, np.int64)
         self._cur_n = 0
         self._ref: np.ndarray | None = None

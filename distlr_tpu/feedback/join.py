@@ -32,9 +32,8 @@ import json
 import os
 import random
 import re
-import threading
-import time
 
+from distlr_tpu import sync
 from distlr_tpu.obs import dtrace
 from distlr_tpu.obs.registry import get_registry
 from distlr_tpu.feedback.spool import FeedbackSpool, SpoolRecord, drop
@@ -97,7 +96,7 @@ class LabelJoiner:
         self.max_pending_labels = int(max_pending_labels)
         self._recent_cap = int(recent_joined)
         self._rng = random.Random(seed)
-        self._lock = threading.Lock()
+        self._lock = sync.Lock()
         #: labels that arrived before their request: rid -> (label, ts)
         self._pending: dict[str, tuple[int, float]] = {}
         #: recently joined rids (bounded, insertion-ordered) — the
@@ -155,7 +154,7 @@ class LabelJoiner:
     def label(self, rid: str, y: int, *, ts: float | None = None) -> str:
         """A label event arrived.  Returns the outcome: ``"joined"``,
         ``"pending"`` (request not seen yet), or ``"duplicate"``."""
-        now = time.time() if ts is None else ts
+        now = sync.wall() if ts is None else ts
         y = int(y)
         with self._lock:
             rec = self.spool.pop(rid)
@@ -259,7 +258,7 @@ class LabelJoiner:
         """Resolve everything older than the window: never-labeled
         requests go through the negative-sampling policy; held labels
         whose request never arrived are dropped as unmatched."""
-        now = time.time() if now is None else now
+        now = sync.wall() if now is None else now
         cutoff = now - self.window_s
         with self._lock:
             expired = self.spool.expire_before(cutoff)

@@ -39,10 +39,10 @@ from __future__ import annotations
 
 import json
 import os
-import threading
-import time
 
 import numpy as np
+
+from distlr_tpu import sync
 
 from distlr_tpu.config import Config
 from distlr_tpu.obs import dtrace
@@ -325,7 +325,7 @@ class OnlineTrainer:
             names = os.listdir(self.shard_dir)
         except OSError:
             return
-        now = time.time()
+        now = sync.wall()
         for nm in names:
             if not nm.endswith(".libsvm.claim"):
                 continue
@@ -387,7 +387,7 @@ class OnlineTrainer:
         cfg = self.cfg
         B = cfg.batch_size if cfg.batch_size > 0 else 256
         n = 0
-        t0_wall, t0 = time.time(), time.monotonic()
+        t0_wall, t0 = sync.wall(), sync.monotonic()
         with dtrace.use(traces[0] if traces else None), dtrace.span(
                 "online.consume",
                 tags={"shard": shard, "records": len(lines),
@@ -415,7 +415,7 @@ class OnlineTrainer:
                     if self._accum.ready:
                         self._flush_push()
                     n += len(y[lo:lo + B])
-        dur = time.monotonic() - t0
+        dur = sync.monotonic() - t0
         for ctx in traces[1:]:
             # the other traces coalesced into this shard each get the
             # same interval attributed (ring + journal), so "where did
@@ -427,15 +427,15 @@ class OnlineTrainer:
         return n
 
     # -- the loop ----------------------------------------------------------
-    def run(self, *, stop: threading.Event | None = None,
+    def run(self, *, stop: sync.Event | None = None,
             max_shards: int = 0, idle_exit_s: float | None = None) -> dict:
         """Consume shards until ``stop`` is set, ``max_shards`` shards
         were trained (0 = unbounded), or nothing new appeared for
         ``idle_exit_s`` seconds (None = wait forever) — the latter two
         are the scriptable exits benches and tests use; production runs
         pass neither and live as long as the serving tier."""
-        stop = stop or threading.Event()
-        idle_since = time.monotonic()
+        stop = stop or sync.Event()
+        idle_since = sync.monotonic()
         consumed_this_run = 0
         while not stop.is_set():
             # every cycle, not just idle ones: under sustained traffic
@@ -445,7 +445,7 @@ class OnlineTrainer:
             pending = self._scan()
             _LAG.set(len(pending))
             if not pending:
-                now = time.monotonic()
+                now = sync.monotonic()
                 if (self._accum.batches
                         and now - idle_since >= self.idle_flush_s):
                     # traffic lull: a partial accumulation span must not
@@ -487,7 +487,7 @@ class OnlineTrainer:
                     log.warning("online[%d]: claim on %s expired while "
                                 "consuming (raise claim_stale_s?)",
                                 self.worker_id, os.path.basename(path))
-                idle_since = time.monotonic()
+                idle_since = sync.monotonic()
                 consumed_this_run += 1
                 log.info("online[%d]: consumed %s (%d examples, k=%d, "
                          "%d pushes)", self.worker_id,

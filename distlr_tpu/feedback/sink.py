@@ -20,9 +20,7 @@ in a forever-partial buffer.
 from __future__ import annotations
 
 import itertools
-import threading
-import time
-
+from distlr_tpu import sync
 from distlr_tpu.feedback.drift import ScoreDriftDetector
 from distlr_tpu.feedback.join import LabelJoiner
 from distlr_tpu.feedback.spool import (
@@ -61,9 +59,9 @@ class FeedbackSink:
         self.idle_flush_s = float(idle_flush_s)
         self._auto_ids = itertools.count()
         self._last_emit_seen = 0
-        self._last_emit_at = time.monotonic()
-        self._stop = threading.Event()
-        self._thread: threading.Thread | None = None
+        self._last_emit_at = sync.monotonic()
+        self._stop = sync.Event()
+        self._thread: sync.Thread | None = None
 
     # -- serve-side entry points ------------------------------------------
     def scored(self, lines: list[str], rows: tuple, scores, *,
@@ -87,7 +85,7 @@ class FeedbackSink:
         (multi-tenant serving) — joined examples emit into the model's
         own shard subdir so online training stays per-tenant; None =
         the pre-tenant flat shard layout."""
-        now = time.time()
+        now = sync.wall()
         keys = per_row_keys(self.model, rows)
         ctx = (dtrace.TraceContext(trace[0], trace[1], True)
                if trace is not None else None)
@@ -118,7 +116,7 @@ class FeedbackSink:
     def tick(self, now: float | None = None) -> None:
         self.joiner.tick(now)
         emitted = self.joiner.joined + self.joiner.negatives
-        mono = time.monotonic()
+        mono = sync.monotonic()
         if emitted != self._last_emit_seen:
             self._last_emit_seen = emitted
             self._last_emit_at = mono
@@ -132,7 +130,7 @@ class FeedbackSink:
     def start(self) -> "FeedbackSink":
         if self._thread is None or not self._thread.is_alive():
             self._stop.clear()
-            self._thread = threading.Thread(
+            self._thread = sync.Thread(
                 target=self._run, daemon=True, name="distlr-feedback-tick")
             self._thread.start()
         return self

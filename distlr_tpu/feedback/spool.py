@@ -28,11 +28,10 @@ import dataclasses
 import json
 import os
 import re
-import threading
-import time
 
 import numpy as np
 
+from distlr_tpu import sync
 from distlr_tpu.obs.registry import get_registry
 
 _reg = get_registry()
@@ -105,7 +104,7 @@ class FeedbackSpool:
         self.segment_records = int(segment_records)
         self.max_segments = int(max_segments)
         self.evict_scan = max(int(evict_scan), 1)
-        self._lock = threading.Lock()
+        self._lock = sync.Lock()
         #: insertion-ordered (dict preserves it): front = oldest
         self._records: dict[str, SpoolRecord] = {}
         # resume the journal AFTER any segment a previous run left
@@ -180,7 +179,7 @@ class FeedbackSpool:
         not journaled, so replayed records carry ``keys=None`` (they
         evict first under pressure — the honest default).  Returns the
         number of records restored."""
-        now = time.time() if now is None else now
+        now = sync.wall() if now is None else now
         cutoff = now - float(window_s)
         segs = sorted(
             int(m.group(1)) for name in os.listdir(self.directory)
@@ -365,4 +364,4 @@ def strip_label(line: str) -> str:
 
 
 def now_ts() -> float:
-    return time.time()
+    return sync.wall()

@@ -58,11 +58,10 @@ from __future__ import annotations
 import json
 import socket
 import socketserver
-import threading
-import time
 
 import numpy as np
 
+from distlr_tpu import sync
 from distlr_tpu.obs import dtrace
 from distlr_tpu.obs.registry import get_registry
 from distlr_tpu.ps import wire
@@ -138,7 +137,7 @@ class MembershipCoordinator:
         self.supervisor = supervisor
         self.drain_timeout_ms = int(drain_timeout_ms)
         self.chunk_rows = int(chunk_rows)
-        self._lock = threading.Lock()
+        self._lock = sync.Lock()
         self._status = "active"
         self._epoch = int(group.epoch)
         #: (monotonic time, event, detail) audit trail, newest last
@@ -189,7 +188,7 @@ class MembershipCoordinator:
             }
 
     def _record(self, event: str, **detail) -> None:
-        self.events.append((time.monotonic(), event, detail))
+        self.events.append((sync.monotonic(), event, detail))
         log.info("membership: %s %s", event, detail or "")
 
     # -- drain plumbing ----------------------------------------------------
@@ -322,7 +321,7 @@ class MembershipCoordinator:
         direction = ("grow" if new_num_servers > self.group.num_servers
                      else "shrink")
         new_epoch = old_epoch + 1
-        t0 = time.monotonic()
+        t0 = sync.monotonic()
         self._record("resize_start", direction=direction,
                      old=self.group.num_servers, new=new_num_servers,
                      epoch=new_epoch, moves=len(plan.moves),
@@ -359,7 +358,7 @@ class MembershipCoordinator:
             self.last_resize = {"ok": False, "error": str(e),
                                 "direction": direction}
             raise MembershipError(f"resize failed (rolled back): {e}") from e
-        wall = time.monotonic() - t0
+        wall = sync.monotonic() - t0
         with self._lock:
             self._epoch = new_epoch
             self._status = "active"
@@ -424,7 +423,7 @@ class MembershipServer:
                                   bind_and_activate=True)
         self._tcp.membership = self  # type: ignore[attr-defined]
         self.host, self.port = self._tcp.server_address[:2]
-        self._thread = threading.Thread(target=self._tcp.serve_forever,
+        self._thread = sync.Thread(target=self._tcp.serve_forever,
                                         daemon=True, name="distlr-ps-ctl")
         self._started = False
 

@@ -35,8 +35,8 @@ instant every interval.
 from __future__ import annotations
 
 import random
-import threading
-import time
+
+from distlr_tpu import sync
 
 import numpy as np
 
@@ -369,11 +369,11 @@ class HotReloader:
         self.last_version = None
         self._degraded_since: float | None = None
         self._last_warn = float("-inf")
-        self._stop = threading.Event()
+        self._stop = sync.Event()
         # serializes source.poll(): wait_for_weights (caller thread) can
         # overlap the background loop, and sources keep per-poll state
-        self._poll_lock = threading.Lock()
-        self._thread = threading.Thread(
+        self._poll_lock = sync.Lock()
+        self._thread = sync.Thread(
             target=self._run, daemon=True, name="distlr-hot-reload"
         )
 
@@ -389,7 +389,7 @@ class HotReloader:
                 got = self.source.poll()
             except Exception as e:
                 self.errors += 1
-                now = time.monotonic()
+                now = sync.monotonic()
                 if self._degraded_since is None:
                     self._degraded_since = now
                 # one warning per degraded poll cycle, rate-limited: a
@@ -413,7 +413,7 @@ class HotReloader:
                     # clock running and keep warning, rate-limited, or
                     # the log would read "recovered" while the engine
                     # serves stale last-good weights indefinitely
-                    now = time.monotonic()
+                    now = sync.monotonic()
                     if now - self._last_warn >= self.warn_every_s:
                         self._last_warn = now
                         log.warning(
@@ -428,7 +428,7 @@ class HotReloader:
             if self._degraded_since is not None:
                 log.info("weight source recovered after %.0fs degraded "
                          "(%d errors total)",
-                         time.monotonic() - self._degraded_since, self.errors)
+                         sync.monotonic() - self._degraded_since, self.errors)
                 self._degraded_since = None
                 self._last_warn = float("-inf")
             version, weights = got
@@ -449,11 +449,11 @@ class HotReloader:
         """Block until the engine has weights (first successful poll) —
         the serve front-end's startup gate when no initial weights were
         given."""
-        deadline = time.monotonic() + timeout_s
+        deadline = sync.monotonic() + timeout_s
         while not self.engine.has_weights:
             if self._poll_once():
                 return
-            if time.monotonic() >= deadline:
+            if sync.monotonic() >= deadline:
                 # Name WHY (satellite of ISSUE 5): "PS unreachable" and
                 # "PS reachable but uninitialized" both used to read as
                 # the same 30 s silence — the operator's next move is
@@ -469,7 +469,7 @@ class HotReloader:
                     f"no weights from {type(self.source).__name__} within "
                     f"{timeout_s:.0f}s{detail}"
                 )
-            time.sleep(min(self.interval_s, 0.2))
+            sync.sleep(min(self.interval_s, 0.2))
 
     def stats(self) -> dict:
         rec = {

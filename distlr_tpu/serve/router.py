@@ -61,9 +61,7 @@ import json
 import random
 import socket
 import socketserver
-import threading
-import time
-
+from distlr_tpu import sync
 from distlr_tpu.obs import dtrace
 from distlr_tpu.obs.registry import get_registry
 from distlr_tpu.serve import tenant as _tenant
@@ -147,8 +145,8 @@ class _Replica:
         #: that model as its default engine and gets bare lines — so
         #: pre-tenant replicas interop byte-identically
         self.models: set[str] = set()
-        self._sem = threading.BoundedSemaphore(max_inflight)
-        self._pool_lock = threading.Lock()
+        self._sem = sync.BoundedSemaphore(max_inflight)
+        self._pool_lock = sync.Lock()
         self._idle: list[tuple] = []
         self.healthy = True
         self.consecutive_errors = 0
@@ -369,9 +367,9 @@ class ScoringRouter:
         self.backend_timeout_s = float(backend_timeout_s)
         self.probe_timeout_s = min(float(backend_timeout_s), 2.0)
         self._retries = int(retries)
-        self._lock = threading.Lock()   # health state + rotation counter
+        self._lock = sync.Lock()   # health state + rotation counter
         self._rr = 0
-        self._t0 = time.monotonic()
+        self._t0 = sync.monotonic()
         self._tcp = _TCPServer((host, port), _RouterHandler,
                                bind_and_activate=True)
         self._tcp.router = self  # type: ignore[attr-defined]
@@ -389,12 +387,12 @@ class ScoringRouter:
         self._err_base = self._errors_c.value
         self._shed_base = self._shed_c.value
         self._retry_base = self._retries_c.value
-        self._stop = threading.Event()
+        self._stop = sync.Event()
         self._started = False
-        self._accept_thread = threading.Thread(
+        self._accept_thread = sync.Thread(
             target=self._tcp.serve_forever, daemon=True,
             name="distlr-route-accept")
-        self._health_thread = threading.Thread(
+        self._health_thread = sync.Thread(
             target=self._health_loop, daemon=True, name="distlr-route-health")
 
     # -- replica selection / health ---------------------------------------
@@ -426,7 +424,7 @@ class ScoringRouter:
         with self._lock:
             rep.requests += 1
             rep.consecutive_errors = 0
-            rep.last_ok = time.monotonic()
+            rep.last_ok = sync.monotonic()
 
     def _note_failure(self, rep: _Replica) -> None:
         with self._lock:
@@ -439,7 +437,7 @@ class ScoringRouter:
         rep.healthy = False
         rep.ejections += 1
         rep.backoff_s = self.probe_backoff_s
-        rep.next_probe_at = time.monotonic() + rep.backoff_s
+        rep.next_probe_at = sync.monotonic() + rep.backoff_s
         rep._up_g.set(0.0)
         _EJECTIONS.labels(replica=rep.addr).inc()
         log.warning("replica %s ejected after %d consecutive failures; "
@@ -472,7 +470,7 @@ class ScoringRouter:
         except OSError:
             ok = False
         with self._lock:
-            rep.last_probe = time.monotonic()
+            rep.last_probe = sync.monotonic()
             if ok:
                 rep.consecutive_errors = 0
                 rep.last_ok = rep.last_probe
@@ -498,7 +496,7 @@ class ScoringRouter:
     def _health_loop(self) -> None:
         tick = max(0.01, min(self.health_interval_s, 0.25))
         while not self._stop.wait(tick):
-            now = time.monotonic()
+            now = sync.monotonic()
             # snapshot: ADDREPLICA/DELREPLICA mutate the list mid-run
             for rep in list(self.replicas):
                 with self._lock:
@@ -856,7 +854,7 @@ class ScoringRouter:
         # several models need it — a pre-tenant single-engine replica
         # keeps parsing every byte it always parsed
         tok = dtrace.token()
-        t0 = time.monotonic()
+        t0 = sync.monotonic()
         excluded: list[_Replica] = []
         last_err = "no healthy replica in rotation"
         shed_only = True  # every failure so far was overload, not death
@@ -911,7 +909,7 @@ class ScoringRouter:
                 excluded.append(rep)
                 continue
             self._note_success(rep)
-            self._req_seconds.observe(time.monotonic() - t0)
+            self._req_seconds.observe(sync.monotonic() - t0)
             self._requests_c.inc()
             _tenant.count_request(tenant)
             with self._lock:
@@ -940,7 +938,7 @@ class ScoringRouter:
         per-replica state list — one parser covers both tiers."""
         n_req = int(self._requests_c.value - self._req_base)
         n_err = int(self._errors_c.value - self._err_base)
-        elapsed = max(time.monotonic() - self._t0, 1e-9)
+        elapsed = max(sync.monotonic() - self._t0, 1e-9)
         with self._lock:
             reps = [{
                 "addr": r.addr,

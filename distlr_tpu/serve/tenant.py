@@ -29,11 +29,10 @@ STATS and TRACE before them).
 from __future__ import annotations
 
 import json
-import threading
-import time
 
 import numpy as np
 
+from distlr_tpu import sync
 from distlr_tpu.obs.registry import get_registry
 from distlr_tpu.utils.logging import get_logger
 
@@ -168,14 +167,14 @@ class TenantQuota:
         if self.burst < 1.0:
             raise ValueError(
                 f"quota burst must be >= 1 token, got {self.burst}")
-        self._lock = threading.Lock()
+        self._lock = sync.Lock()
         self._tokens = self.burst
-        self._at = time.monotonic()
+        self._at = sync.monotonic()
         self.admitted = 0
         self.shed = 0
 
     def try_admit(self, n: float = 1.0, now: float | None = None) -> bool:
-        now = time.monotonic() if now is None else now
+        now = sync.monotonic() if now is None else now
         with self._lock:
             # negative elapsed (a caller-supplied clock behind ours)
             # must never DRAIN the bucket
@@ -276,15 +275,15 @@ class ShadowMirror:
         self.block = int(block)
         self.bins = int(bins)
         self._queue: list[tuple[str, str, str, list[float]]] = []
-        self._lock = threading.Lock()
-        self._wake = threading.Event()
-        self._stop = threading.Event()
+        self._lock = sync.Lock()
+        self._wake = sync.Event()
+        self._stop = sync.Event()
         self._pairs: dict[tuple[str, str], _ShadowPair] = {}
         self.submitted = 0
         self.mirrored = 0
         self.dropped = 0
         self.errors = 0
-        self._thread = threading.Thread(
+        self._thread = sync.Thread(
             target=self._run, daemon=True, name="distlr-shadow-mirror")
         self._thread.start()
 
@@ -315,8 +314,21 @@ class ShadowMirror:
                 self._wake.wait(0.05)
                 self._wake.clear()
                 continue
-            for tenant, candidate, line, primary in batch:
+            for i, (tenant, candidate, line, primary) in enumerate(batch):
                 if self._stop.is_set():
+                    # stop() mid-batch: the remaining dequeued mirrors
+                    # are shed, and shed work is COUNTED — the original
+                    # bare return left them accounted nowhere
+                    # (submitted could never reconcile with mirrored +
+                    # errors + dropped + queued again), found by
+                    # schedcheck's first run (analysis/schedcheck,
+                    # schedule pinned in tests/test_schedcheck.py)
+                    with self._lock:
+                        self.dropped += len(batch) - i
+                    for tnt, cand_id, _l, _p in batch[i:]:
+                        _SHADOW_TOTAL.labels(tenant=tnt,
+                                             candidate=cand_id,
+                                             outcome="dropped").inc()
                     return
                 try:
                     reply = self._exchange(candidate, line)
@@ -346,14 +358,14 @@ class ShadowMirror:
     def drain(self, timeout_s: float = 5.0) -> None:
         """Block until every submitted mirror was processed (not just
         dequeued) — tests/benches."""
-        deadline = time.monotonic() + timeout_s
-        while time.monotonic() < deadline:
+        deadline = sync.monotonic() + timeout_s
+        while sync.monotonic() < deadline:
             with self._lock:
                 done = (not self._queue
                         and self.mirrored + self.errors >= self.submitted)
             if done:
                 return
-            time.sleep(0.01)
+            sync.sleep(0.01)
 
     def psi(self, tenant: str, candidate: str) -> float | None:
         with self._lock:

@@ -1,0 +1,113 @@
+"""THE import point for every thread primitive the fleet creates.
+
+``distlr_tpu/sync`` is to concurrency what :mod:`distlr_tpu.ps.wire`
+is to the wire protocol: the one module production code goes through
+instead of hand-reaching for the stdlib, so the instrumented and the
+native builds share a single code path.  In the default PASSTHROUGH
+state every name below *is* the stdlib object (``sync.Lock is
+threading.Lock``) — creating a lock through the facade costs exactly
+one module-attribute lookup, nothing else, and behavior is
+byte-identical to importing :mod:`threading` directly
+(regression-pinned in ``tests/test_schedcheck.py``).
+
+When schedcheck (:mod:`distlr_tpu.analysis.schedcheck`) installs
+itself, the same names resolve to yield-point-instrumented twins and a
+virtual clock, so the REAL production classes — the batcher, the
+joiner, the router, the reloader, the membership coordinator, the
+chaos proxy — run single-stream under a controlled, replayable
+interleaving.  Twins are handed out only to threads the scheduler
+manages; an unrelated background thread calling ``sync.Lock()``
+mid-install still gets a real stdlib lock, so installs are safe in a
+process with live passthrough users.
+
+Checked twin: :mod:`distlr_tpu.analysis.schedcheck.runtime` holds the
+instrumented implementations and asserts (per scenario, via the
+concurrency lint's shared-state registry) that every lock the lint
+knows about on a class under test actually resolved through this
+facade — a module that silently reverts to raw ``threading`` fails
+schedcheck before it can un-instrument its own races.
+
+Deliberately import-light (stdlib only): the serving and control
+planes stay jax-free and cheap to import.
+"""
+
+from __future__ import annotations
+
+import queue as _queue
+import threading as _threading
+import time as _time
+
+# -- passthrough bindings (the production defaults) ---------------------
+# Each name is the stdlib object itself, not a wrapper: passthrough must
+# be zero-overhead and byte-identical.  schedcheck's install() swaps
+# these module attributes for twins and uninstall() restores them.
+
+Lock = _threading.Lock
+RLock = _threading.RLock
+Condition = _threading.Condition
+Event = _threading.Event
+Semaphore = _threading.Semaphore
+BoundedSemaphore = _threading.BoundedSemaphore
+Thread = _threading.Thread
+Queue = _queue.Queue
+
+#: queue exception types are shared between passthrough and twins, so
+#: ``except sync.Empty`` works identically under both builds
+Empty = _queue.Empty
+Full = _queue.Full
+
+#: the clock the adopted modules read where timing feeds a scheduling
+#: decision (wait deadlines, backoff arithmetic, rate limits).  Under
+#: schedcheck these become the VIRTUAL clock — time advances only when
+#: every managed task is blocked, which is what makes timed waits
+#: deterministic instead of schedule noise.
+monotonic = _time.monotonic
+wall = _time.time
+sleep = _time.sleep
+
+#: every swappable name, in one place (install/uninstall + tests)
+SWAPPABLE = (
+    "Lock", "RLock", "Condition", "Event", "Semaphore",
+    "BoundedSemaphore", "Thread", "Queue", "monotonic", "wall", "sleep",
+)
+
+_PASSTHROUGH = {name: globals()[name] for name in SWAPPABLE}
+_installed_by: object | None = None
+
+
+def install(twins: dict, *, owner: object) -> None:
+    """Swap the facade onto instrumented twins (schedcheck only).
+
+    ``twins`` maps names from :data:`SWAPPABLE` to replacement
+    callables; unnamed entries keep their passthrough binding.
+    Refuses to double-install — two schedcheck runtimes in one process
+    would corrupt each other's schedules.
+    """
+    global _installed_by
+    if _installed_by is not None:
+        raise RuntimeError(
+            "distlr_tpu.sync is already instrumented — one schedcheck "
+            "runtime at a time")
+    unknown = sorted(set(twins) - set(SWAPPABLE))
+    if unknown:
+        raise ValueError(f"unknown sync names {unknown}; "
+                         f"swappable: {SWAPPABLE}")
+    for name, fn in twins.items():
+        globals()[name] = fn
+    _installed_by = owner
+
+
+def uninstall(*, owner: object) -> None:
+    """Restore the passthrough bindings (idempotent per owner)."""
+    global _installed_by
+    if _installed_by is None:
+        return
+    if _installed_by is not owner:
+        raise RuntimeError("sync.uninstall by a non-owner")
+    for name, obj in _PASSTHROUGH.items():
+        globals()[name] = obj
+    _installed_by = None
+
+
+def instrumented() -> bool:
+    return _installed_by is not None

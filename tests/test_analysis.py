@@ -241,7 +241,8 @@ class TestConcurrencyLint:
         pkg = _pkg(tmp_path, "class Empty:\n    pass\n")
         p = tmp_path / "b.toml"
         p.write_text('[[suppress]]\nkey = "unlocked-write:gone"\n'
-                     'justification = "was real once"\n')
+                     'justification = "was real once"\n'
+                     'schedcheck_scenario = "-"\n')
         fs = concurrency.check(pkg_dir=pkg, baseline_path=str(p))
         assert any(f.key.startswith("baseline-stale:") for f in fs)
 
