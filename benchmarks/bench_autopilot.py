@@ -1,0 +1,238 @@
+"""Autopilot benchmark: what closed-loop scaling saves vs peak sizing.
+
+Runs the SAME diurnal load cycle (``loadgen.py``'s raised-cosine
+offered-QPS curve) twice against a real router + engine replicas:
+
+* **static-peak** — every replica in rotation for the whole cycle,
+  the capacity a peak-sized fleet burns around the clock;
+* **autopilot** — one replica in rotation, the rest parked as a
+  standby pool, and a live :class:`~distlr_tpu.autopilot.daemon.
+  AutopilotDaemon` (real policy, real router-admin actuator, signals
+  derived from the router's own STATS wire) promoting/demoting
+  capacity as the cycle breathes.
+
+The row's headline is **replica-seconds saved %**: the integral of
+in-rotation replica count over the cycle, autopilot vs static.  The
+bar the row enforces is that the savings are not bought with failures
+— ``err == 0`` on both runs (sheds are explicit admission control,
+not failures) and the autopilot actually acted.
+
+Prints ONE JSON line in ``bench.py``'s format.  CPU-friendly (tiny
+model, jax only inside the engines).
+
+Run: ``python benchmarks/bench_autopilot.py [--quick|--smoke]``
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+sys.path.insert(0, REPO)
+
+from loadgen import run_load  # noqa: E402
+
+
+def _resilience() -> dict:
+    from bench import resilience_snapshot  # noqa: PLC0415
+
+    return resilience_snapshot()
+
+
+class _RankSeconds:
+    """Integrate in-rotation replica count over wall time."""
+
+    def __init__(self, count0: int):
+        self.t0 = time.monotonic()
+        self.last_t = self.t0
+        self.count = count0
+        self.total = 0.0
+
+    def sample(self, count: int | None) -> None:
+        now = time.monotonic()
+        self.total += self.count * (now - self.last_t)
+        self.last_t = now
+        if count is not None:
+            self.count = count
+
+    def finish(self) -> float:
+        self.sample(None)
+        return round(self.total, 2)
+
+
+def _stats_fetcher(admin):
+    """Reduce the router's STATS wire to a one-row fleet doc — the
+    daemon's windowed shed/req rates and the cumulative p99 come out
+    exactly as they would from obs-agg's /fleet.json."""
+
+    def fetch() -> dict:
+        st = json.loads(admin.send("STATS"))
+        return {"ranks": [{
+            "role": "route", "rank": 0,
+            "route_requests": st["requests"],
+            "route_shed": st["shed"],
+            "route_p99_ms": st["p99_ms"],
+        }]}
+
+    return fetch
+
+
+def _build_tier(d: int, replicas: int, max_inflight: int):
+    import numpy as np  # noqa: PLC0415
+
+    from distlr_tpu.config import Config  # noqa: PLC0415
+    from distlr_tpu.serve import (  # noqa: PLC0415
+        ScoringEngine,
+        ScoringRouter,
+        ScoringServer,
+    )
+
+    cfg = Config(num_feature_dim=d, model="sparse_lr", l2_c=0.0)
+    w = np.random.default_rng(5).standard_normal(d).astype(np.float32)
+    servers = []
+    for _ in range(replicas):
+        eng = ScoringEngine(cfg)
+        eng.set_weights(w)
+        # a generous microbatch wait gives each request a predictable
+        # ~20ms floor, so the diurnal peak actually saturates the
+        # max_inflight=1 admission budget and sheds — the signal the
+        # engine band scales on (a bare CPU engine answers in ~4ms and
+        # the cycle would never breach anything)
+        servers.append(ScoringServer(eng, max_wait_ms=20.0).start())
+    addrs = [f"{s.host}:{s.port}" for s in servers]
+    router = ScoringRouter([addrs[0]], max_inflight=max_inflight).start()
+    return servers, addrs, router
+
+
+def bench_cycle(d: int, replicas: int, *, base_qps: float, peak_qps: float,
+                period_s: float, max_inflight: int, seed: int) -> dict:
+    from distlr_tpu.autopilot import (  # noqa: PLC0415
+        Actuators,
+        AutopilotDaemon,
+        EngineActuator,
+        PolicyConfig,
+        PolicyEngine,
+    )
+    from distlr_tpu.serve.rollout import RouterAdmin  # noqa: PLC0415
+    from distlr_tpu.serve.server import score_lines_over_tcp  # noqa: PLC0415
+
+    servers, addrs, router = _build_tier(d, replicas, max_inflight)
+    try:
+        # warm every engine's jit outside the measured cycles
+        warm = json.dumps({"rows": ["1:1 2:1"]})
+        for s in servers:
+            score_lines_over_tcp(s.host, s.port, [warm])
+        router_addr = f"{router.host}:{router.port}"
+        admin = RouterAdmin(router.host, router.port)
+        actuator = EngineActuator(router_addr, addrs)
+
+        # ---- static-peak leg: all replicas in rotation all cycle ----
+        for a in addrs[1:]:
+            admin.expect_ok(f"ADDREPLICA default {a}")
+        rs = _RankSeconds(replicas)
+        static_load = run_load(router_addr, base_qps=base_qps,
+                               peak_qps=peak_qps, period_s=period_s,
+                               dim=d, seed=seed,
+                               on_tick=lambda t, q: rs.sample(None))
+        static_rank_s = rs.finish()
+        for a in addrs[1:]:
+            admin.expect_ok(f"DELREPLICA default {a}")
+
+        # ---- autopilot leg: start at 1, let the controller breathe ----
+        policy = PolicyEngine(PolicyConfig(
+            hysteresis_ticks=2, cooldown_s=period_s / 10.0,
+            rollback_window_s=0.0,  # no alert gate in this harness
+            engine_min=1, engine_max=replicas,
+            shed_rate_high=0.2, req_rate_low=max(1.0, base_qps / 2.0),
+        ))
+        daemon = AutopilotDaemon(
+            policy, Actuators(engine=actuator),
+            fetch=_stats_fetcher(admin),
+            interval_s=max(0.2, period_s / 60.0),
+            rate_window_s=max(1.0, period_s / 10.0))
+        rs = _RankSeconds(actuator.current() or 1)
+        with daemon:
+            ap_load = run_load(
+                router_addr, base_qps=base_qps, peak_qps=peak_qps,
+                period_s=period_s, dim=d, seed=seed,
+                on_tick=lambda t, q: rs.sample(actuator.current()))
+            # tail: give the controller a moment to breathe back down
+            deadline = time.monotonic() + period_s / 4.0
+            while time.monotonic() < deadline and (
+                    actuator.current() or 1) > 1:
+                rs.sample(actuator.current())
+                time.sleep(daemon.interval_s)
+        ap_rank_s = rs.finish()
+        status = daemon.status()
+    finally:
+        router.stop()
+        for s in servers:
+            s.stop()
+
+    saved_pct = (100.0 * (1.0 - ap_rank_s / static_rank_s)
+                 if static_rank_s > 0 else None)
+    return {
+        "static": {"rank_seconds": static_rank_s, **static_load},
+        "autopilot": {"rank_seconds": ap_rank_s, **ap_load},
+        "rank_seconds_saved_pct": (None if saved_pct is None
+                                   else round(saved_pct, 1)),
+        "actions": status["actions"],
+        "errors": status["errors"],
+        "last_rule": status["last_rule"],
+        "slo_held": static_load["err"] == 0 and ap_load["err"] == 0,
+    }
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true",
+                    help="tiny shapes (smoke/test mode)")
+    ap.add_argument("--smoke", action="store_true",
+                    help="alias of --quick (the `make -C benchmarks "
+                    "autopilot-smoke` entry point)")
+    args = ap.parse_args()
+    quick = args.quick or args.smoke
+    d, replicas, base, peak, period = ((64, 2, 5.0, 60.0, 12.0) if quick
+                                       else (256, 3, 10.0, 150.0, 45.0))
+
+    sub = bench_cycle(d, replicas, base_qps=base, peak_qps=peak,
+                      period_s=period, max_inflight=1, seed=11)
+    row = {
+        "metric": (f"fleet autopilot, {replicas} replicas: one diurnal "
+                   f"cycle ({base:g}->{peak:g} qps over {period:g}s) — "
+                   "replica-seconds saved vs static-peak provisioning"),
+        "value": sub["rank_seconds_saved_pct"],
+        "unit": "percent",
+        "D": d,
+        "replicas": replicas,
+        "quick": quick,
+        "autopilot": sub,
+        "resilience": _resilience(),
+    }
+    try:
+        import jax  # noqa: PLC0415
+
+        row["backend"] = jax.default_backend()
+    except Exception:  # noqa: BLE001 — deliberately import-tolerant
+        row["backend"] = "none"
+    print(json.dumps(row))
+    bad = []
+    if not sub["slo_held"]:
+        bad.append("request errors during a cycle (the bar is err == 0)")
+    if not sub["actions"]:
+        bad.append("the autopilot never acted (dead controller)")
+    if sub["rank_seconds_saved_pct"] is not None \
+            and sub["rank_seconds_saved_pct"] <= 0:
+        bad.append("no replica-seconds saved vs static-peak")
+    for b in bad:
+        print(f"[bench_autopilot] WARNING: {b}", file=sys.stderr)
+    return 1 if bad else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -138,6 +138,17 @@ DISTLR_METRICS_SNAPSHOT="benchmarks/capture_logs/fleet/snapshots/elastic-0.json"
   && echo "bench_elastic ok" \
   || echo "bench_elastic failed (non-fatal; artifact not refreshed)"
 
+echo "== bench_autopilot.py (closed-loop scaling vs static-peak; best-effort) =="
+# Fleet-autopilot row (ISSUE 16): one diurnal load cycle against a real
+# router + replica pool, autopilot vs static-peak provisioning —
+# replica-seconds saved % (the headline), actions taken, and the
+# err == 0 SLO bar (sheds are admission control, not failures).
+DISTLR_METRICS_SNAPSHOT="benchmarks/capture_logs/fleet/snapshots/autopilot-0.json" \
+  timeout 900 python -u benchmarks/bench_autopilot.py \
+  > benchmarks/capture_logs/bench_autopilot.json \
+  && echo "bench_autopilot ok" \
+  || echo "bench_autopilot failed (non-fatal; artifact not refreshed)"
+
 echo "== bank the fleet metrics snapshot (merged view; best-effort) =="
 # Federates every snapshot banked into the window's fleet dir (today:
 # bench.py; any --obs-run-dir'd process that joins a future window rides

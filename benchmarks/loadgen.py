@@ -1,0 +1,256 @@
+"""Open-loop diurnal load generator for the serving tier.
+
+Drives a ``launch route`` front-end (or a single engine listener — the
+line protocol is identical) with a request rate that follows one
+diurnal cycle: a raised-cosine ramp from ``base_qps`` up to
+``peak_qps`` and back over ``period_s``.  This is the traffic shape
+the fleet autopilot is tested against (``bench_autopilot.py``, the
+``test_autopilot`` acceptance e2e): a controller that can follow one
+synthetic day can breathe capacity up into the peak and back down the
+far side.
+
+OPEN loop, deliberately: request send times are scheduled from the
+curve alone, never from reply latency, so a saturated tier keeps
+receiving offered load (and sheds it explicitly) instead of the
+generator politely backing off and hiding the overload — the standard
+closed-loop coordinated-omission trap.
+
+Classification per reply line:
+
+* ``OK ...``/scores — **ok** (latency recorded);
+* ``ERR SHED ...`` — **shed**: explicit admission control, the signal
+  the autopilot's engine band consumes.  Sheds are NOT errors;
+* any other ``ERR``, a transport failure, or a dead connection —
+  **err** (the acceptance bar in the e2e is err == 0).
+
+Deterministic for a given seed: payloads are pre-generated with a
+seeded RNG and the schedule is pure arithmetic.  (Reply ordering and
+latency percentiles still reflect the live fleet, of course.)
+
+Library use::
+
+    from loadgen import run_load
+    summary = run_load("127.0.0.1:7000", base_qps=20, peak_qps=120,
+                       period_s=30, dim=1024, seed=7)
+
+CLI: ``python benchmarks/loadgen.py --addr H:P [--base-qps ...]``
+prints the same summary as ONE JSON line (scriptable, like every
+bench in this directory).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import os
+import queue
+import socket
+import sys
+import threading
+import time
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+sys.path.insert(0, REPO)
+
+
+def make_payloads(n: int, dim: int, nnz: int, rows: int, seed: int) -> list[str]:
+    """``n`` distinct request lines (JSON ``{"rows": [...]}``) with
+    seeded sparse feature rows — the engine protocol's 1-based
+    ``col:val`` text format."""
+    import numpy as np  # noqa: PLC0415
+
+    rng = np.random.default_rng(seed)
+    payloads = []
+    for _ in range(n):
+        lines = []
+        for _ in range(rows):
+            cols = np.sort(rng.choice(dim, size=min(nnz, dim), replace=False))
+            lines.append(" ".join(f"{c + 1}:1" for c in cols))
+        payloads.append(json.dumps({"rows": lines}))
+    return payloads
+
+
+def qps_at(t: float, base_qps: float, peak_qps: float, period_s: float) -> float:
+    """The diurnal curve: raised cosine, base at t=0 and t=period, peak
+    at t=period/2."""
+    phase = (t % period_s) / period_s
+    return base_qps + (peak_qps - base_qps) * 0.5 * (1.0 - math.cos(
+        2.0 * math.pi * phase))
+
+
+def schedule(duration_s: float, base_qps: float, peak_qps: float,
+             period_s: float) -> list[float]:
+    """Deterministic send offsets: integrate the curve in small steps
+    and emit a send time each time the cumulative expectation crosses
+    the next integer."""
+    times: list[float] = []
+    dt = 0.001
+    acc = 0.0
+    t = 0.0
+    while t < duration_s:
+        acc += qps_at(t, base_qps, peak_qps, period_s) * dt
+        while acc >= 1.0:
+            acc -= 1.0
+            times.append(t)
+        t += dt
+    return times
+
+
+class _Counters:
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.sent = 0
+        self.ok = 0
+        self.shed = 0
+        self.err = 0
+        self.latencies_ms: list[float] = []
+
+
+def _worker(addr: tuple[str, int], q: "queue.Queue", c: _Counters,
+            timeout_s: float) -> None:
+    """One sender: a persistent connection, re-dialed on failure (the
+    router may churn replicas under us — that is the point)."""
+    f = None
+    sock = None
+    while True:
+        item = q.get()
+        if item is None:
+            break
+        payload = item
+        t0 = time.monotonic()
+        try:
+            if f is None:
+                sock = socket.create_connection(addr, timeout=timeout_s)
+                f = sock.makefile("rwb")
+            f.write((payload + "\n").encode())
+            f.flush()
+            reply = f.readline()
+            if not reply:
+                raise ConnectionError("connection closed")
+        except OSError:
+            if sock is not None:
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+            f = sock = None
+            with c.lock:
+                c.err += 1
+            continue
+        ms = (time.monotonic() - t0) * 1e3
+        text = reply.decode("utf-8", "replace")
+        with c.lock:
+            if text.startswith("ERR SHED"):
+                c.shed += 1
+            elif text.startswith("ERR"):
+                c.err += 1
+            else:
+                c.ok += 1
+                c.latencies_ms.append(ms)
+    if sock is not None:
+        try:
+            sock.close()
+        except OSError:
+            pass
+
+
+def _pct(sorted_vals: list[float], q: float) -> float | None:
+    if not sorted_vals:
+        return None
+    i = min(len(sorted_vals) - 1, int(q * (len(sorted_vals) - 1) + 0.5))
+    return round(sorted_vals[i], 3)
+
+
+def run_load(addr: str, *, base_qps: float = 20.0, peak_qps: float = 100.0,
+             period_s: float = 30.0, duration_s: float | None = None,
+             dim: int = 1024, nnz: int = 16, rows_per_request: int = 1,
+             seed: int = 0, workers: int = 8, payload_pool: int = 64,
+             timeout_s: float = 10.0, on_tick=None) -> dict:
+    """Run one diurnal cycle (or ``duration_s``) of open-loop load
+    against ``addr`` (``host:port``) and return the summary dict.
+    ``on_tick(t, target_qps)`` is called about once a second — hooks
+    for tests/benches that want to sample the fleet mid-ramp."""
+    host, _, port = str(addr).rpartition(":")
+    if not host or not port.isdigit():
+        raise ValueError(f"addr must be host:port, got {addr!r}")
+    duration_s = period_s if duration_s is None else float(duration_s)
+    payloads = make_payloads(payload_pool, dim, nnz, rows_per_request, seed)
+    sends = schedule(duration_s, base_qps, peak_qps, period_s)
+
+    c = _Counters()
+    q: queue.Queue = queue.Queue()
+    pool = [threading.Thread(target=_worker,
+                             args=((host, int(port)), q, c, timeout_s),
+                             daemon=True, name=f"loadgen-{i}")
+            for i in range(workers)]
+    for t in pool:
+        t.start()
+    t0 = time.monotonic()
+    next_tick = 0.0
+    for i, offset in enumerate(sends):
+        now = time.monotonic() - t0
+        if offset > now:
+            time.sleep(offset - now)
+            now = offset
+        if on_tick is not None and now >= next_tick:
+            on_tick(now, qps_at(now, base_qps, peak_qps, period_s))
+            next_tick = now + 1.0
+        q.put(payloads[i % len(payloads)])
+        c.sent += 1  # only the pacer writes sent: no lock needed
+    for _ in pool:
+        q.put(None)
+    for t in pool:
+        t.join()
+    elapsed = time.monotonic() - t0
+    lat = sorted(c.latencies_ms)
+    return {
+        "sent": c.sent,
+        "ok": c.ok,
+        "shed": c.shed,
+        "err": c.err,
+        "p50_ms": _pct(lat, 0.50),
+        "p99_ms": _pct(lat, 0.99),
+        "elapsed_s": round(elapsed, 3),
+        "offered_qps": round(c.sent / elapsed, 2) if elapsed > 0 else None,
+        "base_qps": base_qps,
+        "peak_qps": peak_qps,
+        "period_s": period_s,
+        "seed": seed,
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="open-loop diurnal load over the serve line protocol")
+    ap.add_argument("--addr", required=True,
+                    help="router/engine host:port (what `launch route` "
+                    "announced as ROUTING)")
+    ap.add_argument("--base-qps", dest="base_qps", type=float, default=20.0)
+    ap.add_argument("--peak-qps", dest="peak_qps", type=float, default=100.0)
+    ap.add_argument("--period", dest="period_s", type=float, default=30.0,
+                    help="seconds per diurnal cycle (default 30)")
+    ap.add_argument("--duration", dest="duration_s", type=float,
+                    help="seconds to run (default: one period)")
+    ap.add_argument("--dim", type=int, default=1024,
+                    help="feature dim of the generated rows (default 1024)")
+    ap.add_argument("--nnz", type=int, default=16)
+    ap.add_argument("--rows-per-request", dest="rows_per_request", type=int,
+                    default=1)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--workers", type=int, default=8,
+                    help="sender threads (default 8)")
+    args = ap.parse_args(argv)
+    summary = run_load(args.addr, base_qps=args.base_qps,
+                       peak_qps=args.peak_qps, period_s=args.period_s,
+                       duration_s=args.duration_s, dim=args.dim,
+                       nnz=args.nnz, rows_per_request=args.rows_per_request,
+                       seed=args.seed, workers=args.workers)
+    # ONE JSON line, the directory's scriptable contract
+    print(json.dumps(summary))
+    return 0 if summary["err"] == 0 else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -822,3 +822,97 @@ def scn_chaoslink_stop_accept(rt: Runtime) -> None:
     _check(not unclosed,
            f"socket(s) {unclosed} not closed after stop() — the "
            "snapshot missed a concurrently-registered connection")
+
+
+# ---------------------------------------------------------------------------
+# 12. AutopilotDaemon — tick loop vs stop() vs lock-free status reads
+# ---------------------------------------------------------------------------
+
+
+class _ScriptedFleet:
+    """fetch() seam: fails once (the fail-safe hold path), then serves
+    a fleet doc whose shard_lag keeps the worker band breached."""
+
+    def __init__(self):
+        self.calls = 0
+
+    def __call__(self) -> dict:
+        self.calls += 1
+        if self.calls == 1:
+            raise OSError("aggregator not up yet")
+        return {"ranks": [{"role": "online", "rank": 0, "shard_lag": 10.0,
+                           "pushes": 100.0 * self.calls,
+                           "route_shed": 0.0, "route_requests": 0.0}]}
+
+
+class _ScriptedWorkerActuator:
+    """The worker actuator surface, minus subprocesses."""
+
+    def __init__(self):
+        self.n = 1
+        self.scales: list[int] = []
+        self.stopped = False
+
+    def current(self) -> int:
+        return self.n
+
+    def scale(self, target: int) -> str:
+        self.scales.append(int(target))
+        self.n = int(target)
+        return "ok"
+
+    def stop_all(self) -> None:
+        self.stopped = True
+
+
+@scenario("autopilot_tick_stop",
+          ("distlr_tpu/autopilot/daemon.py:AutopilotDaemon",),
+          dfs_runs=4000, max_steps=6000)
+def scn_autopilot_tick_stop(rt: Runtime) -> None:
+    """The autopilot's tick loop racing concurrent lock-free status()
+    reads and a stop(): the loop survives the seeded fetch failure
+    (fail-safe hold, not a crash), the tick/action counters stay
+    consistent with the last decision, stop() joins the loop and
+    closes the actuators under EVERY interleaving."""
+    from distlr_tpu.autopilot import (
+        Actuators,
+        AutopilotDaemon,
+        PolicyConfig,
+        PolicyEngine,
+    )
+
+    worker = _ScriptedWorkerActuator()
+    policy = PolicyEngine(PolicyConfig(hysteresis_ticks=1, cooldown_s=0.0))
+    d = AutopilotDaemon(policy, Actuators(worker=worker),
+                        fetch=_ScriptedFleet(), interval_s=0.01)
+    assert_facade(d, "distlr_tpu/autopilot/daemon.py:AutopilotDaemon")
+    snaps: list[dict] = []
+
+    def monitor():
+        snaps.append(d.status())
+        snaps.append(d.status())
+
+    d.start()
+    t = sync.Thread(target=monitor, name="monitor")
+    t.start()
+    rt.await_until(lambda: d.ticks >= 3, "three ticks")
+    t.join()
+    d.stop()
+    _check(d._thread is None, "loop thread not joined by stop()")
+    alive = sorted(task.name for task in rt.tasks
+                   if task.name == "distlr-autopilot"
+                   and task.state not in (NEW, DONE))
+    _check(not alive, "autopilot loop still live after stop() returned")
+    _check(worker.stopped, "actuators not closed by stop()")
+    _check(d.ticks >= 3, f"tick counter lost updates: {d.ticks}")
+    # the seeded fetch failure must surface as a held tick, not a crash
+    _check(d.errors == 0,
+           f"fail-safe hold misaccounted as actuator error: {d.errors}")
+    _check(worker.scales and worker.scales[0] == 2,
+           f"breached worker band never acted: {worker.scales}")
+    _check(d.actions == len(worker.scales),
+           f"action accounting drift: daemon {d.actions}, "
+           f"actuator saw {len(worker.scales)}")
+    for s in snaps:
+        _check(0 <= s["actions"] <= s["ticks"] + 1,
+               f"torn status() snapshot: {s}")

@@ -1,0 +1,54 @@
+"""Fleet autopilot (ISSUE 16): the closed control loop over the
+elastic fleet.
+
+PR 12 built every actuator (``ps-ctl`` live resharding, router
+ADDREPLICA/DELREPLICA, ``.claim`` worker elasticity) and PRs 3/9 built
+every sensor (fleet.json, windowed history, derived alert gauges);
+this package is the controller that connects them, split rigidly into
+a pure half and an effectful half:
+
+* :mod:`~distlr_tpu.autopilot.policy` — the deterministic,
+  clock-injected :class:`PolicyEngine` (bands, hysteresis, cooldowns,
+  bounds, one-action-per-tick arbitration, rollback-on-alert);
+* :mod:`~distlr_tpu.autopilot.actuators` — the fleet-touching
+  :class:`Actuators` (ps-ctl / router admin / worker subprocesses);
+* :mod:`~distlr_tpu.autopilot.daemon` — :class:`AutopilotDaemon`, the
+  tick loop ``launch autopilot`` runs, journaling every decision to
+  ``<run_dir>/autopilot/decisions.jsonl`` and exporting the
+  ``distlr_autopilot_*`` series.
+
+Jax-free by design, like every other control-plane role.
+"""
+
+from distlr_tpu.autopilot.actuators import (
+    ActuatorError,
+    Actuators,
+    EngineActuator,
+    PSActuator,
+    WorkerActuator,
+)
+from distlr_tpu.autopilot.daemon import AutopilotDaemon, fleet_fetcher
+from distlr_tpu.autopilot.policy import (
+    ACTUATORS,
+    Action,
+    Decision,
+    FleetSignals,
+    PolicyConfig,
+    PolicyEngine,
+)
+
+__all__ = [
+    "ACTUATORS",
+    "Action",
+    "ActuatorError",
+    "Actuators",
+    "AutopilotDaemon",
+    "Decision",
+    "EngineActuator",
+    "FleetSignals",
+    "PSActuator",
+    "PolicyConfig",
+    "PolicyEngine",
+    "WorkerActuator",
+    "fleet_fetcher",
+]

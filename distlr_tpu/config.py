@@ -373,6 +373,47 @@ class Config:
     # Per-exchange socket timeout toward replicas (connect + reply read).
     route_backend_timeout_s: float = 30.0
 
+    # ---- fleet autopilot (launch autopilot / distlr_tpu.autopilot) ----
+    # Control-loop tick interval: one /fleet.json poll + at most one
+    # scaling action per tick.
+    autopilot_interval_s: float = 2.0
+    # Consecutive in-breach ticks before a band fires (flap damping; a
+    # reshard or replica churn is never answered to a single sample).
+    autopilot_hysteresis_ticks: int = 2
+    # Per-actuator hold after any action (and the global freeze after a
+    # rollback-on-alert) before the policy may move it again.
+    autopilot_cooldown_s: float = 10.0
+    # How long after an action a firing bound alert still blames (and
+    # reverts) it; older actions are left alone and the daemon holds.
+    autopilot_rollback_window_s: float = 60.0
+    # Per-actuator bounds the policy clamps every target into.
+    autopilot_ps_min: int = 1
+    autopilot_ps_max: int = 8
+    autopilot_engine_min: int = 1
+    autopilot_engine_max: int = 8
+    autopilot_worker_min: int = 1
+    autopilot_worker_max: int = 8
+    # PS band: grow on the cumulative staleness-pushes p99 (the Hogwild
+    # quality knob — convergence degrades with staleness τ) or on the
+    # windowed push rate per rank; shrink only on the windowed rate (a
+    # cumulative percentile never forgets the peak).
+    autopilot_staleness_high: float = 64.0
+    autopilot_push_rate_high: float = 200.0
+    autopilot_push_rate_low: float = 20.0
+    # Engine band: grow on windowed admission-shed rate (sheds/s) or
+    # the cumulative route p99 safety bound; shrink when shed-free and
+    # the windowed accepted req/s per replica falls under the floor.
+    autopilot_shed_rate_high: float = 0.5
+    autopilot_route_p99_high_ms: float = 250.0
+    autopilot_req_rate_low: float = 5.0
+    # Worker band on the live distlr_feedback_shard_lag gauge (pending
+    # unclaimed shards): spawn above high, retire below low.
+    autopilot_lag_high: float = 4.0
+    autopilot_lag_low: float = 1.0
+    # Horizon for the windowed rates (successive /fleet.json polls,
+    # seeded from history.jsonl at daemon start).
+    autopilot_rate_window_s: float = 10.0
+
     def __post_init__(self):
         ref = self.compat_mode == "reference"
         if self.compat_mode not in ("correct", "reference"):
@@ -613,6 +654,49 @@ class Config:
                 "route_backend_timeout_s must be positive, "
                 f"got {self.route_backend_timeout_s}"
             )
+        if self.autopilot_interval_s <= 0:
+            raise ValueError(
+                "autopilot_interval_s must be positive, "
+                f"got {self.autopilot_interval_s}")
+        if self.autopilot_hysteresis_ticks < 1:
+            raise ValueError(
+                "autopilot_hysteresis_ticks must be >= 1, "
+                f"got {self.autopilot_hysteresis_ticks}")
+        if self.autopilot_cooldown_s < 0 or self.autopilot_rollback_window_s < 0:
+            raise ValueError(
+                "autopilot_cooldown_s and autopilot_rollback_window_s "
+                f"must be >= 0, got {self.autopilot_cooldown_s}/"
+                f"{self.autopilot_rollback_window_s}")
+        for knob in ("ps", "engine", "worker"):
+            lo = getattr(self, f"autopilot_{knob}_min")
+            hi = getattr(self, f"autopilot_{knob}_max")
+            if lo < 0 or hi < lo:
+                raise ValueError(
+                    f"need 0 <= autopilot_{knob}_min <= autopilot_"
+                    f"{knob}_max, got {lo}/{hi}")
+        if (self.autopilot_push_rate_low < 0
+                or self.autopilot_push_rate_high <= self.autopilot_push_rate_low):
+            raise ValueError(
+                "need 0 <= autopilot_push_rate_low < autopilot_push_"
+                f"rate_high, got {self.autopilot_push_rate_low}/"
+                f"{self.autopilot_push_rate_high}")
+        if (self.autopilot_lag_low < 0
+                or self.autopilot_lag_high <= self.autopilot_lag_low):
+            raise ValueError(
+                "need 0 <= autopilot_lag_low < autopilot_lag_high, "
+                f"got {self.autopilot_lag_low}/{self.autopilot_lag_high}")
+        if (self.autopilot_staleness_high <= 0
+                or self.autopilot_shed_rate_high < 0
+                or self.autopilot_route_p99_high_ms <= 0
+                or self.autopilot_req_rate_low < 0
+                or self.autopilot_rate_window_s <= 0):
+            raise ValueError(
+                "autopilot bands must be positive (shed/req floors >= 0): "
+                f"staleness_high={self.autopilot_staleness_high} "
+                f"shed_rate_high={self.autopilot_shed_rate_high} "
+                f"route_p99_high_ms={self.autopilot_route_p99_high_ms} "
+                f"req_rate_low={self.autopilot_req_rate_low} "
+                f"rate_window_s={self.autopilot_rate_window_s}")
 
     # -- reference env-var shim ------------------------------------------------
     @classmethod

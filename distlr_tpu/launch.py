@@ -339,6 +339,16 @@ def _config_from_args(args: argparse.Namespace) -> Config:
             "ps_accum_growth_every", "ps_accum_max", "ps_retry_adaptive",
             "trace_sample", "prof_hz", "prof_window_s",
             "serve_model_id", "route_quota",
+            "autopilot_interval_s", "autopilot_hysteresis_ticks",
+            "autopilot_cooldown_s", "autopilot_rollback_window_s",
+            "autopilot_ps_min", "autopilot_ps_max",
+            "autopilot_engine_min", "autopilot_engine_max",
+            "autopilot_worker_min", "autopilot_worker_max",
+            "autopilot_staleness_high", "autopilot_push_rate_high",
+            "autopilot_push_rate_low", "autopilot_shed_rate_high",
+            "autopilot_route_p99_high_ms", "autopilot_req_rate_low",
+            "autopilot_lag_high", "autopilot_lag_low",
+            "autopilot_rate_window_s",
         }
     }
     if isinstance(overrides.get("obs_run_dir"), list):
@@ -999,6 +1009,119 @@ def cmd_rollout(args: argparse.Namespace) -> int:
     return {"promoted": 0, "rolled_back": 3}.get(outcome["outcome"], 4)
 
 
+def cmd_autopilot(args: argparse.Namespace) -> int:
+    """Fleet autopilot (:mod:`distlr_tpu.autopilot`): the closed
+    control loop over the elastic fleet.  Polls obs-agg's
+    ``/fleet.json``, reduces it to signals (cumulative percentiles +
+    windowed rates), and drives whichever actuators were bound:
+    ``--ps-ctl`` scales the elastic server group, ``--router`` +
+    ``--replica-pool`` promotes/demotes standby serving replicas,
+    ``--worker-cmd`` spawns/retires online-worker subprocesses.  Every
+    decision journals to ``<journal-dir>/autopilot/decisions.jsonl``;
+    a bound ``distlr_alert_*`` firing inside the rollback window
+    reverts the last action (the ``launch rollout`` fail-safe,
+    repurposed).  Jax-free, like route/rollout/obs-agg."""
+    import json  # noqa: PLC0415
+    import signal  # noqa: PLC0415
+
+    from distlr_tpu.autopilot import (  # noqa: PLC0415
+        Actuators,
+        AutopilotDaemon,
+        EngineActuator,
+        PolicyConfig,
+        PolicyEngine,
+        PSActuator,
+        WorkerActuator,
+        fleet_fetcher,
+    )
+    from distlr_tpu.obs.federate import discover_endpoints  # noqa: PLC0415
+    from distlr_tpu.serve.rollout import fleet_alert_poller  # noqa: PLC0415
+
+    cfg = _config_from_args(args)
+    run_dir = (cfg.obs_run_dir.split(os.pathsep)[0]
+               if cfg.obs_run_dir else None)
+    fleet_url = args.fleet
+    if not fleet_url and run_dir:
+        aggs = [e for e in discover_endpoints(run_dir)
+                if e["role"] == "obs-agg"]
+        if aggs:
+            fleet_url = f"http://{aggs[-1]['host']}:{aggs[-1]['port']}"
+    if not fleet_url:
+        print("error: no fleet source — pass --fleet http://host:port or "
+              "an --obs-run-dir with a running obs-agg (the autopilot is "
+              "blind without /fleet.json)", file=sys.stderr)
+        return 2
+    if args.router and not args.replica_pool:
+        print("error: --router needs --replica-pool (the standby "
+              "replicas the autopilot may promote into rotation)",
+              file=sys.stderr)
+        return 2
+    if not (args.ps_ctl or args.router or args.worker_cmd):
+        print("error: nothing to actuate — bind at least one of "
+              "--ps-ctl, --router (+--replica-pool), --worker-cmd",
+              file=sys.stderr)
+        return 2
+    try:
+        actuators = Actuators(
+            ps=PSActuator(args.ps_ctl) if args.ps_ctl else None,
+            engine=(EngineActuator(
+                args.router,
+                [a.strip() for a in args.replica_pool.split(",")
+                 if a.strip()],
+                model=args.engine_model)
+                if args.router else None),
+            worker=(WorkerActuator(args.worker_cmd)
+                    if args.worker_cmd else None),
+        )
+    except ValueError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    poller = None
+    if not args.unwatched:
+        names = ([n.strip() for n in args.alerts.split(",") if n.strip()]
+                 if args.alerts else None)
+        poller = fleet_alert_poller(fleet_url, names=names)
+    journal_dir = args.journal_dir or run_dir
+    with _obs_scope(cfg, "autopilot", _obs_rank(args)):
+        daemon = AutopilotDaemon(
+            PolicyEngine(PolicyConfig.from_config(cfg)),
+            actuators,
+            fetch=fleet_fetcher(fleet_url),
+            alert_poll=poller,
+            interval_s=cfg.autopilot_interval_s,
+            journal_dir=journal_dir,
+            rate_window_s=cfg.autopilot_rate_window_s,
+        )
+        if run_dir:
+            seeded = daemon.seed_rates_from_history(run_dir)
+            if seeded:
+                log.info("autopilot: seeded rate window from %d "
+                         "history rows", seeded)
+        # Scriptable contract, like METRICS/ROLLOUT/HOSTS.
+        print("AUTOPILOT " + json.dumps({
+            "fleet": fleet_url,
+            "actuators": [a for a, on in (
+                ("ps", args.ps_ctl), ("engine", args.router),
+                ("worker", args.worker_cmd)) if on],
+            "journal": daemon.journal_path,
+        }), flush=True)
+        signal.signal(signal.SIGTERM, lambda *_: sys.exit(143))
+        try:
+            if args.iterations is not None:
+                for _ in range(args.iterations):
+                    daemon.tick_once()
+                    daemon._stop.wait(daemon.interval_s)
+                actuators.close()
+            else:
+                daemon.run_forever()
+        except KeyboardInterrupt:
+            return 130
+        finally:
+            print("AUTOPILOT-EXIT " + json.dumps(daemon.status()),
+                  flush=True)
+    return 0
+
+
 def cmd_chaos(args: argparse.Namespace) -> int:
     """Stand a fault-injection proxy fabric in front of an EXISTING KV
     server group (:mod:`distlr_tpu.chaos`): one proxied port per
@@ -1184,6 +1307,11 @@ def cmd_ps_ctl(args: argparse.Namespace) -> int:
                   "(ps-ctl --ctl host:port resize N)", file=sys.stderr)
             return 2
         line = f"RESIZE {args.n}"
+        if args.no_wait:
+            # daemon-friendly form: the coordinator validates, replies
+            # immediately with accepted=true, and drains in the
+            # background — poll `status` until it reads active again
+            line += " wait=0"
     else:
         line = args.command.upper()
     try:
@@ -1743,6 +1871,118 @@ def main(argv=None) -> int:
                     "(default: the first --obs-run-dir)")
     ro.set_defaults(fn=cmd_rollout)
 
+    ap = sub.add_parser(
+        "autopilot",
+        help="fleet autopilot: closed-loop scaling daemon — polls "
+             "obs-agg's /fleet.json and drives ps-ctl RESIZE, router "
+             "ADDREPLICA/DELREPLICA over a standby pool, and online-"
+             "worker subprocesses through banded hysteresis with "
+             "rollback-on-alert; every decision journals to "
+             "<journal-dir>/autopilot/decisions.jsonl",
+    )
+    _add_config_flags(ap)
+    ap.add_argument("--fleet",
+                    help="obs-agg URL (http://host:port) polled for "
+                    "/fleet.json; default: discovered from "
+                    "--obs-run-dir")
+    ap.add_argument("--ps-ctl", dest="ps_ctl",
+                    help="elastic group coordinator host:port (what "
+                    "`launch ps-server --elastic` announced as PSCTL): "
+                    "binds the ps actuator (non-blocking RESIZE wait=0)")
+    ap.add_argument("--router",
+                    help="routing front-end host:port (ROUTING): binds "
+                    "the engine actuator; needs --replica-pool")
+    ap.add_argument("--replica-pool", dest="replica_pool",
+                    help="comma-separated host:port of PRE-STARTED "
+                    "standby `launch serve` replicas the autopilot may "
+                    "promote into rotation (idle standbys evict their "
+                    "weights, so parked capacity is cheap)")
+    ap.add_argument("--engine-model", dest="engine_model",
+                    default="default",
+                    help="router model id whose replica set is scaled "
+                    "(default 'default')")
+    ap.add_argument("--worker-cmd", dest="worker_cmd",
+                    help="online-worker command template with a "
+                    "{worker_id} placeholder, e.g. \"python -m "
+                    "distlr_tpu.launch online ... --worker-id "
+                    "{worker_id}\": binds the worker actuator "
+                    "(spawn/SIGTERM-retire; the .claim shard protocol "
+                    "makes churn exactly-once)")
+    ap.add_argument("--alerts",
+                    help="comma-separated alert gauge names that gate "
+                    "rollback (default: every distlr_alert_*; bind "
+                    "explicit names when routine shed/latency alerts "
+                    "are expected during scale-up)")
+    ap.add_argument("--unwatched", action="store_true",
+                    help="no alert gate: never roll an action back "
+                    "(tests/dev only)")
+    ap.add_argument("--journal-dir", dest="journal_dir",
+                    help="journal decisions under DIR/autopilot/ "
+                    "(default: the first --obs-run-dir)")
+    ap.add_argument("--iterations", type=int,
+                    help="run N ticks then exit cleanly (default: "
+                    "until SIGTERM/Ctrl-C)")
+    ap.add_argument("--interval", dest="autopilot_interval_s", type=float,
+                    help="tick period, seconds (default 2)")
+    ap.add_argument("--hysteresis-ticks", dest="autopilot_hysteresis_ticks",
+                    type=int,
+                    help="consecutive breached ticks before a band may "
+                    "act (default 2)")
+    ap.add_argument("--cooldown", dest="autopilot_cooldown_s", type=float,
+                    help="per-actuator seconds after an action during "
+                    "which that actuator holds (default 10)")
+    ap.add_argument("--rollback-window", dest="autopilot_rollback_window_s",
+                    type=float,
+                    help="seconds after an action inside which a firing "
+                    "bound alert reverts it (default 60)")
+    ap.add_argument("--ps-min", dest="autopilot_ps_min", type=int,
+                    help="server-count floor (default 1)")
+    ap.add_argument("--ps-max", dest="autopilot_ps_max", type=int,
+                    help="server-count ceiling (default 8)")
+    ap.add_argument("--engine-min", dest="autopilot_engine_min", type=int,
+                    help="in-rotation replica floor (default 1)")
+    ap.add_argument("--engine-max", dest="autopilot_engine_max", type=int,
+                    help="in-rotation replica ceiling (default 8)")
+    ap.add_argument("--worker-min", dest="autopilot_worker_min", type=int,
+                    help="online-worker floor (default 1)")
+    ap.add_argument("--worker-max", dest="autopilot_worker_max", type=int,
+                    help="online-worker ceiling (default 8)")
+    ap.add_argument("--staleness-high", dest="autopilot_staleness_high",
+                    type=float,
+                    help="staleness_pushes_p99 above which the ps band "
+                    "scales up (default 64)")
+    ap.add_argument("--push-rate-high", dest="autopilot_push_rate_high",
+                    type=float,
+                    help="fleet pushes/s PER SERVER above which the ps "
+                    "band scales up (default 200)")
+    ap.add_argument("--push-rate-low", dest="autopilot_push_rate_low",
+                    type=float,
+                    help="fleet pushes/s per server below which the ps "
+                    "band scales down (default 20)")
+    ap.add_argument("--shed-rate-high", dest="autopilot_shed_rate_high",
+                    type=float,
+                    help="router sheds/s above which the engine band "
+                    "scales up (default 0.5)")
+    ap.add_argument("--route-p99-high", dest="autopilot_route_p99_high_ms",
+                    type=float,
+                    help="route p99 ms above which the engine band "
+                    "scales up (default 250)")
+    ap.add_argument("--req-rate-low", dest="autopilot_req_rate_low",
+                    type=float,
+                    help="requests/s PER REPLICA below which (with zero "
+                    "shed) the engine band scales down (default 5)")
+    ap.add_argument("--lag-high", dest="autopilot_lag_high", type=float,
+                    help="pending feedback shards above which the "
+                    "worker band scales up (default 4)")
+    ap.add_argument("--lag-low", dest="autopilot_lag_low", type=float,
+                    help="pending feedback shards below which the "
+                    "worker band scales down (default 1)")
+    ap.add_argument("--rate-window", dest="autopilot_rate_window_s",
+                    type=float,
+                    help="horizon of the windowed push/shed/req rates, "
+                    "seconds (default 10)")
+    ap.set_defaults(fn=cmd_autopilot)
+
     v = sub.add_parser("ps-server", help="host a KV server group (multi-host PS)")
     _add_config_flags(v)
     v.add_argument("--async", dest="asynchronous", action="store_true")
@@ -1784,6 +2024,12 @@ def main(argv=None) -> int:
                     "until the drain completes)")
     pc.add_argument("n", nargs="?", type=int,
                     help="target server count (resize only)")
+    pc.add_argument("--no-wait", dest="no_wait", action="store_true",
+                    help="resize only: return the moment the "
+                    "coordinator ACCEPTS the reshard (RESIZE n wait=0) "
+                    "instead of blocking through the drain; poll "
+                    "`status` until it reads active — what the "
+                    "autopilot's ps actuator does")
     pc.set_defaults(fn=cmd_ps_ctl)
 
     c = sub.add_parser(

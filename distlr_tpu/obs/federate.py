@@ -227,6 +227,31 @@ def _snap_max(snap: dict, name: str) -> float | None:
     return max(vals) if vals else None
 
 
+def _read_autopilot_last_action(run_dirs: list[str]) -> dict | None:
+    """Tail the autopilot's decision journal for the last ACTION (not
+    the last tick — steady/hold rows carry no action).  Best-effort:
+    the journal is append-only JSONL, so reading the final few KB is
+    enough, and a missing/partial file just yields None."""
+    for d in run_dirs:
+        path = os.path.join(d, "autopilot", "decisions.jsonl")
+        try:
+            with open(path, "rb") as f:
+                f.seek(0, os.SEEK_END)
+                f.seek(max(0, f.tell() - 65536))
+                lines = f.read().decode("utf-8", "replace").splitlines()
+        except OSError:
+            continue
+        for line in reversed(lines):
+            try:
+                doc = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(doc, dict) and doc.get("action"):
+                return {"t": doc.get("t"), "rule": doc.get("rule"),
+                        "outcome": doc.get("outcome"), **doc["action"]}
+    return None
+
+
 # ---------------------------------------------------------------------------
 # the merge
 # ---------------------------------------------------------------------------
@@ -986,6 +1011,30 @@ class FleetScraper:
                 if snap.get("distlr_feedback_score_psi") is not None:
                     row["score_psi"] = _snap_max(
                         snap, "distlr_feedback_score_psi")
+                if snap.get("distlr_feedback_shard_lag") is not None:
+                    # pending unclaimed feedback shards — the autopilot's
+                    # worker-band signal (deterministic, unlike the
+                    # cumulative latency percentiles)
+                    lag = _snap_max(snap, "distlr_feedback_shard_lag")
+                    if lag is not None:
+                        row["shard_lag"] = lag
+                # autopilot ranks (`launch autopilot`, ISSUE 16): the
+                # control loop's own telemetry rolls through fleet.json
+                # so `launch top` shows who is steering the fleet
+                if snap.get("distlr_autopilot_ticks_total") is not None:
+                    row["autopilot_ticks"] = int(
+                        _snap_sum(snap, "distlr_autopilot_ticks_total"))
+                    row["autopilot_actions"] = int(
+                        _snap_sum(snap, "distlr_autopilot_actions_total"))
+                    row["autopilot_errors"] = int(
+                        _snap_sum(snap, "distlr_autopilot_errors_total"))
+                    row["autopilot_rollbacks"] = int(_snap_sum(
+                        snap, "distlr_autopilot_rollbacks_total"))
+                    row["autopilot_holding"] = int(
+                        _snap_sum(snap, "distlr_autopilot_holding"))
+                    last = _read_autopilot_last_action(self.run_dirs)
+                    if last is not None:
+                        row["autopilot_last_action"] = last
                 # multi-tenant serving ranks (ISSUE 10): hosted-model
                 # count, per-tenant quota sheds, and the live shadow PSI
                 # (the canary ramp's promote/rollback evidence) roll

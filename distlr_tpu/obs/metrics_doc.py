@@ -157,6 +157,7 @@ _SECTIONS = (
     ("distlr_chaos_", "Chaos fault injection"),
     ("distlr_fleet_", "Fleet federation meta-series"),
     ("distlr_alert_", "Derived alert gauges"),
+    ("distlr_autopilot_", "Fleet autopilot (closed-loop scaling)"),
     ("distlr_trace_", "Distributed tracing"),
     ("distlr_prof_", "Continuous profiling"),
     ("distlr_jax_", "JAX runtime introspection"),

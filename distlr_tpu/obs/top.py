@@ -31,7 +31,7 @@ _COLUMNS = (
     ("samples/s", 10), ("req/s", 8), ("push/s", 8), ("e2e p50/p99", 13),
     ("step p50", 9), ("pull p50/p99", 13), ("push p50/p99", 13),
     ("stale s", 8), ("stale pushes", 13), ("compiles", 8), ("dev MB", 8),
-    ("mdl", 4), ("t-shed", 7), ("sh-psi", 7),
+    ("mdl", 4), ("t-shed", 7), ("sh-psi", 7), ("lag", 5), ("autopilot", 14),
 )
 
 
@@ -109,6 +109,22 @@ def _num(v, fmt="{:.1f}") -> str:
     return "-" if v is None else fmt.format(v)
 
 
+def _autopilot(r: dict) -> str:
+    """The controller rank's cell: actions/rollbacks, the last action
+    (``eng+3`` = engine scaled up to 3), and whether it is holding."""
+    if r.get("autopilot_ticks") is None:
+        return "-"
+    cell = (f"{r.get('autopilot_actions', 0)}a/"
+            f"{r.get('autopilot_rollbacks', 0)}r")
+    last = r.get("autopilot_last_action")
+    if last:
+        sign = "+" if last.get("direction") == "up" else "-"
+        cell += f" {str(last.get('actuator', '?'))[:3]}{sign}{last.get('to')}"
+    if r.get("autopilot_holding"):
+        cell += " hold"
+    return cell
+
+
 def _rank_cells(r: dict, rates: dict | None = None) -> list[str]:
     rr = (rates or {}).get((r.get("role"), r.get("rank")), {})
     return [
@@ -133,6 +149,10 @@ def _rank_cells(r: dict, rates: dict | None = None) -> list[str]:
         _num(r.get("models"), "{:d}"),
         _num(r.get("tenant_shed"), "{:d}"),
         _num(r.get("shadow_psi"), "{:.3f}"),
+        # feedback backlog (pending unclaimed shards) + the autopilot
+        # rank's control-loop telemetry (actions, rollbacks, last move)
+        _num(r.get("shard_lag"), "{:.0f}"),
+        _autopilot(r),
     ]
 
 

@@ -385,6 +385,43 @@ class MembershipCoordinator:
         self._record("resize_done", **stats)
         return stats
 
+    def resize_async(self, new_num_servers: int) -> dict:
+        """The daemon-friendly resize entry (ISSUE 16): validate and
+        ACCEPT now, migrate on a background thread, report through
+        STATUS polls (``status: migrating`` while the drain runs, then
+        ``last_resize`` carries the outcome).  A controller ticking on
+        a cooldown must never park a blocking admin socket across a
+        drain window.  Raises :class:`MembershipError` up front for a
+        migration already in flight or an obviously bad target; drain
+        failures land in ``last_resize`` + the reshard-failed alert,
+        exactly like the blocking form."""
+        n = int(new_num_servers)
+        with self._lock:
+            if self._status != "active":
+                raise MembershipError(
+                    f"a migration is already in flight ({self._status})")
+            epoch = self._epoch
+        if n == self.group.num_servers:
+            return {"ok": True, "accepted": False, "noop": True,
+                    "epoch": epoch, "num_servers": n}
+        try:
+            self.group.plan_resize(n)  # validate the target NOW
+        except ValueError as e:
+            raise MembershipError(str(e)) from e
+
+        def run() -> None:
+            try:
+                self.resize(n)
+            except MembershipError as e:
+                # recorded in last_resize / the alert gauge by resize()
+                # itself (or, for a lost accept race, by the winner) —
+                # the thread must not die loudly
+                log.warning("async resize to %d failed: %s", n, e)
+
+        sync.Thread(target=run, daemon=True,
+                    name="distlr-resize-async").start()
+        return {"ok": True, "accepted": True, "target": n, "epoch": epoch}
+
 
 # ---------------------------------------------------------------------------
 # the ps-ctl wire: a tiny line protocol over TCP
@@ -411,8 +448,9 @@ class _CtlTCPServer(socketserver.ThreadingTCPServer):
 
 
 class MembershipServer:
-    """``launch ps-ctl``'s wire: LAYOUT / STATUS / RESIZE <n> over a
-    newline-delimited TCP protocol, every reply one JSON line — the
+    """``launch ps-ctl``'s wire: LAYOUT / STATUS / RESIZE <n>
+    [wait=0|wait=1] over a newline-delimited TCP protocol, every reply
+    one JSON line — the
     scheduler endpoint clients' ``route=`` providers poll
     (:func:`layout_client`) and operators script against."""
 
@@ -441,9 +479,20 @@ class MembershipServer:
                 # operators scripting huge tables can poll STATUS from a
                 # second connection)
                 return json.dumps(self.coordinator.resize(int(parts[1])))
+            if (verb == "RESIZE" and len(parts) == 3
+                    and parts[2] in ("wait=0", "wait=1")):
+                # the machine-friendly single-request form (ISSUE 16):
+                # wait=0 accepts now and migrates in the background (the
+                # autopilot's path — STATUS polls report completion),
+                # wait=1 is the blocking form spelled explicitly
+                if parts[2] == "wait=1":
+                    return json.dumps(self.coordinator.resize(int(parts[1])))
+                return json.dumps(
+                    self.coordinator.resize_async(int(parts[1])))
             return json.dumps({"ok": False,
                                "error": f"unknown command {line!r} "
-                                        "(LAYOUT | STATUS | RESIZE <n>)"})
+                                        "(LAYOUT | STATUS | "
+                                        "RESIZE <n> [wait=0|wait=1])"})
         except (MembershipError, ValueError) as e:
             return json.dumps({"ok": False, "error": str(e)})
 
