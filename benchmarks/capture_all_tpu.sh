@@ -149,6 +149,18 @@ DISTLR_METRICS_SNAPSHOT="benchmarks/capture_logs/fleet/snapshots/autopilot-0.jso
   && echo "bench_autopilot ok" \
   || echo "bench_autopilot failed (non-fatal; artifact not refreshed)"
 
+echo "== bench_slo.py (burn-rate pager: detection latency; best-effort) =="
+# SLO-engine row (ISSUE 17): a clean leg then a saturating chaos leg
+# against a real router, scraped through a live FleetScraper with an
+# SLO file — seconds from chaos start to the FAST burn window firing
+# (the headline), with the zero-false-positive clean-leg bar and the
+# slow window still quiet at detection.
+DISTLR_METRICS_SNAPSHOT="benchmarks/capture_logs/fleet/snapshots/slo-0.json" \
+  timeout 900 python -u benchmarks/bench_slo.py \
+  > benchmarks/capture_logs/bench_slo.json \
+  && echo "bench_slo ok" \
+  || echo "bench_slo failed (non-fatal; artifact not refreshed)"
+
 echo "== bank the fleet metrics snapshot (merged view; best-effort) =="
 # Federates every snapshot banked into the window's fleet dir (today:
 # bench.py; any --obs-run-dir'd process that joins a future window rides

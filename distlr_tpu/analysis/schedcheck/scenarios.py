@@ -825,6 +825,81 @@ def scn_chaoslink_stop_accept(rt: Runtime) -> None:
 
 
 # ---------------------------------------------------------------------------
+# 10. FleetTSDB — scrape-tick writer vs /query reader vs rule evaluator
+# ---------------------------------------------------------------------------
+
+
+def _tsdb_frame(i: int) -> dict:
+    return {"updated": 10.0 * (i + 1),
+            "ranks": [{"role": "route", "rank": 0,
+                       "route_requests": 100.0 * (i + 1),
+                       "route_shed": 0.0}],
+            "totals": {"samples_per_s": 5.0}}
+
+
+@scenario("tsdb_write_query_rollup",
+          ("distlr_tpu/obs/tsdb.py:FleetTSDB",),
+          dfs_runs=4000, max_steps=6000)
+def scn_tsdb_write_query_rollup(rt: Runtime) -> None:
+    """The scrape-tick writer racing a /query reader, the recording-
+    rule evaluator, and lock-free stats() monitoring: ingest is atomic
+    (a query sees a frame PREFIX, so every mid-race rate is a rate some
+    serial history produces — here always 10/s once two frames exist),
+    the rule's derived point lands under the store's lock, the
+    monotonic stats counters never run backwards, and the final state
+    is frame-count deterministic whatever the interleaving."""
+    from distlr_tpu.obs.tsdb import FleetTSDB, RecordingRule
+
+    db = FleetTSDB(raw_points=4, rollup_retention_s=1000.0)
+    assert_facade(db, "distlr_tpu/obs/tsdb.py:FleetTSDB")
+    rule = RecordingRule("fleet:req_rate", "rate(route_requests)", 100.0)
+    queried: list = []
+
+    def writer():
+        for i in range(3):
+            db.ingest(_tsdb_frame(i))
+
+    def querier():
+        for _ in range(2):
+            queried.append(db.query("rate(route_requests)",
+                                    window_s=100.0))
+
+    def ruler():
+        now = db.latest_time()
+        if now is not None:
+            rule.evaluate(db, now)
+
+    def monitor():
+        a = db.stats()
+        b = db.stats()
+        _check(b["points"] >= a["points"] and b["frames"] >= a["frames"],
+               f"monotonic stats ran backwards: {a} -> {b}")
+
+    tasks = [sync.Thread(target=writer, name="scrape-writer"),
+             sync.Thread(target=querier, name="query-reader"),
+             sync.Thread(target=ruler, name="rule-eval"),
+             sync.Thread(target=monitor, name="monitor")]
+    for t in tasks:
+        t.start()
+    for t in tasks:
+        t.join()
+    for q in queried:
+        _check(q is None or q == 10.0,
+               f"torn mid-race rate {q!r}: every frame prefix yields "
+               "None (<2 frames) or exactly 10.0/s")
+    _check(db.query("rate(route_requests)", window_s=100.0) == 10.0,
+           "final rate drifted from the serial value")
+    st = db.stats()
+    # 3 frames x (2 rank fields + 1 total) + at most one rule point
+    want = (9, 10)
+    _check(st["frames"] == 3 and st["points"] in want,
+           f"final accounting drifted: {st} (want frames=3, "
+           f"points in {want})")
+    _check(sum(st["dropped"].values()) == 0,
+           f"bounded-tier eviction miscounted under no pressure: {st}")
+
+
+# ---------------------------------------------------------------------------
 # 12. AutopilotDaemon — tick loop vs stop() vs lock-free status reads
 # ---------------------------------------------------------------------------
 

@@ -32,7 +32,6 @@ schedcheck scenario).
 
 from __future__ import annotations
 
-import collections
 import json
 import os
 import urllib.request
@@ -46,6 +45,7 @@ from distlr_tpu.autopilot.policy import (
     PolicyEngine,
 )
 from distlr_tpu.obs import dtrace
+from distlr_tpu.obs import tsdb as tsdb_mod
 from distlr_tpu.obs.registry import get_registry
 from distlr_tpu.utils.logging import get_logger
 
@@ -100,26 +100,10 @@ def _rate_key(row: dict) -> tuple:
     return (row.get("role"), row.get("rank"))
 
 
-class _RateWindow:
-    """Windowed rates from successive cumulative-counter observations:
-    append (t, totals-dict), read back (delta/dt) over the horizon."""
-
-    def __init__(self, window_s: float):
-        self.window_s = float(window_s)
-        self._obs: collections.deque = collections.deque()
-
-    def push(self, t: float, totals: dict) -> None:
-        self._obs.append((t, totals))
-        while len(self._obs) > 2 and t - self._obs[1][0] >= self.window_s:
-            self._obs.popleft()
-
-    def rate(self, key: str) -> float | None:
-        if len(self._obs) < 2:
-            return None
-        (t0, a), (t1, b) = self._obs[0], self._obs[-1]
-        if t1 <= t0 or key not in a or key not in b:
-            return None
-        return max(0.0, (b[key] - a[key]) / (t1 - t0))
+# The bespoke rate window moved into the shared fleet tsdb (ISSUE 17:
+# one rate arithmetic everywhere); the name stays importable — tests
+# and older call sites pin these exact semantics.
+_RateWindow = tsdb_mod.RateWindow
 
 
 class AutopilotDaemon:
@@ -162,31 +146,22 @@ class AutopilotDaemon:
         """Prime the rate window from obs-agg's ``history.jsonl`` (the
         last few lines inside the horizon), so the first live tick
         already has a windowed rate.  Best-effort: no file, no window.
-        History rows carry wall-clock ``t``; the window needs only
-        deltas, so they are rebased onto this daemon's clock."""
-        path = os.path.join(run_dir, "history.jsonl")
-        try:
-            with open(path) as f:
-                lines = f.readlines()[-64:]
-        except OSError:
-            return 0
-        rows = []
-        for line in lines:
-            try:
-                doc = json.loads(line)
-            except ValueError:
-                continue
-            if isinstance(doc.get("t"), (int, float)):
-                rows.append(doc)
+        History rows carry a wall-clock stamp (``updated`` from the
+        live aggregator, ``t`` in older fixtures — ``tsdb.load_history``
+        accepts both; recognizing only ``t`` used to silently seed 0
+        from every REAL history file); the window needs only deltas, so
+        rows are rebased onto this daemon's clock."""
+        rows = tsdb_mod.load_history(
+            os.path.join(run_dir, "history.jsonl"), limit=64)
         if len(rows) < 2:
             return 0
         now = self.clock()
-        newest = rows[-1]["t"]
+        newest = rows[-1][0]
         seeded = 0
-        for doc in rows:
-            if newest - doc["t"] > self._rates.window_s:
+        for t, doc in rows:
+            if newest - t > self._rates.window_s:
                 continue
-            self._rates.push(now - (newest - doc["t"]),
+            self._rates.push(now - (newest - t),
                              self._totals(doc.get("ranks", [])))
             seeded += 1
         return seeded

@@ -280,6 +280,24 @@ class Config:
     # Seconds of aggregation per journaled profile window.
     prof_window_s: float = 10.0
 
+    # ---- SLO engine / embedded fleet tsdb (launch obs-agg) ----
+    # SLO spec file (JSON) compiled by `launch obs-agg` into error-
+    # budget gauges (distlr_slo_budget_remaining / distlr_slo_burn_rate)
+    # and multi-window burn-rate alerts (distlr_alert_slo_burn) over the
+    # embedded fleet time-series store.  None = no SLO engine.
+    slo_file: str | None = None
+    # Raw-tier ring size of the embedded tsdb: scrape frames kept per
+    # (series, labels) before the oldest is evicted into the 10s/60s
+    # rollup tiers (~17 min at the default 2 s scrape interval).
+    obs_tsdb_raw_points: int = 512
+    # Seconds of 10s/60s rollup history kept per series; evictions are
+    # counted in distlr_tsdb_points_dropped_total, never silent.
+    obs_tsdb_rollup_retention_s: float = 3600.0
+    # Lines per on-disk history.jsonl segment (the tsdb's raw tier on
+    # disk, `launch top --replay` input) before rotation; one rotated
+    # segment is kept.
+    obs_tsdb_history_lines: int = 2000
+
     # ---- serving (launch serve / distlr_tpu.serve) ----
     # Port 0 = OS-assigned ephemeral (announced as "SERVING host:port").
     serve_port: int = 0
@@ -697,6 +715,18 @@ class Config:
                 f"route_p99_high_ms={self.autopilot_route_p99_high_ms} "
                 f"req_rate_low={self.autopilot_req_rate_low} "
                 f"rate_window_s={self.autopilot_rate_window_s}")
+        if self.obs_tsdb_raw_points < 2:
+            raise ValueError(
+                "obs_tsdb_raw_points must be >= 2 (a rate needs two "
+                f"points), got {self.obs_tsdb_raw_points}")
+        if self.obs_tsdb_rollup_retention_s <= 0:
+            raise ValueError(
+                "obs_tsdb_rollup_retention_s must be positive, got "
+                f"{self.obs_tsdb_rollup_retention_s}")
+        if self.obs_tsdb_history_lines < 1:
+            raise ValueError(
+                "obs_tsdb_history_lines must be >= 1, got "
+                f"{self.obs_tsdb_history_lines}")
 
     # -- reference env-var shim ------------------------------------------------
     @classmethod

@@ -349,6 +349,8 @@ def _config_from_args(args: argparse.Namespace) -> Config:
             "autopilot_route_p99_high_ms", "autopilot_req_rate_low",
             "autopilot_lag_high", "autopilot_lag_low",
             "autopilot_rate_window_s",
+            "slo_file", "obs_tsdb_raw_points",
+            "obs_tsdb_rollup_retention_s", "obs_tsdb_history_lines",
         }
     }
     if isinstance(overrides.get("obs_run_dir"), list):
@@ -978,9 +980,12 @@ def cmd_rollout(args: argparse.Namespace) -> int:
         # shadow-PSI series) break the ramp; an alert the primary or
         # another tenant caused no longer rolls the candidate back.
         # --gate-all-alerts restores the indiscriminate fleet gate.
+        # --slo <name> (ISSUE 17) narrows further to that objective's
+        # burn-rate alerts (distlr_alert_slo_burn{slo=<name>}).
         poller = fleet_alert_poller(
             fleet_url, names=names,
-            scope_model=None if args.gate_all_alerts else args.candidate)
+            scope_model=None if args.gate_all_alerts else args.candidate,
+            scope_slo=args.slo)
     elif not args.unwatched:
         print("error: no alert source — pass --fleet http://host:port, an "
               "--obs-run-dir with a running obs-agg, or --unwatched to "
@@ -1365,9 +1370,24 @@ def cmd_obs_agg(args: argparse.Namespace) -> int:
     except (OSError, ValueError) as e:
         print(f"error: bad alert thresholds: {e}", file=sys.stderr)
         return 2
+    slo_spec, slo_rules = None, None
+    if cfg.slo_file:
+        from distlr_tpu.obs.slo import SLOSpecError, load_slo_file  # noqa: PLC0415
+        try:
+            slo_spec, slo_rules = load_slo_file(cfg.slo_file)
+        except SLOSpecError as e:
+            print(f"error: bad --slo-file: {e}", file=sys.stderr)
+            return 2
+        log.info("SLO engine armed: %s",
+                 ", ".join(s.name for s in slo_spec))
     scraper = FleetScraper(cfg.obs_run_dir, interval_s=args.interval,
                            stale_after_s=thresholds.scrape_stale_s,
-                           thresholds=thresholds)
+                           thresholds=thresholds,
+                           slo_spec=slo_spec, slo_rules=slo_rules,
+                           history_max_lines=cfg.obs_tsdb_history_lines,
+                           tsdb_raw_points=cfg.obs_tsdb_raw_points,
+                           tsdb_rollup_retention_s=(
+                               cfg.obs_tsdb_rollup_retention_s))
     if args.once:
         # One-shot federation: merge whatever the run dir holds right
         # now (live endpoints AND banked snapshots/ files) and emit it —
@@ -1389,6 +1409,7 @@ def cmd_obs_agg(args: argparse.Namespace) -> int:
     server = MetricsServer(
         registry=scraper, host=cfg.obs_metrics_host, port=port,
         extra_json={"/fleet.json": scraper.fleet_json},
+        extra_query={"/query": scraper.query_endpoint},
     ).start()
     print(f"METRICS {server.host}:{server.port}", flush=True)
     # Published under its own role so `launch top --obs-run-dir` can find
@@ -1550,6 +1571,57 @@ def cmd_top(args: argparse.Namespace) -> int:
     color = False if args.no_color else None
     return run_top(url, interval=args.interval, iterations=args.iterations,
                    color=color, rate_window=args.rate_window)
+
+
+def cmd_fleet_query(args: argparse.Namespace) -> int:
+    """One tsdb expression against a running obs-agg (`launch
+    fleet-query`): hits the aggregator's ``/query`` endpoint and prints
+    the JSON result — ``rate()``, ``increase()``,
+    ``histogram_quantile()``, ``avg_over_time()`` + label matchers and
+    arithmetic over the embedded fleet time-series store.  Exit codes:
+    0 value, 1 no data in the window, 2 bad query/unreachable."""
+    import json  # noqa: PLC0415
+    import urllib.error  # noqa: PLC0415
+    import urllib.parse  # noqa: PLC0415
+    import urllib.request  # noqa: PLC0415
+
+    from distlr_tpu.obs.federate import discover_endpoints  # noqa: PLC0415
+
+    url = args.fleet
+    if not url:
+        if not args.obs_run_dir:
+            print("error: fleet-query needs --fleet http://host:port or "
+                  "--obs-run-dir (to discover a running obs-agg)",
+                  file=sys.stderr)
+            return 2
+        run_dir = (args.obs_run_dir[0]
+                   if isinstance(args.obs_run_dir, list) else args.obs_run_dir)
+        aggs = [e for e in discover_endpoints(run_dir)
+                if e["role"] == "obs-agg"]
+        if not aggs:
+            print(f"error: no obs-agg endpoint under {run_dir} — start "
+                  "`python -m distlr_tpu.launch obs-agg` first",
+                  file=sys.stderr)
+            return 2
+        url = f"http://{aggs[-1]['host']}:{aggs[-1]['port']}"
+    qs = urllib.parse.urlencode({"expr": args.expr, "window": args.window})
+    try:
+        with urllib.request.urlopen(f"{url.rstrip('/')}/query?{qs}",
+                                    timeout=args.timeout) as r:
+            doc = json.load(r)
+    except urllib.error.HTTPError as e:
+        try:
+            doc = json.load(e)
+        except ValueError:
+            doc = {"error": str(e)}
+        print(f"error: {doc.get('error', e)}", file=sys.stderr)
+        return 2
+    except (OSError, ValueError) as e:
+        print(f"error: aggregator unreachable at {url}: {e}",
+              file=sys.stderr)
+        return 2
+    print(json.dumps(doc))
+    return 0 if doc.get("value") is not None else 1
 
 
 def main(argv=None) -> int:
@@ -1859,6 +1931,11 @@ def main(argv=None) -> int:
                     "— label-named, e.g. its shadow-PSI series — gate "
                     "the ramp; the aggregator-unreachable synthetic "
                     "always gates")
+    ro.add_argument("--slo",
+                    help="gate the ramp on one SLO's burn-rate alerts "
+                    "only (distlr_alert_slo_burn{slo=NAME} from an "
+                    "obs-agg running with --slo-file); composes with "
+                    "candidate attribution via the SLO spec's labels")
     ro.add_argument("--unwatched", action="store_true",
                     help="ramp on the stage timers alone, with NO alert "
                     "gate (rollback becomes manual) — tests/dev only")
@@ -2115,7 +2192,51 @@ def main(argv=None) -> int:
     a.add_argument("--snapshot-path", dest="snapshot_path",
                    help="with --once: write the merged fleet registry here "
                    "(.json = JSON snapshot, else Prometheus text)")
+    a.add_argument("--slo-file", dest="slo_file",
+                   help="SLO spec JSON: objectives over tsdb SLI "
+                   "expressions, compiled into error-budget gauges "
+                   "(distlr_slo_*) and multi-window burn-rate alerts "
+                   "(distlr_alert_slo_burn{slo,window}) evaluated every "
+                   "scrape — see docs/CONFIG.md and the README's 'SLOs "
+                   "& error budgets'")
+    a.add_argument("--obs-tsdb-raw-points", dest="obs_tsdb_raw_points",
+                   type=int,
+                   help="embedded tsdb raw-ring size per series, in "
+                   "scrape frames (default 512)")
+    a.add_argument("--obs-tsdb-rollup-retention-s",
+                   dest="obs_tsdb_rollup_retention_s", type=float,
+                   help="seconds of 10s/60s rollup history kept per "
+                   "series (default 3600); evictions count into "
+                   "distlr_tsdb_points_dropped_total")
+    a.add_argument("--obs-tsdb-history-lines",
+                   dest="obs_tsdb_history_lines", type=int,
+                   help="lines per on-disk history.jsonl segment before "
+                   "rotation (default 2000; one rotated segment kept)")
     a.set_defaults(fn=cmd_obs_agg)
+
+    fq = sub.add_parser(
+        "fleet-query",
+        help="evaluate one time-series expression (rate / increase / "
+             "histogram_quantile / *_over_time + label matchers and "
+             "arithmetic) against a running obs-agg's embedded tsdb "
+             "and print the JSON result",
+    )
+    fq.add_argument("expr",
+                    help="the expression, e.g. "
+                    "'rate(route_requests{role=route})' or "
+                    "'histogram_quantile(0.99, "
+                    "distlr_route_request_seconds)'")
+    fq.add_argument("--obs-run-dir", dest="obs_run_dir",
+                    help="fleet run dir: discovers the running obs-agg's "
+                    "endpoint file")
+    fq.add_argument("--fleet", help="aggregator URL (http://host:port) — "
+                    "overrides --obs-run-dir discovery")
+    fq.add_argument("--window", type=float, default=60.0,
+                    help="trailing evaluation window, seconds (default "
+                    "60)")
+    fq.add_argument("--timeout", type=float, default=5.0,
+                    help="HTTP timeout, seconds (default 5)")
+    fq.set_defaults(fn=cmd_fleet_query)
 
     ta = sub.add_parser(
         "trace-agg",

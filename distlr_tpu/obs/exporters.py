@@ -29,6 +29,7 @@ import http.server
 import json
 import os
 import threading
+import urllib.parse
 
 from distlr_tpu.obs.registry import MetricsRegistry, get_registry
 
@@ -36,7 +37,8 @@ from distlr_tpu.obs.registry import MetricsRegistry, get_registry
 class _Handler(http.server.BaseHTTPRequestHandler):
     def do_GET(self):  # noqa: N802 (stdlib API name)
         registry: MetricsRegistry = self.server.registry  # type: ignore[attr-defined]
-        path = self.path.split("?", 1)[0]
+        path, _, query = self.path.partition("?")
+        status = 200
         if path in ("/metrics", "/"):
             body = registry.prometheus_text().encode()
             ctype = "text/plain; version=0.0.4; charset=utf-8"
@@ -48,10 +50,22 @@ class _Handler(http.server.BaseHTTPRequestHandler):
         elif path in (getattr(self.server, "extra_json", None) or {}):
             body = (json.dumps(self.server.extra_json[path]()) + "\n").encode()  # type: ignore[attr-defined]
             ctype = "application/json"
+        elif path in (getattr(self.server, "extra_query", None) or {}):
+            # parameterized JSON routes: the callable receives the
+            # parsed query params ({k: first-value}) and may reject bad
+            # input with ValueError -> a 400 JSON error body
+            params = {k: v[0] for k, v in
+                      urllib.parse.parse_qs(query).items()}
+            try:
+                doc = self.server.extra_query[path](params)  # type: ignore[attr-defined]
+            except ValueError as e:
+                doc, status = {"error": str(e)}, 400
+            body = (json.dumps(doc) + "\n").encode()
+            ctype = "application/json"
         else:
             self.send_error(404)
             return
-        self.send_response(200)
+        self.send_response(status)
         self.send_header("Content-Type", ctype)
         self.send_header("Content-Length", str(len(body)))
         self.end_headers()
@@ -71,11 +85,13 @@ class MetricsServer:
 
     def __init__(self, registry: MetricsRegistry | None = None, *,
                  host: str = "127.0.0.1", port: int = 0,
-                 extra_json: dict | None = None):
+                 extra_json: dict | None = None,
+                 extra_query: dict | None = None):
         self.registry = registry or get_registry()
         self._http = _HTTPServer((host, port), _Handler)
         self._http.registry = self.registry  # type: ignore[attr-defined]
         self._http.extra_json = dict(extra_json or {})  # type: ignore[attr-defined]
+        self._http.extra_query = dict(extra_query or {})  # type: ignore[attr-defined]
         self.host, self.port = self._http.server_address[:2]
         self._thread = threading.Thread(
             target=self._http.serve_forever, daemon=True,

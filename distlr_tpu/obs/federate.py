@@ -48,6 +48,8 @@ import threading
 import time
 import urllib.request
 
+from distlr_tpu.obs import slo as slo_mod
+from distlr_tpu.obs import tsdb as tsdb_mod
 from distlr_tpu.obs.registry import MetricsRegistry, percentile_from_counts
 from distlr_tpu.utils.logging import get_logger
 
@@ -689,9 +691,20 @@ class FleetScraper:
     def __init__(self, run_dir, *, interval_s: float = 2.0,
                  stale_after_s: float = 10.0, timeout_s: float = 2.0,
                  thresholds: AlertThresholds | None = None,
-                 history: bool = True):
+                 history: bool = True,
+                 history_max_lines: int | None = None,
+                 slo_spec=None, slo_rules=None,
+                 tsdb_raw_points: int = 512,
+                 tsdb_rollup_retention_s: float = 3600.0):
         if interval_s <= 0:
             raise ValueError(f"interval_s must be positive, got {interval_s}")
+        if history_max_lines is None:
+            # resolved at call time, not def time: tests (and embedders)
+            # override the module-level default
+            history_max_lines = HISTORY_MAX_LINES
+        if history_max_lines < 1:
+            raise ValueError("history_max_lines must be >= 1, got "
+                             f"{history_max_lines}")
         # Aggregation of aggregators: several run dirs (a list, or one
         # os.pathsep-joined string — the repeatable `--obs-run-dir` CLI
         # form) federate into ONE scrape, so the trainer fleet and the
@@ -727,7 +740,18 @@ class FleetScraper:
         self.scrapes = 0
         self.history_path = (os.path.join(self.run_dirs[0], "history.jsonl")
                              if history else None)
+        self.history_max_lines = int(history_max_lines)
         self._history_lines = self._count_history_lines()
+        # the embedded time-series store (ISSUE 17): every scrape's
+        # fleet doc + merged snapshot lands here; recording rules and
+        # the SLO engine evaluate over it each tick.  history.jsonl
+        # stays the on-disk raw tier (same file, `top --replay` input).
+        self.tsdb = tsdb_mod.FleetTSDB(
+            raw_points=tsdb_raw_points,
+            rollup_retention_s=tsdb_rollup_retention_s)
+        self.rules = tsdb_mod.default_rules() + list(slo_rules or [])
+        self.slo_engine = (slo_mod.SLOEngine(slo_spec)
+                           if slo_spec else None)
 
     # -- exporter protocol (what MetricsServer calls) ---------------------
     @property
@@ -833,8 +857,22 @@ class FleetScraper:
         self._write_meta_series(reg, rank_ages)
         alerts = evaluate_alerts(reg, thresholds=self.thresholds,
                                  rank_ages=rank_ages)
-        self._maybe_trigger_flightrec(alerts)
         fleet = self._build_fleet_json(rank_ages, alerts)
+        # Feed the embedded tsdb, evaluate recording rules, then the
+        # SLO engine.  Burn alerts append onto the SAME alerts list the
+        # fleet doc carries, so the flight-recorder edge trigger below
+        # and every fleet.json consumer (rollout gate, autopilot, top)
+        # inherit them with zero plumbing changes.
+        self.tsdb.ingest(fleet, reg.snapshot())
+        now_t = self.tsdb.latest_time()
+        if now_t is not None:
+            for rule in self.rules:
+                rule.evaluate(self.tsdb, now_t)
+            if self.slo_engine is not None:
+                fleet["slo"] = self.slo_engine.evaluate(
+                    self.tsdb, reg, now_t, alerts)
+        self._write_tsdb_series(reg)
+        self._maybe_trigger_flightrec(alerts)
         self._append_history(fleet)
         with self._lock:
             self._merged = reg
@@ -856,12 +894,21 @@ class FleetScraper:
         if self.history_path is None:
             return
         try:
-            if self._history_lines >= HISTORY_MAX_LINES:
+            if self._history_lines >= self.history_max_lines:
                 # bounded: one rotation kept, like the feedback spool's
                 # journal segments — an always-on aggregator must never
-                # grow a run dir without limit
+                # grow a run dir without limit.  The overwritten .1
+                # segment's lines are counted into the tsdb's drop
+                # counter (`distlr_tsdb_points_dropped_total{tier=
+                # history}`) — eviction is loud, never silent.
+                try:
+                    with open(self.history_path + ".1") as f:
+                        lost = sum(1 for _ in f)
+                except OSError:
+                    lost = 0
                 os.replace(self.history_path, self.history_path + ".1")
                 self._history_lines = 0
+                self.tsdb.count_dropped("history", lost)
             os.makedirs(os.path.dirname(self.history_path), exist_ok=True)
             with open(self.history_path, "a") as f:
                 f.write(json.dumps(fleet) + "\n")
@@ -894,6 +941,46 @@ class FleetScraper:
             except OSError as e:
                 log.warning("flight-recorder trigger in %s failed: %s",
                             d, e)
+
+    def _write_tsdb_series(self, reg: MetricsRegistry) -> None:
+        """Export the store's own health (a fresh merged registry is
+        rebuilt every scrape, so cumulative ``.inc(total)`` yields the
+        correct counter values — same pattern as the scrape totals)."""
+        st = self.tsdb.stats()
+        reg.gauge("distlr_tsdb_series",
+                  "live (series, labels) pairs in the embedded fleet "
+                  "time-series store").set(st["series"])
+        reg.counter("distlr_tsdb_frames_total",
+                    "scrape frames ingested into the embedded "
+                    "time-series store").inc(st["frames"])
+        reg.counter("distlr_tsdb_points_total",
+                    "points ingested into the embedded time-series "
+                    "store across all series").inc(st["points"])
+        drop_c = reg.counter(
+            "distlr_tsdb_points_dropped_total",
+            "points evicted from a bounded tier (raw ring, rollup "
+            "retention, on-disk history rotation) — loud, never "
+            "silently truncated", ("tier",))
+        for tier, n in sorted(st["dropped"].items()):
+            drop_c.labels(tier=tier).inc(n)
+
+    def query_endpoint(self, params: dict) -> dict:
+        """The ``/query?expr=...&window=...`` route (`MetricsServer`
+        ``extra_query``): evaluate one tsdb expression over a trailing
+        window.  ValueError (bad expr / bad window) surfaces as a 400
+        JSON error body."""
+        expr = params.get("expr")
+        if not expr:
+            raise ValueError("missing required query param 'expr'")
+        window_s = float(params.get("window", 60.0))
+        if window_s <= 0:
+            raise ValueError(f"window must be positive, got {window_s}")
+        return {
+            "expr": expr,
+            "window_s": window_s,
+            "t": self.tsdb.latest_time(),
+            "value": self.tsdb.query(expr, window_s=window_s),
+        }
 
     def _rank_state_name(self, st: _RankState, age: float) -> str:
         if st.up:

@@ -156,6 +156,8 @@ _SECTIONS = (
     ("distlr_feedback_", "Feedback loop (spool / join / online trainer)"),
     ("distlr_chaos_", "Chaos fault injection"),
     ("distlr_fleet_", "Fleet federation meta-series"),
+    ("distlr_tsdb_", "Embedded fleet time-series store"),
+    ("distlr_slo_", "SLO engine (error budgets / burn rates)"),
     ("distlr_alert_", "Derived alert gauges"),
     ("distlr_autopilot_", "Fleet autopilot (closed-loop scaling)"),
     ("distlr_trace_", "Distributed tracing"),

@@ -15,6 +15,8 @@ import sys
 import time
 import urllib.request
 
+from distlr_tpu.obs.tsdb import delta_rate
+
 _RESET = "\x1b[0m"
 _BOLD = "\x1b[1m"
 _DIM = "\x1b[2m"
@@ -74,17 +76,14 @@ class RateTracker:
             return {}
         t0, old = self._hist[0]
         t1, new = self._hist[-1]
-        dt = t1 - t0
-        if dt <= 0:
+        if t1 - t0 <= 0:
             return {}
         rates = {}
         for key, (req1, push1) in new.items():
             req0, push0 = old.get(key, (None, None))
             rates[key] = {
-                "req_s": None if req1 is None or req0 is None
-                else max(0.0, (req1 - req0) / dt),
-                "push_s": None if push1 is None or push0 is None
-                else max(0.0, (push1 - push0) / dt),
+                "req_s": delta_rate(t0, req0, t1, req1),
+                "push_s": delta_rate(t0, push0, t1, push1),
             }
         return rates
 
@@ -181,6 +180,21 @@ def render_fleet(fleet: dict, *, color: bool = True,
                 _RED + _BOLD, color))
     else:
         lines.append(_c("alerts: none firing", _DIM, color))
+    # SLO error budgets (aggregators running with --slo-file publish a
+    # "slo" summary in fleet.json; frames without one render unchanged)
+    for s in fleet.get("slo") or []:
+        budget = s.get("budget_remaining")
+        cell = "budget ?" if budget is None else f"budget {budget:7.1%}"
+        burns = []
+        for lbl, b in sorted((s.get("burn") or {}).items()):
+            long = b.get("long")
+            burns.append(f"{lbl} {'-' if long is None else f'{long:.2f}x'}"
+                         + (" FIRING" if b.get("firing") else ""))
+        line = f"SLO {s.get('name', '?')}: {cell}  " + "  ".join(burns)
+        exhausted = budget is not None and budget <= 0
+        firing = any(b.get("firing") for b in (s.get("burn") or {}).values())
+        code = _RED + _BOLD if (exhausted or firing) else _DIM
+        lines.append(_c(line, code, color))
     lines.append("")
 
     header = "  ".join(name.ljust(w) for name, w in _COLUMNS)

@@ -109,7 +109,8 @@ def attributable(alert: dict, model: str) -> bool:
 def fleet_alert_poller(fleet_url: str, *, names=None,
                        prefix: str = "distlr_alert_",
                        timeout_s: float = 2.0,
-                       scope_model: str | None = None):
+                       scope_model: str | None = None,
+                       scope_slo: str | None = None):
     """An ``alert_poll`` callable over a running ``launch obs-agg``:
     returns the firing alert names (``name{labels}``) bound by ``names``
     (exact names) or ``prefix``.  An UNREACHABLE aggregator reports a
@@ -123,7 +124,14 @@ def fleet_alert_poller(fleet_url: str, *, names=None,
     count as firing; alerts attributed to a DIFFERENT model (the
     primary's drift, another tenant's quota storm) and unattributed
     fleet-wide alerts are skipped.  The synthetic unreachable alert
-    always gates — a blind ramp is never safe."""
+    always gates — a blind ramp is never safe.
+
+    ``scope_slo`` (`launch rollout --slo`, ISSUE 17): additionally
+    restrict to alerts carrying ``slo=<name>`` — the obs-agg SLO
+    engine's ``distlr_alert_slo_burn{slo,window}`` instances gate the
+    ramp on error-budget burn for that one objective (combine with
+    ``scope_model`` to require candidate attribution too; the
+    unreachable alert still always gates)."""
     url = fleet_url.rstrip("/") + "/fleet.json"
     bound = set(names) if names else None
 
@@ -144,6 +152,9 @@ def fleet_alert_poller(fleet_url: str, *, names=None,
             elif not name.startswith(prefix):
                 continue
             if scope_model is not None and not attributable(a, scope_model):
+                continue
+            if scope_slo is not None and str(
+                    (a.get("labels") or {}).get("slo")) != str(scope_slo):
                 continue
             labels = a.get("labels") or {}
             shown = ",".join(f"{k}={v}" for k, v in sorted(labels.items())
