@@ -161,6 +161,20 @@ DISTLR_METRICS_SNAPSHOT="benchmarks/capture_logs/fleet/snapshots/slo-0.json" \
   && echo "bench_slo ok" \
   || echo "bench_slo failed (non-fatal; artifact not refreshed)"
 
+echo "== bench_incident.py (structured-log overhead + one chaos incident bundle; best-effort) =="
+# Incident-engine row (ISSUE 18): serve-QPS overhead with structured
+# logging armed at the default level (<2% bound, drift-cancelling
+# paired slices), plus ONE real chaos-triggered incident bundle — the
+# burn alert's edge triggers the flight recorder, settles, and
+# assembles firing alerts + WARN+ logs + the flight dump + a tsdb
+# window into timeline.jsonl + POSTMORTEM.md — banked under
+# capture_logs/incident/run/incidents/.
+DISTLR_METRICS_SNAPSHOT="benchmarks/capture_logs/fleet/snapshots/incident-0.json" \
+  timeout 900 python -u benchmarks/bench_incident.py \
+  > benchmarks/capture_logs/bench_incident.json \
+  && echo "bench_incident ok (bundle -> benchmarks/capture_logs/incident/run/incidents/)" \
+  || echo "bench_incident failed (non-fatal; artifact not refreshed)"
+
 echo "== bank the fleet metrics snapshot (merged view; best-effort) =="
 # Federates every snapshot banked into the window's fleet dir (today:
 # bench.py; any --obs-run-dir'd process that joins a future window rides

@@ -22,7 +22,8 @@ import sys
 
 from distlr_tpu.analysis.report import Finding
 
-PASSES = ("wire", "concurrency", "config", "metrics", "protocol", "sched")
+PASSES = ("wire", "concurrency", "config", "metrics", "printban",
+          "protocol", "sched")
 
 #: one-line summaries for --list-passes (kept here, not in the pass
 #: modules, so listing passes never imports them)
@@ -35,6 +36,8 @@ PASS_SUMMARIES = {
               "(analysis/config_doc.py)",
     "metrics": "metric-series <-> docs/METRICS.md drift "
                "(obs/metrics_doc.py)",
+    "printban": "bare print()/sys.stderr.write outside the audited "
+                "CLI-output allowlist (analysis/printban.py)",
     "protocol": "KV state-machine model checking + mutants + trace "
                 "conformance (analysis/protocol/)",
     "sched": "deterministic-interleaving execution of the real fleet "
@@ -52,6 +55,12 @@ def run_pass(name: str) -> list[Finding]:
     if name == "config":
         from distlr_tpu.analysis import config_doc
         return config_doc.check()
+    if name == "printban":
+        # ISSUE 18: structured-log coverage can't silently regress —
+        # daemon narrative must flow through get_logger (where the
+        # journal tee sees it), not bare prints
+        from distlr_tpu.analysis import printban
+        return printban.check()
     if name == "protocol":
         # ISSUE 14: bounded exhaustive search of the KV state machine,
         # mutant rediscovery, and fixture trace conformance — the

@@ -991,3 +991,93 @@ def scn_autopilot_tick_stop(rt: Runtime) -> None:
     for s in snaps:
         _check(0 <= s["actions"] <= s["ticks"] + 1,
                f"torn status() snapshot: {s}")
+
+
+# ---------------------------------------------------------------------------
+# 13. FleetLogger — emit writers vs flush vs incident collector vs stats
+# ---------------------------------------------------------------------------
+
+
+@scenario("log_ring_incident_assemble",
+          ("distlr_tpu/obs/log.py:FleetLogger",),
+          dfs_runs=5000, max_steps=6000)
+def scn_log_ring_incident_assemble(rt: Runtime) -> None:
+    """ISSUE 18: the structured-log sink's emit path (ring append +
+    dedupe + journal) raced against an explicit flush, the incident
+    engine's journal collector, and the deliberately lock-free stats()
+    monitor.  Invariants: a WARN+ record is on disk the moment emit
+    returns (the eager-flush contract the incident collector relies
+    on), so every collector snapshot is a subset of the final record
+    set with no torn or phantom records; the dedupe table collapses
+    same-template duplicates to exactly one journaled record whatever
+    the interleaving; the ring holds the newest records; and the
+    monotonic stats counters never run backwards."""
+    from distlr_tpu.obs import log as fleetlog
+    from distlr_tpu.obs.log import FleetLogger
+
+    with _workdir() as d:
+        fl = FleetLogger(d, "serve", 0, level="info", ring=4,
+                         dedupe_s=0.0)
+        assert_facade(fl, "distlr_tpu/obs/log.py:FleetLogger")
+        collected: list[list[dict]] = []
+
+        def writer():
+            for i in range(2):
+                fl.emit("error", f"boom {i}", logger="scn")
+
+        def flusher():
+            fl.flush()
+
+        def collector():
+            collected.append(fleetlog.read_records(d, level="warning"))
+
+        def monitor():
+            a = fl.stats()
+            b = fl.stats()
+            _check(b["records"] >= a["records"]
+                   and b["suppressed"] >= a["suppressed"],
+                   f"monotonic stats ran backwards: {a} -> {b}")
+
+        tasks = [sync.Thread(target=writer, name="emit-writer"),
+                 sync.Thread(target=flusher, name="flusher"),
+                 sync.Thread(target=collector, name="incident-collector"),
+                 sync.Thread(target=monitor, name="monitor")]
+        for t in tasks:
+            t.start()
+        for t in tasks:
+            t.join()
+        final = {r["msg"] for r in fleetlog.read_records(d)}
+        _check(final == {"boom 0", "boom 1"},
+               f"journal lost or tore records: {sorted(final)}")
+        for snap in collected:
+            msgs = [r["msg"] for r in snap]
+            _check(set(msgs) <= final and len(msgs) == len(set(msgs)),
+                   f"collector saw torn/phantom records: {msgs}")
+        _check([r["msg"] for r in fl.tail(4)] == ["boom 0", "boom 1"],
+               "ring order drifted from emit order")
+        st = fl.stats()
+        _check(st["records"] == 2 and st["suppressed"] == 0,
+               f"accounting drifted: {st}")
+        fl.close()
+
+        # second act: the dedupe table under two racing writers with
+        # the SAME template — exactly one journaled record carries the
+        # window, the other three emits fold into suppressed counts,
+        # first-writer-wins being schedule-dependent but the TOTALS not
+        f2 = FleetLogger(d, "router", 0, level="info", ring=4,
+                         dedupe_s=1000.0)
+
+        def dup_writer():
+            for _ in range(2):
+                f2.emit("warning", "link flap", logger="scn")
+
+        tasks = [sync.Thread(target=dup_writer, name="dup-a"),
+                 sync.Thread(target=dup_writer, name="dup-b")]
+        for t in tasks:
+            t.start()
+        for t in tasks:
+            t.join()
+        st = f2.stats()
+        _check(st["records"] == 1 and st["suppressed"] == 3,
+               f"dedupe accounting drifted under race: {st}")
+        f2.close()

@@ -34,6 +34,7 @@ from __future__ import annotations
 
 import json
 import os
+import time
 import urllib.request
 
 from distlr_tpu import sync
@@ -262,8 +263,14 @@ class AutopilotDaemon:
     def _journal(self, decision: Decision) -> None:
         if self.journal_path is None:
             return
+        # the decision's own "t" is the policy clock (monotonic in
+        # production — what the cooldown arithmetic and the replay
+        # tests pin); "ts" anchors the line on the wall clock so the
+        # incident engine can place it on a fleet timeline
+        doc = json.loads(decision.to_json())
+        doc["ts"] = round(time.time(), 6)
         with open(self.journal_path, "a") as f:
-            f.write(decision.to_json() + "\n")
+            f.write(json.dumps(doc) + "\n")
 
     # -- lifecycle ---------------------------------------------------------
     def run_forever(self) -> None:

@@ -279,6 +279,33 @@ class Config:
     prof_hz: float = 19.0
     # Seconds of aggregation per journaled profile window.
     prof_window_s: float = 10.0
+    # Structured fleet logging (distlr_tpu.obs.log): minimum level
+    # journaled to <obs_run_dir>/logs/<role>-<rank>.jsonl as JSONL
+    # records stamped with the active dtrace trace/span ids.  Armed
+    # (like tracing) only when obs_run_dir is set; the human-readable
+    # stderr lines are unaffected either way.
+    log_level: str = "info"
+    # Records kept in the logger's bounded in-memory ring (the `launch
+    # logs --follow`-style recent view; like the flight recorder's span
+    # ring, the ring holds what the journal level filtered out).
+    log_ring: int = 2048
+    # Rate-limited dedupe: identical (level, logger, message-template)
+    # records within this many seconds collapse into one journaled
+    # record carrying a suppressed-count.  0 journals every record.
+    log_dedupe_s: float = 5.0
+    # Incident engine (launch obs-agg, distlr_tpu.obs.incident):
+    # seconds of context collected around an alert edge into the
+    # incidents/<seq>/ bundle (WARN+ logs, chaos events, autopilot
+    # decisions, rollout transitions inside the window).
+    incident_window_s: float = 120.0
+    # Seconds the aggregator waits after the alert edge before
+    # assembling the bundle — long enough for every rank's flight dump
+    # (0.25 s watcher) and the profiler's burst window (burst_s, 3 s
+    # default) to land on disk.
+    incident_settle_s: float = 6.0
+    # Incident bundles kept under <run_dir>/incidents/ before the
+    # oldest is pruned (loudly, via distlr_incident_pruned_total).
+    incident_max: int = 32
 
     # ---- SLO engine / embedded fleet tsdb (launch obs-agg) ----
     # SLO spec file (JSON) compiled by `launch obs-agg` into error-
@@ -639,6 +666,28 @@ class Config:
         if self.prof_window_s <= 0:
             raise ValueError(
                 f"prof_window_s must be positive, got {self.prof_window_s}")
+        if self.log_level not in ("debug", "info", "warning", "error"):
+            raise ValueError(
+                "log_level must be debug|info|warning|error, got "
+                f"{self.log_level!r}")
+        if self.log_ring < 1:
+            raise ValueError(
+                f"log_ring must be >= 1, got {self.log_ring}")
+        if self.log_dedupe_s < 0:
+            raise ValueError(
+                "log_dedupe_s must be >= 0 (0 = journal every record), "
+                f"got {self.log_dedupe_s}")
+        if self.incident_window_s <= 0:
+            raise ValueError(
+                "incident_window_s must be positive, got "
+                f"{self.incident_window_s}")
+        if self.incident_settle_s < 0:
+            raise ValueError(
+                "incident_settle_s must be >= 0, got "
+                f"{self.incident_settle_s}")
+        if self.incident_max < 1:
+            raise ValueError(
+                f"incident_max must be >= 1, got {self.incident_max}")
         if (not self.serve_model_id
                 or any(c in self.serve_model_id for c in " \t@=,+")):
             raise ValueError(

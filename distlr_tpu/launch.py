@@ -86,6 +86,17 @@ def _obs_scope(cfg: Config, role: str | None = None, rank: int = 0):
                               rank, hz=cfg.prof_hz,
                               window_s=cfg.prof_window_s)
             prof_armed = True
+        # structured fleet logging (ISSUE 18): every distlr_tpu.*
+        # stderr logger additionally journals JSONL records — trace-id
+        # stamped, deduped, ring-buffered — to <run_dir>/logs/
+        # <role>-<rank>.jsonl for `launch logs` and incident bundles.
+        # The human-readable stderr lines are untouched (one extra
+        # handler, never a replacement).
+        from distlr_tpu.obs import log as fleetlog  # noqa: PLC0415
+
+        fleetlog.configure(cfg.obs_run_dir.split(os.pathsep)[0], role,
+                           rank, level=cfg.log_level, ring=cfg.log_ring,
+                           dedupe_s=cfg.log_dedupe_s)
     port = cfg.obs_metrics_port
     if port is None and cfg.obs_run_dir and role is not None:
         port = 0  # joining a fleet implies a scrape endpoint
@@ -112,8 +123,10 @@ def _obs_scope(cfg: Config, role: str | None = None, rank: int = 0):
             log.info("phase trace -> %s (load in Perfetto)", path)
         if cfg.obs_run_dir and role is not None:
             from distlr_tpu.obs import dtrace  # noqa: PLC0415
+            from distlr_tpu.obs import log as fleetlog  # noqa: PLC0415
 
             dtrace.flush()
+            fleetlog.stop()  # flushes + detaches the journal tee
         if prof_armed:
             from distlr_tpu.obs import profile  # noqa: PLC0415
 
@@ -222,6 +235,35 @@ def _add_config_flags(p: argparse.ArgumentParser) -> None:
     p.add_argument("--prof-window", dest="prof_window_s", type=float,
                    help="seconds of aggregation per journaled profile "
                    "window (default 10)")
+    p.add_argument("--log-level", dest="log_level",
+                   choices=["debug", "info", "warning", "error"],
+                   help="minimum level of structured log records "
+                   "journaled to <obs-run-dir>/logs/<role>-<rank>.jsonl "
+                   "(default info); stderr output is unaffected.  "
+                   "Records are stamped with the active dtrace "
+                   "trace/span ids, so `launch logs --trace` can pull "
+                   "one request's log+span story")
+    p.add_argument("--log-ring", dest="log_ring", type=int,
+                   help="records kept in the structured logger's "
+                   "bounded in-memory ring (default 2048)")
+    p.add_argument("--log-dedupe", dest="log_dedupe_s", type=float,
+                   help="seconds identical records collapse into one "
+                   "journaled record with a suppressed-count "
+                   "(default 5; 0 = journal every record)")
+    p.add_argument("--incident-window", dest="incident_window_s",
+                   type=float,
+                   help="obs-agg: seconds of context (WARN+ logs, chaos "
+                   "events, autopilot decisions, rollout transitions) "
+                   "collected around an alert edge into the "
+                   "incidents/<seq>/ bundle (default 120)")
+    p.add_argument("--incident-settle", dest="incident_settle_s",
+                   type=float,
+                   help="obs-agg: seconds after the alert edge before "
+                   "the bundle assembles, letting flight dumps and the "
+                   "profiler burst land (default 6)")
+    p.add_argument("--incident-max", dest="incident_max", type=int,
+                   help="obs-agg: incident bundles kept before the "
+                   "oldest is pruned (default 32)")
     p.add_argument("--resume", action="store_true")
     p.add_argument("--num-workers", dest="num_workers", type=int)
     p.add_argument("--num-servers", dest="num_servers", type=int)
@@ -338,6 +380,8 @@ def _config_from_args(args: argparse.Namespace) -> Config:
             "ps_compress", "ps_accum_start", "ps_accum_growth",
             "ps_accum_growth_every", "ps_accum_max", "ps_retry_adaptive",
             "trace_sample", "prof_hz", "prof_window_s",
+            "log_level", "log_ring", "log_dedupe_s",
+            "incident_window_s", "incident_settle_s", "incident_max",
             "serve_model_id", "route_quota",
             "autopilot_interval_s", "autopilot_hysteresis_ticks",
             "autopilot_cooldown_s", "autopilot_rollback_window_s",
@@ -1387,7 +1431,10 @@ def cmd_obs_agg(args: argparse.Namespace) -> int:
                            history_max_lines=cfg.obs_tsdb_history_lines,
                            tsdb_raw_points=cfg.obs_tsdb_raw_points,
                            tsdb_rollup_retention_s=(
-                               cfg.obs_tsdb_rollup_retention_s))
+                               cfg.obs_tsdb_rollup_retention_s),
+                           incident_window_s=cfg.incident_window_s,
+                           incident_settle_s=cfg.incident_settle_s,
+                           incident_max=cfg.incident_max)
     if args.once:
         # One-shot federation: merge whatever the run dir holds right
         # now (live endpoints AND banked snapshots/ files) and emit it —
@@ -1622,6 +1669,133 @@ def cmd_fleet_query(args: argparse.Namespace) -> int:
         return 2
     print(json.dumps(doc))
     return 0 if doc.get("value") is not None else 1
+
+
+def cmd_logs(args: argparse.Namespace) -> int:
+    """Query the fleet's structured log journals (`launch logs`): merge
+    ``<run_dir>/logs/*.jsonl`` across every rank into one time-ordered
+    stream, filtered by level/substring/time, tailed, or — with
+    ``--trace <id>`` — narrowed to one request's records, interleaved
+    with that trace's spans from the span journals (the log+span story
+    of a single request).  Exit 1 when nothing matched."""
+    import json  # noqa: PLC0415
+    import time  # noqa: PLC0415
+
+    from distlr_tpu.obs import dtrace  # noqa: PLC0415
+    from distlr_tpu.obs import log as fleetlog  # noqa: PLC0415
+
+    cfg = _config_from_args(args)
+    if not cfg.obs_run_dir:
+        print("error: logs needs --obs-run-dir (where the fleet "
+              "journals records)", file=sys.stderr)
+        return 2
+    dirs = cfg.obs_run_dir.split(os.pathsep)
+    events: list[dict] = list(fleetlog.read_records(
+        dirs, level=args.level, grep=args.grep, trace=args.trace))
+    if args.trace:
+        # interleave the trace's spans: records say WHAT was logged,
+        # spans say WHERE in the request the process was
+        want = args.trace.lower().lstrip("0")
+        for d in dirs:
+            spans_dir = os.path.join(d, "spans")
+            if not os.path.isdir(spans_dir):
+                continue
+            for name in sorted(os.listdir(spans_dir)):
+                if not name.endswith(".jsonl"):
+                    continue
+                for r in dtrace.read_journal(
+                        os.path.join(spans_dir, name)):
+                    if r.get("type") != "span" or \
+                            str(r.get("trace", "")).lstrip("0") != want:
+                        continue
+                    events.append({
+                        "ts": float(r.get("ts", 0.0)) / 1e6,
+                        "kind": "span", "src": name[:-len(".jsonl")],
+                        "name": r.get("name"),
+                        "dur_ms": round(float(r.get("dur", 0.0)) / 1e3, 3),
+                        "trace": r.get("trace"), "span": r.get("span"),
+                    })
+        events.sort(key=lambda e: e.get("ts", 0.0))
+    if args.tail and len(events) > args.tail:
+        events = events[-args.tail:]
+    for ev in events:
+        if args.json:
+            print(json.dumps(ev))
+            continue
+        ts = time.strftime("%H:%M:%S", time.localtime(ev.get("ts", 0.0)))
+        ts += f".{int((ev.get('ts', 0.0) % 1) * 1000):03d}"
+        if ev.get("kind") == "span":
+            print(f"{ts} SPAN {ev['src']}] {ev['name']} "
+                  f"({ev['dur_ms']} ms)", flush=True)
+        else:
+            who = f"{ev.get('role', '?')}-{ev.get('rank', '?')}"
+            sup = f" (x{ev['suppressed']} suppressed)" \
+                if ev.get("suppressed") else ""
+            tr = f" trace={ev['trace']}" if ev.get("trace") else ""
+            print(f"{ts} {str(ev.get('level', '?')).upper():7s} {who} "
+                  f"{ev.get('logger')}] {ev.get('msg')}{sup}{tr}",
+                  flush=True)
+    return 0 if events else 1
+
+
+def cmd_incident(args: argparse.Namespace) -> int:
+    """Incident bundles (`launch incident`): list the bundles under
+    ``<run_dir>/incidents/``, show one's facts, re-render its
+    POSTMORTEM.md, or — with ``--trigger`` — fire the PR 8/9 dump
+    machinery manually and assemble a bundle for a drill."""
+    import json  # noqa: PLC0415
+    import time  # noqa: PLC0415
+
+    from distlr_tpu.obs import incident  # noqa: PLC0415
+
+    cfg = _config_from_args(args)
+    if not cfg.obs_run_dir:
+        print("error: incident needs --obs-run-dir", file=sys.stderr)
+        return 2
+    dirs = cfg.obs_run_dir.split(os.pathsep)
+    if args.trigger:
+        log.info("manual incident trigger (%s): dumping rings, waiting "
+                 "%.1fs settle for bursts", args.trigger,
+                 cfg.incident_settle_s)
+        path = incident.manual_trigger(
+            dirs, args.trigger, window_s=cfg.incident_window_s,
+            settle_s=cfg.incident_settle_s)
+        if path is None:
+            print("error: bundle for this trigger seq already exists",
+                  file=sys.stderr)
+            return 1
+        print(f"INCIDENT {path}", flush=True)
+        return 0
+    if args.action == "list":
+        incidents = incident.list_incidents(dirs[0])
+        for doc in incidents:
+            when = time.strftime(
+                "%H:%M:%S", time.localtime(doc.get("detected_ts", 0)))
+            n = sum((doc.get("events") or {}).values())
+            print(f"{doc['seq']:04d}  {when}  {doc.get('reason', '?'):24s} "
+                  f"events={n:<4d} {doc['path']}", flush=True)
+        return 0 if incidents else 1
+    seq = args.seq
+    if seq is None:
+        seq = incident.latest_seq(dirs[0])
+    if seq is None:
+        print(f"error: no incident bundles under {dirs[0]}/incidents",
+              file=sys.stderr)
+        return 1
+    if args.action == "show":
+        doc = incident.load(dirs[0], seq)
+        if doc is None:
+            print(f"error: no bundle for seq {seq}", file=sys.stderr)
+            return 1
+        print(json.dumps(doc, indent=1))
+        return 0
+    # render
+    path = incident.render(dirs[0], seq)
+    if path is None:
+        print(f"error: no bundle for seq {seq}", file=sys.stderr)
+        return 1
+    print(f"INCIDENT {path}", flush=True)
+    return 0
 
 
 def main(argv=None) -> int:
@@ -2317,6 +2491,45 @@ def main(argv=None) -> int:
                    help="seconds between replayed frames (default 0 = "
                    "as fast as the terminal draws)")
     t.set_defaults(fn=cmd_top)
+
+    lg = sub.add_parser(
+        "logs",
+        help="query the fleet's structured log journals: tail/grep/"
+             "level-filter across every rank, or follow one request "
+             "with --trace",
+    )
+    _add_config_flags(lg)
+    lg.add_argument("--level", choices=["debug", "info", "warning",
+                                        "error"],
+                    help="minimum record level (default: all journaled)")
+    lg.add_argument("--grep", help="only records whose message contains "
+                    "this substring")
+    lg.add_argument("--trace", help="only this trace id's records, "
+                    "interleaved with its spans (one request's story)")
+    lg.add_argument("--tail", type=int, default=0,
+                    help="print only the last N events (default 0 = all)")
+    lg.add_argument("--json", action="store_true",
+                    help="one JSON object per line instead of text")
+    lg.set_defaults(fn=cmd_logs)
+
+    inc = sub.add_parser(
+        "incident",
+        help="incident bundles: list/show/render the postmortem bundles "
+             "obs-agg assembles on alert edges, or --trigger a manual "
+             "drill bundle",
+    )
+    _add_config_flags(inc)
+    inc.add_argument("action", nargs="?", default="list",
+                     choices=["list", "show", "render"],
+                     help="list bundles, show one's facts as JSON, or "
+                     "(re-)render its POSTMORTEM.md (default: list)")
+    inc.add_argument("--seq", type=int,
+                     help="bundle sequence (default: the newest)")
+    inc.add_argument("--trigger", metavar="REASON",
+                     help="fire the flight-recorder/profiler dump "
+                     "machinery now and assemble a manual bundle with "
+                     "this reason")
+    inc.set_defaults(fn=cmd_incident)
 
     args = parser.parse_args(argv)
     return args.fn(args)

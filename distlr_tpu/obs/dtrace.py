@@ -652,6 +652,12 @@ def trigger(run_dir: str, alert: str = "manual") -> str:
 # trace-agg: merge per-rank span journals into one Chrome/Perfetto trace
 # ---------------------------------------------------------------------------
 
+def read_journal(path: str) -> list[dict]:
+    """Tolerant JSONL journal reader (torn tail lines skipped) — shared
+    by trace-agg here and the incident engine's artifact collectors."""
+    return _read_journal(path)
+
+
 def _read_journal(path: str) -> list[dict]:
     out = []
     try:

@@ -48,6 +48,7 @@ import threading
 import time
 import urllib.request
 
+from distlr_tpu.obs import incident as incident_mod
 from distlr_tpu.obs import slo as slo_mod
 from distlr_tpu.obs import tsdb as tsdb_mod
 from distlr_tpu.obs.registry import MetricsRegistry, percentile_from_counts
@@ -695,7 +696,11 @@ class FleetScraper:
                  history_max_lines: int | None = None,
                  slo_spec=None, slo_rules=None,
                  tsdb_raw_points: int = 512,
-                 tsdb_rollup_retention_s: float = 3600.0):
+                 tsdb_rollup_retention_s: float = 3600.0,
+                 incidents: bool = True,
+                 incident_window_s: float | None = None,
+                 incident_settle_s: float | None = None,
+                 incident_max: int = 32):
         if interval_s <= 0:
             raise ValueError(f"interval_s must be positive, got {interval_s}")
         if history_max_lines is None:
@@ -752,6 +757,20 @@ class FleetScraper:
         self.rules = tsdb_mod.default_rules() + list(slo_rules or [])
         self.slo_engine = (slo_mod.SLOEngine(slo_spec)
                            if slo_spec else None)
+        # the incident engine (ISSUE 18): every alert edge that fires
+        # the flight recorder also queues a bundle, assembled one
+        # settle window later (so the PR 8/9 dumps and bursts have
+        # landed) on this same scrape thread
+        self.incidents_enabled = bool(incidents)
+        self.incident_window_s = float(
+            incident_window_s if incident_window_s is not None
+            else incident_mod.WINDOW_S)
+        self.incident_settle_s = float(
+            incident_settle_s if incident_settle_s is not None
+            else incident_mod.SETTLE_S)
+        self.incident_max = int(incident_max)
+        self._pending_incidents: list[dict] = []
+        self._last_incident_seq = incident_mod.latest_seq(self.run_dirs[0])
 
     # -- exporter protocol (what MetricsServer calls) ---------------------
     @property
@@ -873,6 +892,7 @@ class FleetScraper:
                     self.tsdb, reg, now_t, alerts)
         self._write_tsdb_series(reg)
         self._maybe_trigger_flightrec(alerts)
+        self._maybe_assemble_incidents(fleet)
         self._append_history(fleet)
         with self._lock:
             self._merged = reg
@@ -935,12 +955,74 @@ class FleetScraper:
         reason = ",".join(sorted({k.split("{", 1)[0] for k in new}))
         log.warning("alert(s) newly firing (%s); triggering flight-"
                     "recorder dumps", reason)
+        per_dir_seqs: list[int | None] = []
         for d in self.run_dirs:
             try:
                 dtrace.trigger(d, alert=reason)
             except OSError as e:
                 log.warning("flight-recorder trigger in %s failed: %s",
                             d, e)
+            seq = None
+            try:
+                with open(os.path.join(d, "flightrec",
+                                       dtrace.TRIGGER_NAME)) as f:
+                    seq = int(json.load(f).get("seq", 0))
+            except (OSError, ValueError):
+                pass
+            per_dir_seqs.append(seq)
+        if not self.incidents_enabled:
+            return
+        # queue the incident bundle for this edge; assembled one settle
+        # window later (see _maybe_assemble_incidents) so the flight
+        # dumps and profiler bursts stamped with these seqs have landed
+        # on disk.  The EDGE gate above is the exactly-one contract: a
+        # persistently-firing alert queues once, not once per cycle.
+        seq = next((s for s in per_dir_seqs if s is not None), 0)
+        self._pending_incidents.append({
+            "seq": seq,
+            "per_dir_seqs": per_dir_seqs,
+            "reason": reason,
+            "detected_ts": time.time(),
+            "alerts": [dict(a) for a in alerts if a.get("firing")],
+            "due": time.monotonic() + self.incident_settle_s,
+        })
+
+    def _maybe_assemble_incidents(self, fleet: dict) -> None:
+        """Assemble queued incident bundles whose settle window has
+        elapsed, enforce retention, and stamp the open-incident seq
+        into the fleet doc (the `launch top` ``inc`` column)."""
+        if not self.incidents_enabled:
+            return
+        now = time.monotonic()
+        due = [p for p in self._pending_incidents if p["due"] <= now]
+        if due:
+            self._pending_incidents = [
+                p for p in self._pending_incidents if p["due"] > now]
+        for p in due:
+            try:
+                out = incident_mod.assemble(
+                    self.run_dirs, seq=p["seq"], reason=p["reason"],
+                    detected_ts=p["detected_ts"], alerts=p["alerts"],
+                    slo=fleet.get("slo"), per_dir_seqs=p["per_dir_seqs"],
+                    window_s=self.incident_window_s,
+                    settle_s=self.incident_settle_s, tsdb=self.tsdb)
+                if out is not None:
+                    self._last_incident_seq = p["seq"]
+            except Exception:  # a bad bundle must not stop scraping
+                log.exception("incident %s bundle assembly failed",
+                              p["seq"])
+            incident_mod.prune(self.run_dirs[0], self.incident_max)
+        open_seq = None
+        if self._pending_incidents:
+            open_seq = self._pending_incidents[-1]["seq"]
+        elif self._alerts_firing:
+            open_seq = self._last_incident_seq
+        info = {"open": open_seq, "last": self._last_incident_seq,
+                "pending": len(self._pending_incidents)}
+        fleet["incident"] = info
+        if open_seq is not None:
+            for row in fleet.get("ranks", []):
+                row["incident_open"] = open_seq
 
     def _write_tsdb_series(self, reg: MetricsRegistry) -> None:
         """Export the store's own health (a fresh merged registry is
@@ -1143,6 +1225,21 @@ class FleetScraper:
                 if snap.get("distlr_rollout_weight") is not None:
                     row["rollout_weight"] = _snap_max(
                         snap, "distlr_rollout_weight")
+                # structured-log signal (ISSUE 18): cumulative ERROR
+                # records (tsdb ingests it per-rank, feeding the
+                # fleet:log_error_rate recording rule) and the windowed
+                # per-rank ERROR rate read back from the store — one
+                # frame behind, like autopilot_last_action
+                if snap.get("distlr_log_records_total") is not None:
+                    row["log_errors_total"] = int(_snap_sum(
+                        snap, "distlr_log_records_total",
+                        {"level": "error"}))
+                    r = self.tsdb.query(
+                        "rate(log_errors_total"
+                        f"{{role={st.role},rank={st.rank}}})",
+                        window_s=30.0)
+                    if r is not None:
+                        row["log_errors"] = round(r, 3)
                 # routing-tier ranks (`launch route`): surface the
                 # admission/health signals next to the trainer rows
                 if snap.get("distlr_route_requests_total") is not None:

@@ -160,6 +160,8 @@ _SECTIONS = (
     ("distlr_slo_", "SLO engine (error budgets / burn rates)"),
     ("distlr_alert_", "Derived alert gauges"),
     ("distlr_autopilot_", "Fleet autopilot (closed-loop scaling)"),
+    ("distlr_log_", "Structured fleet logging"),
+    ("distlr_incident_", "Incident engine (bundles / postmortems)"),
     ("distlr_trace_", "Distributed tracing"),
     ("distlr_prof_", "Continuous profiling"),
     ("distlr_jax_", "JAX runtime introspection"),

@@ -34,6 +34,7 @@ _COLUMNS = (
     ("step p50", 9), ("pull p50/p99", 13), ("push p50/p99", 13),
     ("stale s", 8), ("stale pushes", 13), ("compiles", 8), ("dev MB", 8),
     ("mdl", 4), ("t-shed", 7), ("sh-psi", 7), ("lag", 5), ("autopilot", 14),
+    ("err/s", 6), ("inc", 5),
 )
 
 
@@ -152,6 +153,12 @@ def _rank_cells(r: dict, rates: dict | None = None) -> list[str]:
         # rank's control-loop telemetry (actions, rollbacks, last move)
         _num(r.get("shard_lag"), "{:.0f}"),
         _autopilot(r),
+        # structured-log ERROR rate (tsdb-windowed) + the open-incident
+        # seq the aggregator stamps while an alert edge's bundle is
+        # settling or its alert is still firing
+        _num(r.get("log_errors"), "{:.2f}"),
+        ("-" if r.get("incident_open") is None
+         else f"{int(r['incident_open']):04d}"),
     ]
 
 

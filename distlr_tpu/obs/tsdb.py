@@ -386,6 +386,28 @@ class FleetTSDB:
                      "kind": s.kind, "points": len(s.raw)}
                     for s in self._series.values()]
 
+    def window_snapshot(self, start: float, end: float, *,
+                        prefix: str = "fleet:") -> dict:
+        """Export every ``prefix``-named scalar series' (t, value)
+        points inside ``[start, end]`` — the incident bundle's
+        ``tsdb.json`` payload: the headline recorded series around the
+        alert edge, frozen into the bundle so the postmortem does not
+        depend on the live store's retention."""
+        out: dict = {}
+        with self._lock:
+            for s in self._series.values():
+                if not s.name.startswith(prefix) or s.kind == "hist":
+                    continue
+                pts = self._scalar_points(s, start, end)
+                if not pts:
+                    continue
+                key = s.name
+                if s.labels:
+                    key += "{" + ",".join(
+                        f"{k}={v}" for k, v in s.labels) + "}"
+                out[key] = [[round(t, 3), v] for t, v in pts]
+        return out
+
     def latest_time(self) -> float | None:
         with self._lock:
             return self._last_t
@@ -669,6 +691,9 @@ DEFAULT_RULES = (
     ("fleet:push_rate", "rate(pushes)", 30.0),
     ("fleet:shed_rate", "rate(route_shed)", 30.0),
     ("fleet:req_rate", "rate(route_requests)", 30.0),
+    # windowed fleet ERROR-record rate (the structured-log signal the
+    # `launch top` log_errors column and incident bundles read)
+    ("fleet:log_error_rate", "rate(log_errors_total)", 30.0),
 )
 
 

@@ -51,6 +51,24 @@ class _LazyStderrHandler(logging.StreamHandler):
         return sys.stderr
 
 
+#: extra-handler providers consulted on every get_logger call.  Each is
+#: a zero-arg callable returning a Handler (or None when disarmed); the
+#: handler is attached alongside — never instead of — the stderr
+#: handler.  The structured-log tee (distlr_tpu.obs.log) registers here
+#: so loggers created *after* log.configure() still reach the journal.
+_EXTRA_HANDLER_PROVIDERS: list = []
+
+
+def register_extra_handler(provider) -> None:
+    if provider not in _EXTRA_HANDLER_PROVIDERS:
+        _EXTRA_HANDLER_PROVIDERS.append(provider)
+
+
+def unregister_extra_handler(provider) -> None:
+    if provider in _EXTRA_HANDLER_PROVIDERS:
+        _EXTRA_HANDLER_PROVIDERS.remove(provider)
+
+
 def get_logger(name: str = "distlr_tpu") -> logging.Logger:
     logger = logging.getLogger(name)
     if not logger.handlers:
@@ -59,6 +77,10 @@ def get_logger(name: str = "distlr_tpu") -> logging.Logger:
         logger.addHandler(handler)
         logger.setLevel(logging.INFO)
         logger.propagate = False
+    for provider in list(_EXTRA_HANDLER_PROVIDERS):
+        extra = provider()
+        if extra is not None and extra not in logger.handlers:
+            logger.addHandler(extra)
     return logger
 
 

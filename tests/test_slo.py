@@ -362,8 +362,10 @@ class TestRecordingRules:
         assert db.query("fleet:ghost", now=20.0) is None
 
     def test_default_rules_cover_the_three_unified_rates(self):
+        # + the ERROR-log rate behind `launch top`'s log_errors column
         assert {r.name for r in default_rules()} == {
-            "fleet:push_rate", "fleet:shed_rate", "fleet:req_rate"}
+            "fleet:push_rate", "fleet:shed_rate", "fleet:req_rate",
+            "fleet:log_error_rate"}
 
 
 # ---------------------------------------------------------------------------
