@@ -15,6 +15,12 @@
 #                         # schedules
 #   make verify-sched-full# deep tier (higher preemption bound / run
 #                         # budgets; the pytest `slow` twin)
+#   make verify-fleetsim  # fleetsim: thousand-rank discrete-event
+#                         # scenarios driving the real autopilot /
+#                         # router / reshard / SLO policies — pinned
+#                         # digests + all three policy-bug mutants
+#   make verify-fleetsim-full # + the multi-seed fuzz sweep per
+#                         # scenario (the pytest `slow` twin)
 #   make sanitizers       # build the native TSan/ASan/UBSan matrix
 #   make sanitizer-smoke  # fast TSan-client + TSan-server e2e
 #                         # (delegates to benchmarks/Makefile)
@@ -46,6 +52,14 @@ verify-sched:
 verify-sched-full:
 	$(PY) -m distlr_tpu.analysis.schedcheck --full --fuzz 200
 
+verify-fleetsim:
+	$(PY) -m distlr_tpu.analysis.fleetsim
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_fleetsim.py \
+	  -m 'not slow' -q -p no:cacheprovider
+
+verify-fleetsim-full:
+	$(PY) -m distlr_tpu.analysis.fleetsim --full
+
 sanitizers:
 	$(MAKE) -C distlr_tpu/ps/native sanitizers
 
@@ -53,4 +67,5 @@ sanitizer-smoke:
 	$(MAKE) -C benchmarks sanitizer-smoke
 
 .PHONY: lint lint-docs verify-protocol verify-protocol-full \
-	verify-sched verify-sched-full sanitizers sanitizer-smoke
+	verify-sched verify-sched-full verify-fleetsim \
+	verify-fleetsim-full sanitizers sanitizer-smoke

@@ -1,0 +1,126 @@
+"""Fleetsim benchmark: thousand-rank scenarios in seconds on a CPU.
+
+Runs every registered fleet scenario at the pinned seed on the seeded
+discrete-event loop, then the three policy-bug mutant rediscoveries.
+The row's headline is **simulated rank-seconds per wall-second** over
+the whole sweep — the leverage the simulator buys over spawning real
+processes (tier-1 tops out near 4 ranks; the partition-heal scenario
+drives 1000 simulated workers through the REAL joiner/spool and
+autopilot classes).  Prints ONE JSON line in ``bench.py``'s format.
+jax-free by construction.
+
+The bars (WARNINGs + exit 1, same contract as bench_slo):
+
+* every scenario CLEAN (a violation here is a real policy bug — fix
+  it or pin it as a mutant in the same change);
+* byte-identical digests across a back-to-back double run;
+* the 1000-worker scenario completes in single-digit seconds;
+* all three mutants rediscover their pinned counterexample.
+
+Run: ``python benchmarks/bench_fleetsim.py [--quick|--smoke]``
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import os
+import sys
+import time
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+sys.path.insert(0, REPO)
+
+#: wall-clock bar for the 1000-worker scenario (generous: it runs in
+#: well under a second on a laptop; the bar catches algorithmic
+#: regressions like an accidentally quadratic rejoin path)
+HEAL_1000_BUDGET_S = 10.0
+
+
+def bench_sweep() -> dict:
+    from distlr_tpu.analysis.fleetsim import mutants, scenarios  # noqa: PLC0415
+
+    per: dict[str, dict] = {}
+    rank_seconds = 0.0
+    events = 0
+    wall = 0.0
+    violations: list[str] = []
+    for name in scenarios.SCENARIOS:
+        t0 = time.monotonic()
+        res = scenarios.run_scenario(name, 0)
+        dt = time.monotonic() - t0
+        res2 = scenarios.run_scenario(name, 0)
+        per[name] = {
+            "events": res.events,
+            "wall_s": round(dt, 3),
+            "rank_seconds": res.summary["rank_seconds"],
+            "peak_ranks": res.summary["peak_ranks"],
+            "digest": res.digest,
+            "deterministic": res.digest == res2.digest,
+            "violations": res.violations,
+        }
+        rank_seconds += res.summary["rank_seconds"]
+        events += res.events
+        wall += dt
+        violations.extend(res.violations)
+    mutant_ok = {name: not mutants.verify_mutant(name)
+                 for name in mutants.MUTANTS}
+    return {
+        "scenarios": per,
+        "events": events,
+        "wall_s": round(wall, 3),
+        "events_per_s": round(events / max(wall, 1e-9)),
+        "sim_rank_seconds": round(rank_seconds, 1),
+        "rank_seconds_per_wall_s": round(rank_seconds / max(wall, 1e-9)),
+        "violations": violations,
+        "counterexamples_rediscovered": sum(mutant_ok.values()),
+        "mutants": mutant_ok,
+    }
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true",
+                    help="accepted for bench-driver symmetry (the sweep "
+                    "is already seconds-scale; shapes are pinned by the "
+                    "scenario digests)")
+    ap.add_argument("--smoke", action="store_true",
+                    help="alias of --quick (the `make -C benchmarks "
+                    "fleetsim-smoke` entry point)")
+    args = ap.parse_args()
+    logging.disable(logging.WARNING)
+
+    sub = bench_sweep()
+    row = {
+        "metric": ("fleetsim sweep: six fleet scenarios (incl. 1000 "
+                   "simulated workers) through the real control-plane "
+                   "policies — simulated rank-seconds per wall-second"),
+        "value": sub["rank_seconds_per_wall_s"],
+        "unit": "rank-seconds/s",
+        "quick": bool(args.quick or args.smoke),
+        "backend": "none",  # jax-free by construction
+        "fleetsim": sub,
+    }
+    print(json.dumps(row))
+    bad = []
+    for v in sub["violations"]:
+        bad.append(f"clean-run violation: {v}")
+    for name, info in sub["scenarios"].items():
+        if not info["deterministic"]:
+            bad.append(f"{name}: nondeterministic digest")
+    heal = sub["scenarios"]["partition_heal_1000"]["wall_s"]
+    if heal > HEAL_1000_BUDGET_S:
+        bad.append(f"partition_heal_1000 took {heal:.1f}s "
+                   f"(budget {HEAL_1000_BUDGET_S:.0f}s)")
+    for name, ok in sub["mutants"].items():
+        if not ok:
+            bad.append(f"mutant {name} not rediscovered")
+    for b in bad:
+        print(f"[bench_fleetsim] WARNING: {b}", file=sys.stderr)
+    return 1 if bad else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -9,6 +9,25 @@ the fleet autopilot is tested against (``bench_autopilot.py``, the
 synthetic day can breathe capacity up into the peak and back down the
 far side.
 
+The curve/arrival math lives in :mod:`distlr_tpu.traffic` — ONE
+traffic model shared with the fleetsim discrete-event simulator
+(ISSUE 19), so the simulated autopilot and the real one face the same
+offered load.  This module is the socket driver around it, plus three
+realism knobs:
+
+* ``zipf_alpha`` — Zipf-skewed feature popularity (``P(k) ∝ 1/k^a``),
+  the skew that makes an engine's
+  :class:`~distlr_tpu.serve.hotset.HotSetTracker` working set earn its
+  keep (uniform traffic has no hot set); 0 keeps the old uniform draw;
+* ``tenant_mix`` — ``"v1=0.8,v2=0.2"`` per-tenant traffic mixes:
+  requests pick a model by weight and ride ``MODEL``-scoped
+  connections (the multi-tenant router protocol);
+* ``label_frac`` + ``label_delay`` — a replayable label-delay
+  distribution: that fraction of requests goes in ``ID <rid>`` mode
+  and a ``LABEL <rid> <y>`` line follows after a lognormal delay
+  (p50/p95-parameterized), exercising the spool/join window machinery
+  with the same tape every run.
+
 OPEN loop, deliberately: request send times are scheduled from the
 curve alone, never from reply latency, so a saturated tier keeps
 receiving offered load (and sheds it explicitly) instead of the
@@ -23,9 +42,14 @@ Classification per reply line:
 * any other ``ERR``, a transport failure, or a dead connection —
   **err** (the acceptance bar in the e2e is err == 0).
 
-Deterministic for a given seed: payloads are pre-generated with a
-seeded RNG and the schedule is pure arithmetic.  (Reply ordering and
-latency percentiles still reflect the live fleet, of course.)
+Label lines are classified apart (``label_ok``/``label_err``) — a
+fleet run without a feedback spool answers them ``ERR``, which is an
+opt-in wiring gap, not a serving failure.
+
+Deterministic for a given seed: payloads, the Zipf draws, tenant
+picks, and label delays all come from seeded RNGs and the schedule is
+pure arithmetic.  (Reply ordering and latency percentiles still
+reflect the live fleet, of course.)
 
 Library use::
 
@@ -41,10 +65,11 @@ bench in this directory).
 from __future__ import annotations
 
 import argparse
+import bisect
 import json
-import math
 import os
 import queue
+import random
 import socket
 import sys
 import threading
@@ -54,48 +79,46 @@ HERE = os.path.dirname(os.path.abspath(__file__))
 REPO = os.path.dirname(HERE)
 sys.path.insert(0, REPO)
 
+# the shared traffic model (re-exported: `from loadgen import qps_at,
+# schedule` is the pinned import contract of tests and benches)
+from distlr_tpu.traffic import (  # noqa: E402
+    LabelDelay,
+    ZipfSampler,
+    parse_tenant_mix,
+    qps_at,
+    schedule,
+)
 
-def make_payloads(n: int, dim: int, nnz: int, rows: int, seed: int) -> list[str]:
+__all__ = ["make_payloads", "qps_at", "run_load", "schedule"]
+
+
+def make_payloads(n: int, dim: int, nnz: int, rows: int, seed: int,
+                  zipf_alpha: float = 0.0) -> list[str]:
     """``n`` distinct request lines (JSON ``{"rows": [...]}``) with
     seeded sparse feature rows — the engine protocol's 1-based
-    ``col:val`` text format."""
+    ``col:val`` text format.  ``zipf_alpha > 0`` draws columns
+    Zipf-skewed (popular low ids dominate — the hot set); 0 keeps the
+    historical uniform draw byte-identical."""
     import numpy as np  # noqa: PLC0415
 
     rng = np.random.default_rng(seed)
+    zipf = ZipfSampler(dim, zipf_alpha) if zipf_alpha > 0 else None
+    zrng = random.Random(seed)
     payloads = []
     for _ in range(n):
         lines = []
         for _ in range(rows):
-            cols = np.sort(rng.choice(dim, size=min(nnz, dim), replace=False))
-            lines.append(" ".join(f"{c + 1}:1" for c in cols))
+            if zipf is None:
+                cols = np.sort(rng.choice(dim, size=min(nnz, dim),
+                                          replace=False))
+            else:
+                picked: set[int] = set()
+                while len(picked) < min(nnz, dim):
+                    picked.add(zipf.sample(zrng))
+                cols = sorted(picked)
+            lines.append(" ".join(f"{int(c) + 1}:1" for c in cols))
         payloads.append(json.dumps({"rows": lines}))
     return payloads
-
-
-def qps_at(t: float, base_qps: float, peak_qps: float, period_s: float) -> float:
-    """The diurnal curve: raised cosine, base at t=0 and t=period, peak
-    at t=period/2."""
-    phase = (t % period_s) / period_s
-    return base_qps + (peak_qps - base_qps) * 0.5 * (1.0 - math.cos(
-        2.0 * math.pi * phase))
-
-
-def schedule(duration_s: float, base_qps: float, peak_qps: float,
-             period_s: float) -> list[float]:
-    """Deterministic send offsets: integrate the curve in small steps
-    and emit a send time each time the cumulative expectation crosses
-    the next integer."""
-    times: list[float] = []
-    dt = 0.001
-    acc = 0.0
-    t = 0.0
-    while t < duration_s:
-        acc += qps_at(t, base_qps, peak_qps, period_s) * dt
-        while acc >= 1.0:
-            acc -= 1.0
-            times.append(t)
-        t += dt
-    return times
 
 
 class _Counters:
@@ -105,25 +128,40 @@ class _Counters:
         self.ok = 0
         self.shed = 0
         self.err = 0
+        self.labels_sent = 0
+        self.label_ok = 0
+        self.label_err = 0
         self.latencies_ms: list[float] = []
 
 
 def _worker(addr: tuple[str, int], q: "queue.Queue", c: _Counters,
             timeout_s: float) -> None:
     """One sender: a persistent connection, re-dialed on failure (the
-    router may churn replicas under us — that is the point)."""
+    router may churn replicas under us — that is the point).  Items are
+    ``(model, line, is_label)``; a model switch re-scopes the
+    connection with a ``MODEL`` line first."""
     f = None
     sock = None
+    scope: str | None = None
     while True:
         item = q.get()
         if item is None:
             break
-        payload = item
+        model, payload, is_label = item
         t0 = time.monotonic()
         try:
             if f is None:
                 sock = socket.create_connection(addr, timeout=timeout_s)
                 f = sock.makefile("rwb")
+                scope = None
+            if model is not None and model != scope:
+                f.write(f"MODEL {model}\n".encode())
+                f.flush()
+                mrep = f.readline()
+                if not mrep:
+                    raise ConnectionError("connection closed")
+                if mrep.decode("utf-8", "replace").startswith("OK"):
+                    scope = model
             f.write((payload + "\n").encode())
             f.flush()
             reply = f.readline()
@@ -136,13 +174,22 @@ def _worker(addr: tuple[str, int], q: "queue.Queue", c: _Counters,
                 except OSError:
                     pass
             f = sock = None
+            scope = None
             with c.lock:
-                c.err += 1
+                if is_label:
+                    c.label_err += 1
+                else:
+                    c.err += 1
             continue
         ms = (time.monotonic() - t0) * 1e3
         text = reply.decode("utf-8", "replace")
         with c.lock:
-            if text.startswith("ERR SHED"):
+            if is_label:
+                if text.startswith("ERR"):
+                    c.label_err += 1
+                else:
+                    c.label_ok += 1
+            elif text.startswith("ERR SHED"):
                 c.shed += 1
             elif text.startswith("ERR"):
                 c.err += 1
@@ -163,11 +210,49 @@ def _pct(sorted_vals: list[float], q: float) -> float | None:
     return round(sorted_vals[i], 3)
 
 
+def _build_events(sends: list[float], payloads: list[str], *, seed: int,
+                  tenant_mix: dict[str, float] | None, label_frac: float,
+                  label_delay: LabelDelay) -> list[tuple[float, str | None,
+                                                         str, bool]]:
+    """The full deterministic tape: ``(t, model, line, is_label)``
+    sorted by send time — labeled requests go in ``ID`` mode with
+    their ``LABEL`` line scheduled ``delay`` later on the same model."""
+    rng = random.Random(seed)
+    models: list[str] | None = None
+    cdf: list[float] = []
+    if tenant_mix:
+        models = list(tenant_mix)
+        acc = 0.0
+        for m in models:
+            acc += tenant_mix[m]
+            cdf.append(acc)
+    events: list[tuple[float, int, str | None, str, bool]] = []
+    for i, t in enumerate(sends):
+        model = None
+        if models:
+            model = models[min(len(models) - 1,
+                               bisect.bisect_left(cdf, rng.random()))]
+        line = payloads[i % len(payloads)]
+        if label_frac > 0 and rng.random() < label_frac:
+            rid = f"lg{seed}-{i}"
+            line = f"ID {rid} {line}"
+            y = 1 if rng.random() < 0.5 else 0
+            events.append((t + label_delay.sample(rng), i + len(sends),
+                           model, f"LABEL {rid} {y}", True))
+        events.append((t, i, model, line, False))
+    events.sort(key=lambda e: (e[0], e[1]))
+    return [(t, model, line, is_label)
+            for t, _i, model, line, is_label in events]
+
+
 def run_load(addr: str, *, base_qps: float = 20.0, peak_qps: float = 100.0,
              period_s: float = 30.0, duration_s: float | None = None,
              dim: int = 1024, nnz: int = 16, rows_per_request: int = 1,
              seed: int = 0, workers: int = 8, payload_pool: int = 64,
-             timeout_s: float = 10.0, on_tick=None) -> dict:
+             timeout_s: float = 10.0, on_tick=None,
+             zipf_alpha: float = 0.0, tenant_mix=None,
+             label_frac: float = 0.0, label_delay_p50_s: float = 1.0,
+             label_delay_p95_s: float = 5.0) -> dict:
     """Run one diurnal cycle (or ``duration_s``) of open-loop load
     against ``addr`` (``host:port``) and return the summary dict.
     ``on_tick(t, target_qps)`` is called about once a second — hooks
@@ -175,9 +260,16 @@ def run_load(addr: str, *, base_qps: float = 20.0, peak_qps: float = 100.0,
     host, _, port = str(addr).rpartition(":")
     if not host or not port.isdigit():
         raise ValueError(f"addr must be host:port, got {addr!r}")
+    if not 0.0 <= label_frac <= 1.0:
+        raise ValueError(f"label_frac must be in [0, 1], got {label_frac}")
+    mix = parse_tenant_mix(tenant_mix) if tenant_mix else None
     duration_s = period_s if duration_s is None else float(duration_s)
-    payloads = make_payloads(payload_pool, dim, nnz, rows_per_request, seed)
+    payloads = make_payloads(payload_pool, dim, nnz, rows_per_request, seed,
+                             zipf_alpha=zipf_alpha)
     sends = schedule(duration_s, base_qps, peak_qps, period_s)
+    events = _build_events(
+        sends, payloads, seed=seed, tenant_mix=mix, label_frac=label_frac,
+        label_delay=LabelDelay(label_delay_p50_s, label_delay_p95_s))
 
     c = _Counters()
     q: queue.Queue = queue.Queue()
@@ -189,7 +281,7 @@ def run_load(addr: str, *, base_qps: float = 20.0, peak_qps: float = 100.0,
         t.start()
     t0 = time.monotonic()
     next_tick = 0.0
-    for i, offset in enumerate(sends):
+    for offset, model, line, is_label in events:
         now = time.monotonic() - t0
         if offset > now:
             time.sleep(offset - now)
@@ -197,15 +289,19 @@ def run_load(addr: str, *, base_qps: float = 20.0, peak_qps: float = 100.0,
         if on_tick is not None and now >= next_tick:
             on_tick(now, qps_at(now, base_qps, peak_qps, period_s))
             next_tick = now + 1.0
-        q.put(payloads[i % len(payloads)])
-        c.sent += 1  # only the pacer writes sent: no lock needed
+        q.put((model, line, is_label))
+        # only the pacer writes the sent counters: no lock needed
+        if is_label:
+            c.labels_sent += 1
+        else:
+            c.sent += 1
     for _ in pool:
         q.put(None)
     for t in pool:
         t.join()
     elapsed = time.monotonic() - t0
     lat = sorted(c.latencies_ms)
-    return {
+    summary = {
         "sent": c.sent,
         "ok": c.ok,
         "shed": c.shed,
@@ -219,6 +315,16 @@ def run_load(addr: str, *, base_qps: float = 20.0, peak_qps: float = 100.0,
         "period_s": period_s,
         "seed": seed,
     }
+    if zipf_alpha > 0:
+        summary["zipf_alpha"] = zipf_alpha
+    if mix:
+        summary["tenant_mix"] = {m: round(w, 6) for m, w in mix.items()}
+    if label_frac > 0:
+        summary.update(labels_sent=c.labels_sent, label_ok=c.label_ok,
+                       label_err=c.label_err,
+                       label_delay_p50_s=label_delay_p50_s,
+                       label_delay_p95_s=label_delay_p95_s)
+    return summary
 
 
 def main(argv=None) -> int:
@@ -241,12 +347,35 @@ def main(argv=None) -> int:
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--workers", type=int, default=8,
                     help="sender threads (default 8)")
+    ap.add_argument("--zipf-alpha", dest="zipf_alpha", type=float,
+                    default=0.0,
+                    help="Zipf skew of feature popularity (0 = uniform, "
+                    "the historical default; ~1.1 = realistic hot set)")
+    ap.add_argument("--tenant-mix", dest="tenant_mix",
+                    help="per-tenant traffic mix, e.g. v1=0.8,v2=0.2 "
+                    "(requests pick a model by weight over MODEL-scoped "
+                    "connections)")
+    ap.add_argument("--label-frac", dest="label_frac", type=float,
+                    default=0.0,
+                    help="fraction of requests sent in ID mode with a "
+                    "delayed LABEL line following (default 0 = no labels)")
+    ap.add_argument("--label-delay-p50", dest="label_delay_p50_s",
+                    type=float, default=1.0,
+                    help="label-delay distribution median, seconds")
+    ap.add_argument("--label-delay-p95", dest="label_delay_p95_s",
+                    type=float, default=5.0,
+                    help="label-delay distribution p95, seconds")
     args = ap.parse_args(argv)
     summary = run_load(args.addr, base_qps=args.base_qps,
                        peak_qps=args.peak_qps, period_s=args.period_s,
                        duration_s=args.duration_s, dim=args.dim,
                        nnz=args.nnz, rows_per_request=args.rows_per_request,
-                       seed=args.seed, workers=args.workers)
+                       seed=args.seed, workers=args.workers,
+                       zipf_alpha=args.zipf_alpha,
+                       tenant_mix=args.tenant_mix,
+                       label_frac=args.label_frac,
+                       label_delay_p50_s=args.label_delay_p50_s,
+                       label_delay_p95_s=args.label_delay_p95_s)
     # ONE JSON line, the directory's scriptable contract
     print(json.dumps(summary))
     return 0 if summary["err"] == 0 else 1

@@ -1,8 +1,9 @@
 """distlr-lint runner: ``python -m distlr_tpu.analysis`` / ``make lint``.
 
 Runs every pass (wire parity, concurrency, config/CLI/docs parity, the
-folded-in metrics-doc lint, the protocol model-checking pass, and the
-schedcheck interleaving pass), prints findings as
+folded-in metrics-doc lint, the protocol model-checking pass, the
+schedcheck interleaving pass, and the fleetsim scenario pass), prints
+findings as
 ``[pass] key: message (file:line ...)``, and exits non-zero when any
 survive the audited baselines — the single static-analysis entry point
 tier-1 enforces through ``tests/test_analysis.py``.
@@ -23,7 +24,7 @@ import sys
 from distlr_tpu.analysis.report import Finding
 
 PASSES = ("wire", "concurrency", "config", "metrics", "printban",
-          "protocol", "sched")
+          "protocol", "sched", "fleetsim")
 
 #: one-line summaries for --list-passes (kept here, not in the pass
 #: modules, so listing passes never imports them)
@@ -42,6 +43,8 @@ PASS_SUMMARIES = {
                 "conformance (analysis/protocol/)",
     "sched": "deterministic-interleaving execution of the real fleet "
              "classes + mutants (analysis/schedcheck/)",
+    "fleetsim": "discrete-event fleet scenarios property-testing the "
+                "control plane + policy mutants (analysis/fleetsim/)",
 }
 
 
@@ -74,6 +77,13 @@ def run_pass(name: str) -> list[Finding]:
         # mutants (full-depth: `make verify-sched-full`)
         from distlr_tpu.analysis.schedcheck import lint
         return lint.check()
+    if name == "fleetsim":
+        # ISSUE 19: thousand-rank fleet scenarios driving the REAL
+        # autopilot/balance/reshard/SLO policies on a seeded event
+        # loop — pinned digests + the three policy-bug mutants
+        # (full-depth: `make verify-fleetsim-full`)
+        from distlr_tpu.analysis.fleetsim import lint
+        return lint.check()
     if name == "metrics":
         # the PR-8 lint, folded under this runner (its module keeps its
         # own __main__ for the doc generator; tests/test_metrics_doc.py
@@ -96,14 +106,15 @@ def main(argv=None) -> int:
         prog="python -m distlr_tpu.analysis",
         description="distlr-lint: wire parity, concurrency, "
                     "config/docs parity, metrics doc, protocol model "
-                    "checking, schedcheck interleavings")
+                    "checking, schedcheck interleavings, fleetsim "
+                    "scenarios")
     ap.add_argument("--pass", dest="passes", action="append",
                     choices=PASSES,
                     help="run only this pass (repeatable; default all)")
     ap.add_argument("--only", dest="passes", action="append",
                     choices=PASSES, metavar="PASS",
                     help="alias of --pass: run one pass in isolation "
-                    "(the now-six-pass runner takes a while end to "
+                    "(the now-eight-pass runner takes a while end to "
                     "end; see --list-passes)")
     ap.add_argument("--list-passes", action="store_true",
                     help="list the passes with one-line summaries, "

@@ -52,6 +52,14 @@ from distlr_tpu.utils.logging import get_logger
 
 log = get_logger(__name__)
 
+#: decision-journal format version, pinned as the file's FIRST line
+#: ``{"schema": 1, "kind": "autopilot_decisions", ...}`` (mirroring the
+#: PR-14 chaos event-log pin).  Readers — ``launch top``'s journal
+#: pane, federate's last-action column, fleetsim's replay loader —
+#: reject headerless or unknown-schema journals LOUDLY instead of
+#: misparsing decision lines written by a different build.
+JOURNAL_SCHEMA = 1
+
 _reg = get_registry()
 _TICKS = _reg.counter(
     "distlr_autopilot_ticks_total",
@@ -270,9 +278,52 @@ class AutopilotDaemon:
         doc = json.loads(decision.to_json())
         doc["ts"] = round(time.time(), 6)
         with open(self.journal_path, "a") as f:
+            if f.tell() == 0:
+                f.write(json.dumps(
+                    {"schema": JOURNAL_SCHEMA,
+                     "kind": "autopilot_decisions"}) + "\n")
             f.write(json.dumps(doc) + "\n")
 
     # -- lifecycle ---------------------------------------------------------
+    @staticmethod
+    def read_journal(path: str) -> list[dict]:
+        """Load a decision journal, VALIDATING the schema header.
+
+        The shared reader behind fleetsim's ``--replay`` loader and
+        ``launch top``'s journal pane: the first line must be the
+        ``{"schema": 1, "kind": "autopilot_decisions"}`` pin — a
+        headerless file (pre-ISSUE-19 build) or an unknown schema
+        raises ``ValueError`` instead of silently misparsing decision
+        lines whose shape this build does not know.  Trailing partial
+        lines (a live daemon mid-append) are tolerated."""
+        with open(path, encoding="utf-8") as f:
+            first = f.readline()
+            try:
+                header = json.loads(first)
+            except ValueError:
+                header = None
+            if (not isinstance(header, dict)
+                    or header.get("kind") != "autopilot_decisions"):
+                raise ValueError(
+                    f"{path}: not a journal — first line must be the "
+                    '{"schema": ..., "kind": "autopilot_decisions"} '
+                    "header (headerless journals predate ISSUE 19; "
+                    "re-run the daemon to regenerate)")
+            if header.get("schema") != JOURNAL_SCHEMA:
+                raise ValueError(
+                    f"{path}: journal schema {header.get('schema')!r}, "
+                    f"this build reads {JOURNAL_SCHEMA}")
+            docs = []
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    docs.append(json.loads(line))
+                except ValueError:
+                    break  # a torn tail ends the readable prefix
+            return docs
+
     def run_forever(self) -> None:
         while not self._stop.is_set():
             t0 = self.clock()

@@ -16,9 +16,17 @@ Control shape (one action per tick, fixed priority):
    autoscaler turns an observability outage into a fleet outage; the
    same fail-safe stance as the rollout gater's synthetic
    ``rollout_fleet_unreachable`` alert (PR 10).
-2. **Any bound alert firing** -> roll back the most recent action (if
-   one is young enough to blame, :attr:`PolicyConfig.rollback_window_s`)
-   and freeze every actuator for a cooldown; otherwise hold.
+2. **Any bound alert firing** -> if the most recent action is young
+   enough to blame (:attr:`PolicyConfig.rollback_window_s`), roll it
+   back and freeze every actuator for a cooldown.  When NO action can
+   be blamed the alert is evidence of under-provisioning, not
+   mis-actuation: capacity ADDS stay allowed (and are never rollback
+   candidates — they were taken under an already-firing alert),
+   removals are suppressed, and an idle tick holds.  The pre-fix
+   freeze-everything stance deadlocked on slow burns: a gradual
+   degradation fires the SLO alert forever, the frozen controller can
+   never add the engine that would clear it, and the error budget
+   drains to zero (fleetsim ``slow_burn_slo``).
 3. **Bands, in priority order** ``ps`` -> ``engine`` -> ``worker``:
    the PS group is the quality knob (Hogwild convergence degrades with
    staleness τ — PAPERS.md), so it outranks serving capacity, which
@@ -46,6 +54,18 @@ ACTUATORS = ("ps", "engine", "worker")
 #: (:func:`distlr_tpu.serve.rollout.fleet_alert_poller`); it HOLDS the
 #: autopilot rather than triggering a rollback — no evidence, no action
 UNREACHABLE_ALERT = "rollout_fleet_unreachable"
+
+#: flap damping (fleetsim ``autopilot_resonance``): a direction
+#: REVERSAL within this many cooldowns of the previous action on the
+#: same actuator doubles that actuator's next cooldown, compounding up
+#: to ``2**FLAP_STREAK_MAX``.  An offered load sitting between the
+#: scale-down and scale-up thresholds of adjacent counts otherwise
+#: drives up/down/up/down at exactly the cooldown cadence — each cycle
+#: a replica churn — while the escalating hold stretches the
+#: oscillation period until the diurnal curve moves off the resonant
+#: point.  Same-direction repeats (a genuine ramp) never pay it.
+FLAP_WINDOW_COOLDOWNS = 10
+FLAP_STREAK_MAX = 3
 
 
 @dataclasses.dataclass(frozen=True)
@@ -170,6 +190,10 @@ class PolicyEngine:
         self._last_action: Action | None = None
         self._last_action_t: float = float("-inf")
         self._rolled_back = True  # nothing to roll back yet
+        #: actuator -> (direction, time) of its last action, and the
+        #: running reversal streak that escalates its cooldown
+        self._last_dir: dict[str, tuple[str, float]] = {}
+        self._flap_streak: dict[str, int] = {}
 
     # -- helpers -----------------------------------------------------------
     def _holding(self, now: float) -> dict:
@@ -192,13 +216,54 @@ class PolicyEngine:
         lo, hi = self.cfg.bounds(actuator)
         target = max(lo, min(hi, current + (1 if direction == "up" else -1)))
         act = Action(actuator, direction, current, target)
-        self._cooldown_until[actuator] = now + self.cfg.cooldown_s
+        prev = self._last_dir.get(actuator)
+        if (prev is not None and prev[0] != direction
+                and now - prev[1]
+                <= FLAP_WINDOW_COOLDOWNS * self.cfg.cooldown_s):
+            self._flap_streak[actuator] = min(
+                self._flap_streak.get(actuator, 0) + 1, FLAP_STREAK_MAX)
+        else:
+            self._flap_streak[actuator] = 0
+        self._last_dir[actuator] = (direction, now)
+        self._cooldown_until[actuator] = now + self.cfg.cooldown_s * (
+            2 ** self._flap_streak[actuator])
         # the action changes the very state both counters measured
         self._breach[(actuator, "up")] = 0
         self._breach[(actuator, "down")] = 0
         self._last_action, self._last_action_t = act, now
         self._rolled_back = False
         return act
+
+    def _on_alert(self, current: dict,
+                  now: float) -> tuple[str, Action | None] | None:
+        """Arbitrate a firing bound alert.  Returns the decided
+        ``(rule, action)`` when the youngest action is young enough to
+        blame (freeze everything, undo it), or ``None`` when nobody is
+        blamable — the tick then runs in capacity-only mode instead of
+        freezing a fleet whose alert no rollback can clear."""
+        c = self.cfg
+        last = self._last_action
+        if (last is None or self._rolled_back
+                or now - self._last_action_t > c.rollback_window_s):
+            return None
+        # the youngest action plausibly caused this: undo it while the
+        # fleet heals behind a full freeze
+        for a in ACTUATORS:
+            self._cooldown_until[a] = now + c.cooldown_s
+        self._breach.clear()
+        if current.get(last.actuator) is None:
+            # count unknown: hold, but keep the blame armed so the
+            # rollback fires as soon as the actuator is readable again
+            return ("hold_on_alert", None)
+        lo, hi = c.bounds(last.actuator)
+        target = max(lo, min(hi, last.from_count))
+        cur = int(current[last.actuator])
+        self._rolled_back = True
+        if target != cur:
+            return ("rollback_on_alert",
+                    Action(last.actuator, "down" if target < cur else "up",
+                           cur, target))
+        return ("hold_on_alert", None)
 
     # -- the tick ----------------------------------------------------------
     def tick(self, signals: FleetSignals, current: dict,
@@ -234,25 +299,17 @@ class PolicyEngine:
 
         # 2. a firing bound alert: undo the youngest action while it is
         # still plausibly the cause, then freeze everything for a
-        # cooldown — the fleet heals before the controller moves again
+        # cooldown — the fleet heals before the controller moves again.
+        # With nobody to blame, the alert is the symptom of missing
+        # capacity: fall through in capacity-only mode (adds allowed,
+        # removals suppressed) instead of freezing into the deadlock
+        # fleetsim's slow_burn_slo scenario pins.
+        alert_capacity_only = False
         if signals.alerts:
-            for a in ACTUATORS:
-                self._cooldown_until[a] = now + c.cooldown_s
-            self._breach.clear()
-            last = self._last_action
-            if (last is not None and not self._rolled_back
-                    and now - self._last_action_t <= c.rollback_window_s
-                    and current.get(last.actuator) is not None):
-                lo, hi = c.bounds(last.actuator)
-                target = max(lo, min(hi, last.from_count))
-                cur = int(current[last.actuator])
-                self._rolled_back = True
-                if target != cur:
-                    act = Action(last.actuator,
-                                 "down" if target < cur else "up",
-                                 cur, target)
-                    return decide("rollback_on_alert", act)
-            return decide("hold_on_alert")
+            decided = self._on_alert(current, now)
+            if decided is not None:
+                return decide(decided[0], decided[1])
+            alert_capacity_only = True
 
         # 3. bands, fixed priority; every counter advances every tick
         # (an early actuator's action must not stall a later actuator's
@@ -295,9 +352,14 @@ class PolicyEngine:
                 continue
             lo, hi = c.bounds(actuator)
             if armed[(actuator, "up")] and cur < hi:
-                return decide(f"{actuator}_up",
-                              self._act(actuator, "up", int(cur), now))
-            if armed[(actuator, "down")] and cur > lo:
+                act = self._act(actuator, "up", int(cur), now)
+                if alert_capacity_only:
+                    # an add taken under an already-firing alert cannot
+                    # have caused it — never a rollback candidate
+                    self._rolled_back = True
+                return decide(f"{actuator}_up", act)
+            if (not alert_capacity_only
+                    and armed[(actuator, "down")] and cur > lo):
                 return decide(f"{actuator}_down",
                               self._act(actuator, "down", int(cur), now))
-        return decide("steady")
+        return decide("hold_on_alert" if alert_capacity_only else "steady")

@@ -230,15 +230,40 @@ def _snap_max(snap: dict, name: str) -> float | None:
     return max(vals) if vals else None
 
 
+_bad_journals_warned: set[str] = set()
+
+
 def _read_autopilot_last_action(run_dirs: list[str]) -> dict | None:
     """Tail the autopilot's decision journal for the last ACTION (not
     the last tick — steady/hold rows carry no action).  Best-effort:
     the journal is append-only JSONL, so reading the final few KB is
-    enough, and a missing/partial file just yields None."""
+    enough, and a missing/partial file just yields None.  The FIRST
+    line must be the ISSUE-19 ``{"schema": 1}`` header — a headerless
+    or unknown-schema journal is rejected LOUDLY (warned once per
+    path), because its decision lines may not mean what this build
+    thinks they mean."""
+    from distlr_tpu.autopilot.daemon import JOURNAL_SCHEMA  # noqa: PLC0415
+
     for d in run_dirs:
         path = os.path.join(d, "autopilot", "decisions.jsonl")
         try:
             with open(path, "rb") as f:
+                try:
+                    header = json.loads(f.readline().decode(
+                        "utf-8", "replace"))
+                except ValueError:
+                    header = None
+                if (not isinstance(header, dict)
+                        or header.get("kind") != "autopilot_decisions"
+                        or header.get("schema") != JOURNAL_SCHEMA):
+                    if path not in _bad_journals_warned:
+                        _bad_journals_warned.add(path)
+                        log.warning(
+                            "ignoring autopilot journal %s: missing or "
+                            "unknown schema header (want {\"schema\": %d, "
+                            "\"kind\": \"autopilot_decisions\"})",
+                            path, JOURNAL_SCHEMA)
+                    continue
                 f.seek(0, os.SEEK_END)
                 f.seek(max(0, f.tell() - 65536))
                 lines = f.read().decode("utf-8", "replace").splitlines()

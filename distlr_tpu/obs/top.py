@@ -170,7 +170,17 @@ def render_fleet(fleet: dict, *, color: bool = True,
     lines: list[str] = []
     tot = fleet.get("totals", {})
     updated = fleet.get("updated")
-    age = f"{max(0.0, time.time() - updated):.1f}s ago" if updated else "never"
+    if fleet.get("virtual"):
+        # a fleetsim-emitted frame: "updated" is the simulator's
+        # virtual clock, meaningless against time.time() — scrub by
+        # simulated offset instead (rate windows already difference
+        # successive "updated" stamps, so they are virtual-safe as-is)
+        age = (f"t=+{updated:.1f}s (virtual clock)" if updated is not None
+               else "never")
+    elif updated:
+        age = f"{max(0.0, time.time() - updated):.1f}s ago"
+    else:
+        age = "never"
     head = (f"distlr fleet top — {fleet.get('run_dir', '?')} — "
             f"{tot.get('up', 0)}/{tot.get('ranks', 0)} up — "
             f"{tot.get('samples_per_s', 0):,.0f} samples/s — updated {age}")

@@ -99,6 +99,71 @@ class ResizePlan:
         return sum(hi - lo for _, lo, hi, _ in self.moves)
 
 
+def plan_reshard(dim: int, old_ranges: list[tuple[int, int]],
+                 new_num_servers: int, *, alive: list[bool],
+                 allow_reuse: bool = True) -> ResizePlan:
+    """The membership planner's pure core: current layout -> equal-range
+    layout over ``new_num_servers``, as a :class:`ResizePlan`.
+
+    Extracted from :meth:`ServerGroup.plan_resize` (which now delegates
+    here after its process-level validation) so fleetsim property-tests
+    the SAME arithmetic against thousand-rank layouts without spawning a
+    single server.  ``alive[r]`` says whether old rank ``r``'s process
+    survives (a dead process can never be reused — its table is gone);
+    ``allow_reuse=False`` is the FTRL / opt_segments full-rebuild mode.
+
+    Reuse keys on a matching ``range_begin`` among alive ranks: the
+    server stores local keys rebased by range_begin, so a matching start
+    keeps every resident slot addressable — a grown range extends
+    elastically, a shrunk one simply stops being addressed.  Every key
+    of every new range is then either resident (the reused prefix) or
+    covered by exactly one move; :mod:`distlr_tpu.analysis.fleetsim`
+    pins that as the ``reshard_converged`` property.
+    """
+    if new_num_servers < 1:
+        raise ValueError(
+            f"new_num_servers must be >= 1, got {new_num_servers}")
+    if new_num_servers > dim:
+        raise ValueError(
+            f"cannot shard dim={dim} over {new_num_servers} "
+            "servers (empty ranges)")
+    if len(alive) != len(old_ranges):
+        raise ValueError(
+            f"alive has {len(alive)} entries for {len(old_ranges)} ranks")
+    S2 = int(new_num_servers)
+    new_ranges = [(dim * r // S2, dim * (r + 1) // S2) for r in range(S2)]
+    reuse: dict[int, int] = {}
+    if allow_reuse:
+        old_by_begin = {lo: r for r, (lo, _hi) in enumerate(old_ranges)
+                        if alive[r]}
+        claimed: set[int] = set()
+        for nr, (lo, _hi) in enumerate(new_ranges):
+            r = old_by_begin.get(lo)
+            if r is not None and r not in claimed:
+                reuse[nr] = r
+                claimed.add(r)
+    moves: list[tuple[int, int, int, int]] = []
+    for nr, (lo, hi) in enumerate(new_ranges):
+        res_hi = lo  # end of the resident (reused) prefix
+        if nr in reuse:
+            res_hi = min(old_ranges[reuse[nr]][1], hi)
+        if res_hi >= hi:
+            continue
+        for o, (olo, ohi) in enumerate(old_ranges):
+            mlo, mhi = max(olo, res_hi), min(ohi, hi)
+            if mlo < mhi:
+                moves.append((o, mlo, mhi, nr))
+    return ResizePlan(
+        new_num_servers=S2,
+        new_ranges=new_ranges,
+        reuse=reuse,
+        spawn=[nr for nr in range(S2) if nr not in reuse],
+        retire=[r for r in range(len(old_ranges))
+                if r not in reuse.values()],
+        moves=moves,
+    )
+
+
 class ServerGroup:
     """Spawn and manage S native KV server processes on localhost.
 
@@ -443,45 +508,10 @@ class ServerGroup:
             raise ValueError(
                 "elastic resize supports async (Hogwild) groups only — "
                 "a sync BSP round cannot straddle a membership change")
-        if new_num_servers < 1:
-            raise ValueError(
-                f"new_num_servers must be >= 1, got {new_num_servers}")
-        if new_num_servers > self.dim:
-            raise ValueError(
-                f"cannot shard dim={self.dim} over {new_num_servers} "
-                "servers (empty ranges)")
-        S2 = int(new_num_servers)
-        new_ranges = [(self.dim * r // S2, self.dim * (r + 1) // S2)
-                      for r in range(S2)]
-        reuse: dict[int, int] = {}
-        if not self.has_ftrl and not self._opt_segments:
-            old_by_begin = {lo: r for r, (lo, _hi) in enumerate(self.ranges)
-                            if self.procs[r].poll() is None}
-            claimed: set[int] = set()
-            for nr, (lo, _hi) in enumerate(new_ranges):
-                r = old_by_begin.get(lo)
-                if r is not None and r not in claimed:
-                    reuse[nr] = r
-                    claimed.add(r)
-        moves: list[tuple[int, int, int, int]] = []
-        for nr, (lo, hi) in enumerate(new_ranges):
-            res_hi = lo  # end of the resident (reused) prefix
-            if nr in reuse:
-                res_hi = min(self.ranges[reuse[nr]][1], hi)
-            if res_hi >= hi:
-                continue
-            for o, (olo, ohi) in enumerate(self.ranges):
-                mlo, mhi = max(olo, res_hi), min(ohi, hi)
-                if mlo < mhi:
-                    moves.append((o, mlo, mhi, nr))
-        return ResizePlan(
-            new_num_servers=S2,
-            new_ranges=new_ranges,
-            reuse=reuse,
-            spawn=[nr for nr in range(S2) if nr not in reuse],
-            retire=[r for r in range(self.num_servers)
-                    if r not in reuse.values()],
-            moves=moves,
+        return plan_reshard(
+            self.dim, self.ranges, new_num_servers,
+            alive=[p.poll() is None for p in self.procs],
+            allow_reuse=not self.has_ftrl and not self._opt_segments,
         )
 
     def spawn_for_resize(self, plan: ResizePlan,

@@ -1,0 +1,157 @@
+"""The router's balancing and health POLICY, extracted pure.
+
+:class:`~distlr_tpu.serve.router.ScoringRouter` grew its
+least-in-flight selection, consecutive-error ejection, probe backoff
+doubling, and reinstatement logic inline, where only a live socket
+fleet could exercise them.  ISSUE 19 pulls the decision arithmetic out
+here so the fleetsim discrete-event simulator property-tests the SAME
+policy at thousand-rank scale that the production router runs at
+replica scale — not a reimplementation that drifts.
+
+Every function takes duck-typed replica objects carrying the health
+fields of ``serve.router._Replica`` (``healthy``,
+``consecutive_errors``, ``inflight``, ``errors``, ``requests``,
+``ejections``, ``reinstates``, ``backoff_s``, ``next_probe_at``,
+``last_ok``, ``last_probe``).  Nothing here touches sockets, locks,
+metrics, or clocks — the router calls these under its health lock with
+``sync.monotonic()``; fleetsim calls them on simulated replicas with
+the virtual clock.  Side effects are confined to the replica fields
+named in each docstring.
+
+The last-healthy **ejection floor** (:func:`may_eject`) is ISSUE 19's
+router-policy fix: fleetsim's ``cascade_eject_canary`` scenario showed
+the unbounded policy ejecting every replica of a pool during a
+transient brownout, then serving nothing for a full probe-backoff
+after the fault cleared — turning a degraded tier into a total outage.
+Envoy calls the same guard an outlier-detection panic budget: the last
+healthy replica of any pool it serves stays in rotation no matter how
+it misbehaves, because a bad answer beats no answer and its
+``consecutive_errors`` keep counting — it ejects the moment a sibling
+is reinstated.
+"""
+
+from __future__ import annotations
+
+__all__ = [
+    "eject",
+    "eject_verdict",
+    "may_eject",
+    "note_failure",
+    "note_success",
+    "order_candidates",
+    "probe_due",
+    "probe_result",
+]
+
+
+def order_candidates(cands: list, rr: int) -> tuple[list, int]:
+    """Least in-flight first with a rotating tie-break, exactly the
+    ``_acquire`` ordering: advance the rotation counter, rotate, then
+    STABLE-sort by in-flight (so rotation order breaks ties and serial
+    traffic still spreads).  Returns ``(ordered, new_rr)``; an empty
+    candidate list leaves the counter untouched."""
+    if not cands:
+        return [], rr
+    rr = (rr + 1) % len(cands)
+    rotated = cands[rr:] + cands[:rr]
+    rotated.sort(key=lambda r: r.inflight)
+    return rotated, rr
+
+
+def note_success(rep, now: float) -> None:
+    """A successful exchange: the consecutive-error streak resets."""
+    rep.requests += 1
+    rep.consecutive_errors = 0
+    rep.last_ok = now
+
+
+def note_failure(rep) -> None:
+    """A transport failure: count it (the caller then consults
+    :func:`eject_verdict`)."""
+    rep.errors += 1
+    rep.consecutive_errors += 1
+
+
+def may_eject(rep, pools: list) -> bool:
+    """The ejection floor: True only if EVERY multi-replica pool in
+    ``pools`` (the replica lists of each model ``rep`` serves) keeps at
+    least one OTHER healthy replica after ``rep`` leaves rotation.
+
+    Singleton pools are exempt: the floor exists to preserve a
+    fail-over destination, and a pool of one has none — ejecting its
+    only member at least converts slow per-request dial timeouts into
+    fast ``no healthy replica`` admission errors while backoff probes
+    watch for recovery (the pinned single-replica outage semantics)."""
+    for pool in pools:
+        if len(pool) > 1 and not any(r.healthy
+                                     for r in pool if r is not rep):
+            return False
+    return True
+
+
+def eject_verdict(rep, pools: list, eject_after: int) -> str:
+    """Arbitrate one failure streak: ``"keep"`` below the threshold,
+    ``"eject"`` at/over it, ``"floor"`` when only the last-healthy
+    budget blocks the ejection (callers surface that loudly — a
+    suppressed ejection is a pool running on its last replica)."""
+    if not rep.healthy or rep.consecutive_errors < eject_after:
+        return "keep"
+    return "eject" if may_eject(rep, pools) else "floor"
+
+
+def eject(rep, now: float, probe_backoff_s: float) -> None:
+    """Take ``rep`` out of rotation and arm the first backoff probe.
+    Pure state transition — the router adds metrics/logging and drains
+    the connection pool around it."""
+    rep.healthy = False
+    rep.ejections += 1
+    rep.backoff_s = probe_backoff_s
+    rep.next_probe_at = now + rep.backoff_s
+
+
+def probe_result(rep, ok: bool, now: float, *, probe_backoff_s: float,
+                 probe_backoff_max_s: float, eject_after: int,
+                 pools: list) -> str:
+    """Fold one active health-probe outcome into the replica's state.
+
+    Returns what happened: ``"reinstated"`` (ejected replica back in
+    rotation), ``"ok"`` (healthy confirmed), ``"counted"`` (failure
+    toward ejection), ``"ejected"``, ``"floor"`` (threshold crossed
+    but the last-healthy budget held it), or ``"backoff"`` (ejected
+    replica still down — backoff doubled, capped)."""
+    rep.last_probe = now
+    if ok:
+        rep.consecutive_errors = 0
+        rep.last_ok = now
+        rep.backoff_s = 0.0
+        if not rep.healthy:
+            rep.healthy = True
+            rep.reinstates += 1
+            return "reinstated"
+        return "ok"
+    if rep.healthy:
+        note_failure(rep)
+        verdict = eject_verdict(rep, pools, eject_after)
+        if verdict == "eject":
+            eject(rep, now, probe_backoff_s)
+            return "ejected"
+        return "floor" if verdict == "floor" else "counted"
+    rep.backoff_s = min(max(rep.backoff_s * 2, probe_backoff_s),
+                        probe_backoff_max_s)
+    rep.next_probe_at = now + rep.backoff_s
+    return "backoff"
+
+
+def probe_due(rep, now: float, health_interval_s: float,
+              probe_backoff_s: float) -> bool:
+    """The health loop's due computation: healthy replicas probe when
+    neither traffic nor a probe confirmed them for an interval; ejected
+    replicas probe on their backoff schedule.  When an ejected
+    replica's probe comes due the NEXT slot is pre-pushed, so a
+    fast-failing probe cannot hot-loop inside one backoff window."""
+    if rep.healthy:
+        return now - max(rep.last_ok, rep.last_probe) >= health_interval_s
+    due = now >= rep.next_probe_at
+    if due:
+        rep.next_probe_at = now + max(rep.backoff_s, probe_backoff_s)
+    return due

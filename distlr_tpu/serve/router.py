@@ -64,6 +64,7 @@ import socketserver
 from distlr_tpu import sync
 from distlr_tpu.obs import dtrace
 from distlr_tpu.obs.registry import get_registry
+from distlr_tpu.serve import balance as _balance
 from distlr_tpu.serve import tenant as _tenant
 from distlr_tpu.utils.logging import get_logger
 
@@ -111,6 +112,13 @@ _EJECTIONS = _reg.counter(
 _REINSTATES = _reg.counter(
     "distlr_route_reinstates_total",
     "ejected replicas reinstated by a successful backoff probe",
+    labelnames=("replica",),
+)
+_EJECT_SUPPRESSED = _reg.counter(
+    "distlr_route_eject_suppressed_total",
+    "ejections suppressed by the last-healthy floor (the replica "
+    "crossed eject_after consecutive failures but is the only healthy "
+    "replica left in one of its model pools)",
     labelnames=("replica",),
 )
 _LABELS = _reg.counter(
@@ -406,12 +414,10 @@ class ScoringRouter:
                     else self._model_replicas.get(model, []))
             cands = [r for r in pool
                      if r.healthy and r not in excluded]
-            if not cands:
-                return None
-            self._rr = (self._rr + 1) % len(cands)
-            cands = cands[self._rr:] + cands[:self._rr]
-            cands.sort(key=lambda r: r.inflight)  # stable: rotation = tie-break
-            for rep in cands:
+            # least in-flight + rotating tie-break: the policy ordering
+            # lives in serve.balance (fleetsim runs the same function)
+            ordered, self._rr = _balance.order_candidates(cands, self._rr)
+            for rep in ordered:
                 if rep.try_acquire():
                     return rep
             return None
@@ -422,28 +428,48 @@ class ScoringRouter:
 
     def _note_success(self, rep: _Replica) -> None:
         with self._lock:
-            rep.requests += 1
-            rep.consecutive_errors = 0
-            rep.last_ok = sync.monotonic()
+            _balance.note_success(rep, sync.monotonic())
 
     def _note_failure(self, rep: _Replica) -> None:
         with self._lock:
-            rep.errors += 1
-            rep.consecutive_errors += 1
-            if rep.healthy and rep.consecutive_errors >= self.eject_after:
+            _balance.note_failure(rep)
+            verdict = _balance.eject_verdict(rep, self._pools_locked(rep),
+                                             self.eject_after)
+            if verdict == "eject":
                 self._eject_locked(rep)
+            elif verdict == "floor":
+                self._floor_locked(rep)
+
+    def _pools_locked(self, rep: _Replica) -> list:
+        """The replica lists of every model ``rep`` serves — what the
+        last-healthy ejection floor arbitrates over."""
+        return [self._model_replicas.get(m, []) for m in sorted(rep.models)]
 
     def _eject_locked(self, rep: _Replica) -> None:
-        rep.healthy = False
-        rep.ejections += 1
-        rep.backoff_s = self.probe_backoff_s
-        rep.next_probe_at = sync.monotonic() + rep.backoff_s
+        _balance.eject(rep, sync.monotonic(), self.probe_backoff_s)
+        self._post_eject_locked(rep)
+
+    def _post_eject_locked(self, rep: _Replica) -> None:
+        """The effectful half of an ejection (state transition already
+        applied by :mod:`~distlr_tpu.serve.balance`)."""
         rep._up_g.set(0.0)
         _EJECTIONS.labels(replica=rep.addr).inc()
         log.warning("replica %s ejected after %d consecutive failures; "
                     "probing with %.2fs backoff", rep.addr,
                     rep.consecutive_errors, rep.backoff_s)
         rep.drain_pool()  # pooled sockets to a suspect replica are suspect
+
+    def _floor_locked(self, rep: _Replica) -> None:
+        """The ejection the last-healthy floor suppressed (ISSUE 19:
+        fleetsim's cascade counterexample): keep the replica in
+        rotation, count it, and warn once per streak threshold."""
+        _EJECT_SUPPRESSED.labels(replica=rep.addr).inc()
+        if rep.consecutive_errors == self.eject_after:
+            log.warning(
+                "replica %s crossed the eject threshold (%d consecutive "
+                "failures) but is the LAST healthy replica of a pool it "
+                "serves; keeping it in rotation (ejection floor)",
+                rep.addr, rep.consecutive_errors)
 
     def _probe(self, rep: _Replica) -> bool:
         """Active health check: a STATS round trip on a fresh connection.
@@ -470,27 +496,20 @@ class ScoringRouter:
         except OSError:
             ok = False
         with self._lock:
-            rep.last_probe = sync.monotonic()
-            if ok:
-                rep.consecutive_errors = 0
-                rep.last_ok = rep.last_probe
-                rep.backoff_s = 0.0
-                if not rep.healthy:
-                    rep.healthy = True
-                    rep.reinstates += 1
-                    rep._up_g.set(1.0)
-                    _REINSTATES.labels(replica=rep.addr).inc()
-                    log.info("replica %s reinstated", rep.addr)
-            elif rep.healthy:
-                rep.errors += 1
-                rep.consecutive_errors += 1
-                if rep.consecutive_errors >= self.eject_after:
-                    self._eject_locked(rep)
-            else:
-                rep.backoff_s = min(max(rep.backoff_s * 2,
-                                        self.probe_backoff_s),
-                                    self.probe_backoff_max_s)
-                rep.next_probe_at = rep.last_probe + rep.backoff_s
+            outcome = _balance.probe_result(
+                rep, ok, sync.monotonic(),
+                probe_backoff_s=self.probe_backoff_s,
+                probe_backoff_max_s=self.probe_backoff_max_s,
+                eject_after=self.eject_after,
+                pools=self._pools_locked(rep))
+            if outcome == "reinstated":
+                rep._up_g.set(1.0)
+                _REINSTATES.labels(replica=rep.addr).inc()
+                log.info("replica %s reinstated", rep.addr)
+            elif outcome == "ejected":
+                self._post_eject_locked(rep)
+            elif outcome == "floor":
+                self._floor_locked(rep)
         return ok
 
     def _health_loop(self) -> None:
@@ -500,16 +519,9 @@ class ScoringRouter:
             # snapshot: ADDREPLICA/DELREPLICA mutate the list mid-run
             for rep in list(self.replicas):
                 with self._lock:
-                    if rep.healthy:
-                        due = (now - max(rep.last_ok, rep.last_probe)
-                               >= self.health_interval_s)
-                    else:
-                        due = now >= rep.next_probe_at
-                        if due:
-                            # pre-push the next slot so a fast-failing
-                            # probe cannot hot-loop inside one backoff
-                            rep.next_probe_at = now + max(
-                                rep.backoff_s, self.probe_backoff_s)
+                    due = _balance.probe_due(rep, now,
+                                             self.health_interval_s,
+                                             self.probe_backoff_s)
                 if due:
                     self._probe(rep)
 

@@ -270,12 +270,67 @@ class TestPolicyEngine:
         d = p.tick(sig(alerts=("distlr_alert_x{}",)), cur(engine=2), 50.0)
         assert d.rule == "hold_on_alert" and d.action is None
 
-    def test_alert_freezes_every_actuator_for_a_cooldown(self):
+    def test_blamable_alert_freezes_every_actuator_for_a_cooldown(self):
         p = PolicyEngine(PolicyConfig(hysteresis_ticks=1, cooldown_s=10.0))
-        p.tick(sig(alerts=("distlr_alert_x{}",)), cur(), 0.0)
-        d = p.tick(sig(shed_rate=10.0), cur(), 1.0)
+        d = p.tick(sig(shed_rate=10.0), cur(), 0.0)
+        assert d.rule == "engine_up"
+        d = p.tick(sig(alerts=("distlr_alert_x{}",)), cur(engine=3), 1.0)
+        assert d.rule == "rollback_on_alert"
+        d = p.tick(sig(shed_rate=10.0), cur(), 2.0)
         assert d.rule == "steady"
         assert all(d.holding[a] for a in ACTUATORS)
+
+    def test_unattributed_alert_still_allows_capacity_adds(self):
+        # fleetsim slow_burn_slo: the SLO burn alert fires with no
+        # recent action to blame.  The pre-fix policy froze every
+        # actuator on EVERY alert tick — the engine add that would
+        # clear the burn could never happen.  Capacity-only mode lets
+        # the up-band fire; the add is not a rollback candidate.
+        p = PolicyEngine(PolicyConfig(hysteresis_ticks=1, cooldown_s=10.0))
+        alert = ("distlr_alert_slo_burn{}",)
+        d = p.tick(sig(alerts=alert, shed_rate=10.0), cur(), 0.0)
+        assert d.rule == "engine_up"
+        assert d.action.to_doc() == {"actuator": "engine",
+                                     "direction": "up", "from": 2, "to": 3}
+        d = p.tick(sig(alerts=alert, shed_rate=10.0), cur(engine=3), 1.0)
+        assert d.rule == "hold_on_alert"   # never rolls back its own add
+        assert d.action is None
+
+    def test_unattributed_alert_suppresses_scale_down(self):
+        # an alert with nobody to blame must not be answered by
+        # REMOVING capacity, however idle the fleet looks
+        p = PolicyEngine(PolicyConfig(hysteresis_ticks=1, cooldown_s=0.0))
+        alert = ("distlr_alert_x{}",)
+        for t in range(3):
+            d = p.tick(sig(alerts=alert, shed_rate=0.0, req_rate=1.0),
+                       cur(), float(t))
+            assert d.rule == "hold_on_alert"
+            assert d.action is None
+        # the moment the alert clears, the armed down-counter fires
+        d = p.tick(sig(shed_rate=0.0, req_rate=1.0), cur(), 3.0)
+        assert d.rule == "engine_down"
+
+    def test_flap_reversal_escalates_the_cooldown(self):
+        # fleetsim autopilot_resonance: load between the thresholds of
+        # adjacent counts drives up/down/up at the cooldown cadence.
+        # Each reversal doubles the next cooldown (2**streak, capped).
+        p = PolicyEngine(PolicyConfig(hysteresis_ticks=1, cooldown_s=10.0))
+        d = p.tick(sig(shed_rate=10.0), cur(), 0.0)
+        assert d.rule == "engine_up"
+        assert p._cooldown_until["engine"] == 10.0       # streak 0
+        d = p.tick(sig(shed_rate=0.0, req_rate=1.0), cur(engine=3), 10.0)
+        assert d.rule == "engine_down"                   # reversal
+        assert p._cooldown_until["engine"] == 30.0       # 10 + 10*2
+        d = p.tick(sig(shed_rate=10.0), cur(), 30.0)
+        assert d.rule == "engine_up"                     # reversal again
+        assert p._cooldown_until["engine"] == 70.0       # 30 + 10*4
+
+    def test_same_direction_ramp_never_pays_the_flap_penalty(self):
+        p = PolicyEngine(PolicyConfig(hysteresis_ticks=1, cooldown_s=10.0))
+        for i, t in enumerate((0.0, 10.0, 20.0)):
+            d = p.tick(sig(shed_rate=10.0), cur(engine=2 + i), t)
+            assert d.rule == "engine_up"
+            assert p._cooldown_until["engine"] == t + 10.0
 
     def test_journal_schema_and_byte_identical_determinism(self):
         seq = [
@@ -424,8 +479,8 @@ class TestDaemon:
         assert d.status()["errors"] == 1
         assert _counter_total("distlr_autopilot_errors_total") == errors0 + 1
         # and the failure is on the journal line, not swallowed
-        doc = json.loads(
-            (tmp_path / "autopilot" / "decisions.jsonl").read_text())
+        doc = AutopilotDaemon.read_journal(
+            str(tmp_path / "autopilot" / "decisions.jsonl"))[-1]
         assert doc["outcome"].startswith("error:")
 
     def test_journal_carries_every_tick_and_action(self, tmp_path):
@@ -438,14 +493,37 @@ class TestDaemon:
         for _ in range(3):
             d.tick_once()
             clock.t += 1.0
-        lines = (tmp_path / "autopilot" /
-                 "decisions.jsonl").read_text().splitlines()
-        docs = [json.loads(line) for line in lines]
+        path = tmp_path / "autopilot" / "decisions.jsonl"
+        first = json.loads(path.read_text().splitlines()[0])
+        assert first == {"schema": 1, "kind": "autopilot_decisions"}
+        docs = AutopilotDaemon.read_journal(str(path))
         assert [doc["rule"] for doc in docs] == [
             "steady", "worker_up", "steady"]
         acted = [doc for doc in docs if doc["action"]]
         assert len(acted) == d.status()["actions"] == 1
         assert acted[0]["outcome"] == "set worker=2"
+
+    def test_read_journal_rejects_headerless_and_unknown_schema(
+            self, tmp_path):
+        # the ISSUE-19 pin: a journal written by a pre-header build (or
+        # a future schema) must fail LOUDLY, not misparse
+        headerless = tmp_path / "old.jsonl"
+        headerless.write_text(json.dumps({"rule": "steady"}) + "\n")
+        with pytest.raises(ValueError, match="autopilot_decisions"):
+            AutopilotDaemon.read_journal(str(headerless))
+        future = tmp_path / "future.jsonl"
+        future.write_text(json.dumps(
+            {"schema": 99, "kind": "autopilot_decisions"}) + "\n")
+        with pytest.raises(ValueError, match="schema 99"):
+            AutopilotDaemon.read_journal(str(future))
+        # a torn tail (live daemon mid-append) only truncates
+        good = tmp_path / "good.jsonl"
+        good.write_text(
+            json.dumps({"schema": 1, "kind": "autopilot_decisions"}) + "\n"
+            + json.dumps({"rule": "steady", "action": None}) + "\n"
+            + '{"rule": "engi')
+        assert [d["rule"] for d in
+                AutopilotDaemon.read_journal(str(good))] == ["steady"]
 
     def test_seed_rates_from_history_primes_the_first_tick(self, tmp_path):
         with open(tmp_path / "history.jsonl", "w") as f:
@@ -803,9 +881,8 @@ class TestAutopilotAcceptance:
             assert status["errors"] == 0, status
 
             # the controller breathed: up into the peak, down after it
-            docs = [json.loads(line) for line in
-                    (tmp_path / "autopilot" /
-                     "decisions.jsonl").read_text().splitlines()]
+            docs = AutopilotDaemon.read_journal(
+                str(tmp_path / "autopilot" / "decisions.jsonl"))
             acted = [doc for doc in docs if doc["action"]]
             assert status["actions"] >= 2, status
             dirs = {a["action"]["direction"] for a in acted}
@@ -883,9 +960,8 @@ class TestAutopilotAcceptance:
                     with KVWorker(g.hosts, D, sync_group=False) as kv:
                         np.testing.assert_array_equal(
                             kv.pull(), np.arange(D, dtype=np.float32))
-                    docs = [json.loads(line) for line in
-                            (tmp_path / "autopilot" /
-                             "decisions.jsonl").read_text().splitlines()]
+                    docs = AutopilotDaemon.read_journal(
+                        str(tmp_path / "autopilot" / "decisions.jsonl"))
                     assert [doc["rule"] for doc in docs] == [
                         "ps_up", "worker_up", "worker_down"]
                     assert all(not doc["outcome"].startswith("error")

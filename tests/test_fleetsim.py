@@ -1,0 +1,285 @@
+"""Fleetsim acceptance suite (ISSUE 19 tentpole).
+
+The four acceptance criteria, pinned as tier-1 tests:
+
+* **determinism** — same seed + scenario ⇒ byte-identical event log,
+  digest, and property verdicts, run twice back to back, and every
+  seed-0 digest matches the table pinned in
+  ``analysis/fleetsim/mutants.py`` (drift is a reviewable diff);
+* **scale** — the partition-heal scenario drives >= 1000 simulated
+  workers through the REAL joiner/spool and autopilot classes and
+  completes in seconds on a CPU;
+* **found bugs stay found** — all three policy-bug mutants (ejection
+  floor, alert freeze, flap damping) rediscover their pinned
+  counterexample with the fix reverted and stay CLEAN with it in
+  place;
+* **integration** — replay ids parse loudly, the CLI round-trips
+  them, the banked history scrubs through ``launch top --replay`` on
+  the virtual clock, and the lint pass is registered.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import time
+import types
+
+import pytest
+
+from distlr_tpu.analysis.fleetsim import EventLoop, props
+from distlr_tpu.analysis.fleetsim.__main__ import main as fleetsim_main
+from distlr_tpu.analysis.fleetsim.mutants import (
+    EXPECTED_DIGESTS,
+    MUTANTS,
+    verify_mutant,
+)
+from distlr_tpu.analysis.fleetsim.scenarios import (
+    SCENARIOS,
+    parse_replay_id,
+    run_scenario,
+)
+from distlr_tpu.ps.server import plan_reshard
+from distlr_tpu.traffic import ZipfSampler
+
+pytestmark = pytest.mark.filterwarnings("ignore::DeprecationWarning")
+
+
+@pytest.fixture(autouse=True)
+def _quiet(caplog):
+    import logging
+
+    logging.disable(logging.WARNING)
+    yield
+    logging.disable(logging.NOTSET)
+
+
+# ---------------------------------------------------------------------------
+# the event loop
+# ---------------------------------------------------------------------------
+
+
+class TestEventLoop:
+    def test_ties_break_on_insertion_order(self):
+        loop = EventLoop(0)
+        seen: list[str] = []
+        loop.at(1.0, lambda: seen.append("first"))
+        loop.at(1.0, lambda: seen.append("second"))
+        loop.at(0.5, lambda: seen.append("early"))
+        loop.run(2.0)
+        assert seen == ["early", "first", "second"]
+        assert loop.now == 2.0
+
+    def test_the_past_is_immutable(self):
+        loop = EventLoop(0)
+        loop.run(5.0)
+        fired_at: list[float] = []
+        loop.at(1.0, lambda: fired_at.append(loop.now))
+        loop.run(10.0)
+        assert fired_at == [5.0]  # clamped to now, never backwards
+
+    def test_every_is_a_fixed_grid(self):
+        loop = EventLoop(0)
+        ticks: list[float] = []
+        loop.every(2.0, lambda: ticks.append(loop.now), until=7.0)
+        loop.run(7.0)
+        assert ticks == [2.0, 4.0, 6.0]
+
+    def test_digest_covers_the_log(self):
+        a, b = EventLoop(0), EventLoop(0)
+        for lp in (a, b):
+            lp.log("x", v=1.5)
+        assert a.digest() == b.digest()
+        b.log("x", v=1.6)
+        assert a.digest() != b.digest()
+
+
+# ---------------------------------------------------------------------------
+# determinism + clean verdicts + scale (the tier-1 acceptance bars)
+# ---------------------------------------------------------------------------
+
+
+class TestScenarios:
+    @pytest.mark.parametrize("name", sorted(SCENARIOS))
+    def test_clean_deterministic_and_pinned(self, name):
+        """Every scenario, twice: zero violations with the fixed
+        policies, byte-identical logs, and the seed-0 digest matching
+        the pinned table."""
+        a = run_scenario(name, 0)
+        b = run_scenario(name, 0)
+        assert a.violations == [], a.violations
+        assert a.lines == b.lines
+        assert a.digest == b.digest
+        assert a.digest == EXPECTED_DIGESTS[name], (
+            f"{name}: digest {a.digest} != pinned — the simulated "
+            "fleet drifted; re-pin EXPECTED_DIGESTS deliberately")
+
+    def test_seed_changes_the_tape(self):
+        assert (run_scenario("partition_heal_1000", 0).digest
+                != run_scenario("partition_heal_1000", 7).digest)
+
+    def test_thousand_workers_in_seconds(self):
+        """The scale criterion: 1000 simulated workers through the
+        REAL joiner/spool/autopilot classes, wall-bounded (generously
+        — it runs in well under a second; the bound catches an
+        accidentally quadratic rejoin path)."""
+        t0 = time.monotonic()
+        res = run_scenario("partition_heal_1000", 0)
+        wall = time.monotonic() - t0
+        assert res.summary["workers_joined"] == 1000
+        assert res.summary["rejoin_events"] == 1000
+        assert res.violations == []
+        assert wall < 30.0, f"1000-worker scenario took {wall:.1f}s"
+
+    def test_summary_and_verdict_are_inside_the_digest(self):
+        res = run_scenario("cascade_eject_canary", 0)
+        assert any(l.split(" ", 2)[1] == "summary" for l in res.lines)
+        assert any(l.split(" ", 2)[1] == "verdict" for l in res.lines)
+
+
+# ---------------------------------------------------------------------------
+# the three found-by-fleetsim bugs, pinned as mutants
+# ---------------------------------------------------------------------------
+
+
+class TestMutants:
+    @pytest.mark.parametrize("name", sorted(MUTANTS))
+    def test_fix_reverted_is_rediscovered(self, name):
+        """Full acceptance per mutant: clean at the pinned digest with
+        the fix, the expected violation class without it, and a
+        byte-identical re-run of the counterexample."""
+        assert verify_mutant(name) == []
+
+    def test_mutants_cover_three_distinct_policies(self):
+        """The ISSUE-19 bar: >= 3 distinct policy bugs found, fixed,
+        and pinned — one per control-plane seam, not three flavors of
+        the same bug."""
+        seams = {m.target[0] if isinstance(m.target[0], types.ModuleType)
+                 else m.target[1] for m in MUTANTS.values()}
+        assert len(MUTANTS) >= 3
+        assert len(seams) == len(MUTANTS)
+        assert len({m.scenario for m in MUTANTS.values()}) == len(MUTANTS)
+
+
+# ---------------------------------------------------------------------------
+# replay ids + CLI + top integration
+# ---------------------------------------------------------------------------
+
+
+class TestReplay:
+    def test_replay_id_round_trip(self):
+        res = run_scenario("autopilot_resonance", 3)
+        assert res.replay_id == "fleetsim:autopilot_resonance:3"
+        assert parse_replay_id(res.replay_id) == ("autopilot_resonance", 3)
+
+    @pytest.mark.parametrize("bad", [
+        "autopilot_resonance:0",
+        "fleetsim:no_such_scenario:0",
+        "fleetsim:autopilot_resonance:zero",
+        "schedule:thing",
+    ])
+    def test_bad_replay_ids_are_loud(self, bad):
+        with pytest.raises(ValueError, match="replay id|fleetsim"):
+            parse_replay_id(bad)
+
+    def test_cli_replays_a_pinned_id(self, capsys):
+        rc = fleetsim_main(["--replay", "fleetsim:cascade_eject_canary:0",
+                            "--json"])
+        doc = json.loads(capsys.readouterr().out)
+        assert rc == 0
+        assert doc["violations"] == []
+        assert doc["digest"] == EXPECTED_DIGESTS["cascade_eject_canary"]
+
+    def test_cli_rejects_garbage(self, capsys):
+        assert fleetsim_main(["--replay", "fleetsim:nope:0"]) == 2
+        assert fleetsim_main(["--scenario", "nope"]) == 2
+        assert fleetsim_main(["--history", "/tmp/x.jsonl"]) == 2
+        capsys.readouterr()
+
+    def test_banked_history_scrubs_in_top_on_the_virtual_clock(
+            self, tmp_path, capsys):
+        """ISSUE 19 satellite: the simulated fleet.json frames render
+        through the REAL `launch top --replay` path, with ages shown
+        as virtual offsets instead of wall-clock deltas."""
+        from distlr_tpu.obs.top import run_top_replay
+
+        path = str(tmp_path / "history.jsonl")
+        rc = fleetsim_main(["--scenario", "slow_burn_slo",
+                            "--history", path])
+        assert rc == 0
+        capsys.readouterr()
+        out = io.StringIO()
+        assert run_top_replay(path, color=False, out=out) == 0
+        text = out.getvalue()
+        assert "(virtual clock)" in text
+        assert "fleetsim:slow_burn_slo" in text
+        assert "replayed" in text
+
+    def test_lint_pass_is_registered(self):
+        from distlr_tpu.analysis.__main__ import PASSES, run_pass
+
+        assert "fleetsim" in PASSES
+        assert run_pass("fleetsim") == []
+
+
+# ---------------------------------------------------------------------------
+# property checks as a unit table
+# ---------------------------------------------------------------------------
+
+
+def _stub_fleet(**kw):
+    ns = types.SimpleNamespace(**kw)
+    if hasattr(ns, "_actions"):
+        ns.actions = lambda: ns._actions
+    return ns
+
+
+def _action(actuator, direction):
+    return {"action": {"actuator": actuator, "direction": direction}}
+
+
+class TestProps:
+    def test_no_flapping_counts_reversals(self):
+        fleet = _stub_fleet(_actions=[
+            _action("engine", "up"), _action("engine", "down"),
+            _action("engine", "up"), _action("ps", "down")])
+        assert props.no_flapping(fleet, actuator="engine",
+                                 max_reversals=2) == []
+        out = props.no_flapping(fleet, actuator="engine", max_reversals=1)
+        assert out and "reversed direction 2x" in out[0]
+
+    def test_zero_failed_accepted_honors_the_fault_window(self):
+        fleet = _stub_fleet(router=types.SimpleNamespace(
+            error_ticks=[(10.0, 5.0), (20.0, 3.0)]))
+        assert props.zero_failed_accepted(fleet, allowed_until=20.0) == []
+        out = props.zero_failed_accepted(fleet, allowed_until=15.0)
+        assert out and "3.0 requests failed" in out[0]
+
+    def test_reshard_converged_accepts_the_real_planner(self):
+        dim = 1 << 12
+        old = [(i * (dim // 64), (i + 1) * (dim // 64)) for i in range(64)]
+        plan = plan_reshard(dim, old, 96, alive=[True] * 64)
+        z = ZipfSampler(dim, 1.05)
+        assert props.reshard_converged(
+            plan, dim, old, sampler=z, max_hot_share=1.0) == []
+
+    def test_reshard_converged_catches_a_corrupt_plan(self):
+        dim = 1 << 12
+        old = [(i * (dim // 64), (i + 1) * (dim // 64)) for i in range(64)]
+        plan = plan_reshard(dim, old, 96, alive=[True] * 64)
+        broken = [m for m in plan.moves][:-1]  # drop one move: a gap
+        bad = types.SimpleNamespace(
+            moves=broken, new_ranges=plan.new_ranges, reuse=plan.reuse)
+        out = props.reshard_converged(bad, dim, old)
+        assert out and any("covered to" in v or "gap" in v for v in out)
+
+    def test_slo_budget_held_requires_summaries(self):
+        fleet = _stub_fleet(slo_summaries=[])
+        assert props.slo_budget_held(fleet)
+        fleet = _stub_fleet(slo_summaries=[
+            {"name": "x", "budget_remaining": 0.4}])
+        assert props.slo_budget_held(fleet) == []
+        fleet = _stub_fleet(slo_summaries=[
+            {"name": "x", "budget_remaining": -0.2}])
+        out = props.slo_budget_held(fleet)
+        assert out and "exhausted" in out[0]
