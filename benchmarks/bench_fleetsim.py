@@ -94,7 +94,7 @@ def main() -> int:
 
     sub = bench_sweep()
     row = {
-        "metric": ("fleetsim sweep: six fleet scenarios (incl. 1000 "
+        "metric": ("fleetsim sweep: seven fleet scenarios (incl. 1000 "
                    "simulated workers) through the real control-plane "
                    "policies — simulated rank-seconds per wall-second"),
         "value": sub["rank_seconds_per_wall_s"],

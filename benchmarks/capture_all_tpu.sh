@@ -175,6 +175,17 @@ DISTLR_METRICS_SNAPSHOT="benchmarks/capture_logs/fleet/snapshots/incident-0.json
   && echo "bench_incident ok (bundle -> benchmarks/capture_logs/incident/run/incidents/)" \
   || echo "bench_incident failed (non-fatal; artifact not refreshed)"
 
+echo "== bench_recovery.py (durable-store DR drill: measured RTO/RPO; best-effort) =="
+# Disaster-recovery row (ISSUE 20): a real 2-rank async group with the
+# durable store armed is SIGKILLed whole mid-push and cold-restarted
+# from disk — once snapshot-only (loss bounded by the interval), once
+# with the push WAL (push-clock audit proves ZERO acked pushes lost).
+DISTLR_METRICS_SNAPSHOT="benchmarks/capture_logs/fleet/snapshots/recovery-0.json" \
+  timeout 900 python -u benchmarks/bench_recovery.py \
+  > benchmarks/capture_logs/bench_recovery.json \
+  && echo "bench_recovery ok" \
+  || echo "bench_recovery failed (non-fatal; artifact not refreshed)"
+
 echo "== bank the fleet metrics snapshot (merged view; best-effort) =="
 # Federates every snapshot banked into the window's fleet dir (today:
 # bench.py; any --obs-run-dir'd process that joins a future window rides

@@ -62,6 +62,7 @@ EXPECTED_DIGESTS: dict[str, str] = {
     "autopilot_resonance": "8a27b240d189726b",
     "slow_burn_slo": "f433f00e7d368a8b",
     "standby_exhaustion": "27fa5c1582a81512",
+    "power_loss_durable": "69dcd9fcc6a72fc1",
 }
 
 

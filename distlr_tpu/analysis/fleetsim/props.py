@@ -15,6 +15,8 @@ __all__ = [
     "no_flapping",
     "rank_seconds_bounded",
     "reshard_converged",
+    "rpo_bounded",
+    "rto_bounded",
     "slo_budget_held",
     "zero_failed_accepted",
 ]
@@ -87,6 +89,51 @@ def all_rejoined(fleet, *, deadline_s: float) -> list[str]:
     if fleet.shard_lag > fleet.p.shard_inflow_rate:
         out.append(f"all_rejoined: shard backlog {fleet.shard_lag:.1f} "
                    f"not drained by t={deadline_s:.0f}s")
+    return out
+
+
+def rto_bounded(fleet, *, max_rto_s: float) -> list[str]:
+    """Recovery-Time Objective: the span from a whole-fleet power loss
+    to the LAST rank back in service stays under the bound (the
+    power_loss_durable scenario writes ``fleet.dr``).  A fleet that
+    never fully recovers is the worst violation, not a vacuous pass."""
+    dr = getattr(fleet, "dr", None)
+    if not dr:
+        return ["rto_bounded: the fleet has no DR record — the power "
+                "loss never ran"]
+    if dr["rto_s"] is None:
+        down = [i for i, r in enumerate(dr["ranks"]) if not r["up"]]
+        return [f"rto_bounded: the fleet never fully recovered "
+                f"(ranks still down: {down})"]
+    if dr["rto_s"] > max_rto_s:
+        return [f"rto_bounded: RTO {dr['rto_s']:.1f}s > bound "
+                f"{max_rto_s:.1f}s"]
+    return []
+
+
+def rpo_bounded(fleet) -> list[str]:
+    """Recovery-Point Objective, per rank against its durability mode
+    (the ISSUE-20 contract): a WAL rank loses ZERO applied pushes; a
+    snapshot-only rank loses at most one snapshot interval's worth; a
+    rank whose newest generation was torn by the cut falls back ONE
+    generation — at most two intervals lost, never a refusal to start
+    and never a silent restore of the corrupt file.  The scenario bakes
+    each rank's bound (``rpo_bound``) from its mode and the live push
+    rate at the moment of the cut."""
+    dr = getattr(fleet, "dr", None)
+    if not dr:
+        return ["rpo_bounded: the fleet has no DR record — the power "
+                "loss never ran"]
+    out = []
+    for i, r in enumerate(dr["ranks"]):
+        if r["lost"] is None:
+            out.append(f"rpo_bounded: rank {i} has no loss record — it "
+                       "never lost power")
+            continue
+        if r["lost"] > r["rpo_bound"] + 1e-9:
+            out.append(
+                f"rpo_bounded: rank {i} ({r['mode']}) lost "
+                f"{r['lost']:.1f} pushes > bound {r['rpo_bound']:.1f}")
     return out
 
 

@@ -255,6 +255,123 @@ def _standby_exhaustion(loop: EventLoop):
     ]
 
 
+def _power_loss_durable(loop: EventLoop):
+    """The whole PS group loses power mid-push (ISSUE 20's DR drill at
+    fleet scale): every rank dies at the same instant, cold-restarts
+    from its durable store, and resumes at its persisted push clock.
+    Odd ranks run the push WAL (durable clock tracks the applied clock
+    — RPO 0); even ranks are snapshot-only (loss bounded by one
+    snapshot interval); and rank 0's NEWEST snapshot generation is torn
+    by the cut mid-write, so its recovery must fall back one generation
+    (the 2-generation design: loss bounded by TWO intervals, never a
+    refusal to start, never a silent restore of the corrupt file).
+    RTO is the span from the cut to the LAST rank back."""
+    p = FleetParams(engines=2, workers=256, ps=8, ps_dim=1 << 14,
+                    duration_s=120.0, base_qps=20.0, peak_qps=30.0,
+                    autopilot=False, slo=False)
+    fleet = SimFleet(loop, p, "power_loss_durable")
+    interval_s = 5.0
+    # mid-interval on purpose: a cut ON a snapshot boundary loses
+    # nothing and proves nothing (the losses_realistic prop pins this)
+    t_kill = 62.7
+    ranks = [{
+        "mode": "wal" if r % 2 else "snap",
+        "applied": 0.0,            # the rank's push clock
+        "snapshots": [0.0, 0.0],   # the 2 on-disk generations (clocks)
+        "durable": 0.0,            # what a cold restart recovers to
+        "up": True,
+        "recovered_at": None,
+        "lost": None,
+        "rpo_bound": None,
+    } for r in range(p.ps)]
+    dr = {"t_kill": t_kill, "interval_s": interval_s, "ranks": ranks,
+          "rto_s": None, "rate_per_rank": 0.0}
+    fleet.dr = dr
+
+    def push_tick():
+        rate = fleet.workers.push_rate() / p.ps
+        dr["rate_per_rank"] = rate
+        for r in ranks:
+            if r["up"]:
+                r["applied"] += rate * p.tick_s
+                if r["mode"] == "wal":
+                    # group-commit fsync (default 0.1s) << tick: the
+                    # WAL's durable clock tracks the applied clock
+                    r["durable"] = r["applied"]
+
+    def snapshot_tick():
+        for r in ranks:
+            if r["up"]:
+                r["snapshots"] = [r["snapshots"][1], r["applied"]]
+                if r["mode"] == "snap":
+                    r["durable"] = r["applied"]
+        loop.log("store_snapshot", clock=_r(ranks[0]["applied"]))
+
+    loop.every(p.tick_s, push_tick, until=p.duration_s)
+    loop.every(interval_s, snapshot_tick, until=p.duration_s)
+
+    def recover(i: int):
+        r = ranks[i]
+        r["up"] = True
+        r["applied"] = r["durable"]
+        r["recovered_at"] = loop.now
+        loop.log("rank_recovered", rank=i, mode=r["mode"],
+                 clock=_r(r["applied"]), lost=_r(r["lost"]))
+        if all(x["up"] for x in ranks):
+            dr["rto_s"] = loop.now - t_kill
+            fleet.workers.joined = fleet.workers.total  # clients resume
+            loop.log("fleet_recovered", rto_s=_r(dr["rto_s"]))
+
+    def power_loss():
+        rate = dr["rate_per_rank"]
+        loop.log("power_loss", ranks=p.ps, rate_per_rank=_r(rate))
+        for i, r in enumerate(ranks):
+            r["up"] = False
+            generations = 1
+            if i == 0:
+                # the snapshot write in flight at the cut is torn: CRC
+                # rejects the newest generation, recovery restores the
+                # previous one
+                r["snapshots"][1] = r["snapshots"][0]
+                if r["mode"] == "snap":
+                    r["durable"] = r["snapshots"][0]
+                generations = 2
+            r["lost"] = r["applied"] - r["durable"]
+            r["rpo_bound"] = (0.0 if r["mode"] == "wal"
+                              else rate * (interval_s * generations
+                                           + p.tick_s))
+            # staggered cold restart: respawn + snapshot load, plus WAL
+            # replay time for the WAL ranks
+            delay = 1.0 + loop.rng.uniform(0.0, 2.0) + (
+                0.5 if r["mode"] == "wal" else 0.0)
+            loop.after(delay, recover, i)
+        fleet.workers.joined = 0  # every push stream broke at once
+
+    loop.at(t_kill, power_loss)
+
+    def losses_realistic(_f):
+        # the scenario must actually exercise its point: snapshot ranks
+        # lose real pushes, the torn rank loses MORE than an untorn one
+        if not any(r["mode"] == "snap" and r["lost"] and i > 0
+                   for i, r in enumerate(ranks)):
+            return ["power_loss: no snapshot-only rank lost anything — "
+                    "the cut landed on a snapshot boundary and proved "
+                    "nothing"]
+        untorn = max(r["lost"] for i, r in enumerate(ranks)
+                     if r["mode"] == "snap" and i > 0)
+        if ranks[0]["lost"] <= untorn:
+            return ["power_loss: the torn-generation rank lost no more "
+                    "than an untorn one — the fallback never engaged"]
+        return []
+
+    return fleet, [
+        lambda f: props.rto_bounded(f, max_rto_s=5.0),
+        props.rpo_bounded,
+        losses_realistic,
+        lambda f: props.all_rejoined(f, deadline_s=p.duration_s),
+    ]
+
+
 @dataclasses.dataclass(frozen=True)
 class Scenario:
     name: str
@@ -289,6 +406,12 @@ SCENARIOS: dict[str, Scenario] = {
                  "diurnal peak outgrows the standby pool: loud error "
                  "outcomes, no crash, no failed requests",
                  _standby_exhaustion),
+        Scenario("power_loss_durable",
+                 "whole-fleet power loss mid-push: cold restart from "
+                 "the durable store with RTO/RPO bounds (WAL ranks "
+                 "lose 0, snapshot ranks <= 1 interval, torn "
+                 "generation falls back to <= 2)",
+                 _power_loss_durable),
     )
 }
 

@@ -79,6 +79,30 @@ WIRE_ONLY = {
                "field but names no constant for its ceiling",
 }
 
+#: header constant -> distlr_tpu/ps/store.py name.  Disk formats drift
+#: exactly like wire formats drift: the durable-store constants the
+#: native writer stamps into snapshot/WAL files are mirrored in
+#: ps/store.py (NOT wire.py — they never cross a socket) and the same
+#: bidirectional parity applies.
+HEADER_TO_STORE = {
+    "kStoreMagic": "STORE_MAGIC",
+    "kStoreVersion": "STORE_VERSION",
+    "kStoreHeaderSize": "STORE_HEADER_SIZE",
+    "kStoreGenerations": "STORE_GENERATIONS",
+    "kStoreFlagFtrl": "STORE_FLAG_FTRL",
+    "kStoreFlagInitialized": "STORE_FLAG_INITIALIZED",
+    "kWalMagic": "WAL_MAGIC",
+    "kWalHeaderSize": "WAL_HEADER_SIZE",
+    "kWalRecordHeaderSize": "WAL_RECORD_HEADER_SIZE",
+}
+
+#: store.py struct format -> the header-size constant it must pack to
+STORE_STRUCT_SIZES = (
+    ("SNAP_HEADER_STRUCT", "STORE_HEADER_SIZE"),
+    ("WAL_SEGMENT_STRUCT", "WAL_HEADER_SIZE"),
+    ("WAL_RECORD_STRUCT", "WAL_RECORD_HEADER_SIZE"),
+)
+
 #: the v1 kStats counter order the protocol comment fixes (the client's
 #: STATS_FIELDS prefix must reproduce it exactly)
 STATS_V1_ORDER = ("dim", "initialized", "pending_sync_pushes",
@@ -103,8 +127,10 @@ MIRROR_SITES = (
 
 #: distinctive protocol values that must never appear as bare literals
 #: in a mirror site (small ints like op codes and flag bits are too
-#: collision-prone to scan for; these are unmistakable)
-_DISTINCTIVE = ("kMagic", "kQuantBlock", "kMaxValsPerKey")
+#: collision-prone to scan for; these are unmistakable).  The store/WAL
+#: magics are disk-format constants — named through ps/store.py.
+_DISTINCTIVE = ("kMagic", "kQuantBlock", "kMaxValsPerKey",
+                "kStoreMagic", "kWalMagic")
 
 
 def header_path() -> str:
@@ -306,7 +332,11 @@ def check(root: str | None = None,
         rel(wpath) if root == repo_root() else wpath
 
     # direction 1: every header constant has a wire twin of equal value
+    # (durable-store constants route to ps/store.py — see
+    # _check_store_format — and are skipped here)
     for hname, (hval, hline) in sorted(hdr.items()):
+        if hname in HEADER_TO_STORE:
+            continue
         wname = HEADER_TO_WIRE.get(hname)
         if wname is None:
             findings.append(Finding(
@@ -359,10 +389,81 @@ def check(root: str | None = None,
                     f"{fname} = {wire_vals[fname][0]}",
                     ((wrel, sline),)))
 
+    findings += _check_store_format(root, hdr, hrel)
     findings += _check_stats_fields(root, hdr, hrel)
     findings += _check_codec_ids(root, hdr, hrel)
     findings += _check_raw_literals(root, hdr, hrel)
     return findings
+
+
+def _check_store_format(root: str, hdr: dict, hrel: str) -> list[Finding]:
+    """ps/store.py must mirror the header's durable-store constants
+    exactly, in both directions, and its struct formats must pack to
+    the header's pinned sizes — a disk-format edit that touches only
+    one side fails the lint before it can strand snapshots."""
+    spath = os.path.join(root, "distlr_tpu", "ps", "store.py")
+    srel = rel(spath) if root == repo_root() else spath
+    if not os.path.exists(spath):
+        if any(h in hdr for h in HEADER_TO_STORE):
+            return [Finding(
+                "wire", "store-mirror-missing",
+                "the header defines durable-store constants but "
+                "distlr_tpu/ps/store.py does not exist", ((hrel, 1),))]
+        return []
+    store_vals = module_constants(spath)
+    out: list[Finding] = []
+
+    # direction 1: every header store constant has a store.py twin
+    for hname, sname in sorted(HEADER_TO_STORE.items()):
+        if hname not in hdr:
+            out.append(Finding(
+                "wire", f"store-header-lost:{hname}",
+                f"HEADER_TO_STORE maps {hname} but the header no longer "
+                "defines it", ((hrel, 1),)))
+            continue
+        hval, hline = hdr[hname]
+        if sname not in store_vals:
+            out.append(Finding(
+                "wire", f"store-missing-mirror:{sname}",
+                f"header {hname} = {hval} should mirror as "
+                f"store.{sname}, which does not exist",
+                ((hrel, hline), (srel, 1))))
+            continue
+        sval, sline = store_vals[sname]
+        if sval != hval:
+            out.append(Finding(
+                "wire", f"store-value-mismatch:{hname}",
+                f"{hname} = {hval} in the header but store.{sname} = "
+                f"{sval} — the disk-format mirrors drifted",
+                ((hrel, hline), (srel, sline))))
+
+    # direction 2: every store.py int constant is a mirror (no
+    # unaudited disk-format constants on the Python side)
+    mirrored = set(HEADER_TO_STORE.values())
+    for sname, (sval, sline) in sorted(store_vals.items()):
+        if not isinstance(sval, int) or sname.startswith("_"):
+            continue
+        if sname.endswith("_STRUCT"):
+            continue  # struct objects; covered by the size check below
+        if sname in mirrored:
+            continue
+        out.append(Finding(
+            "wire", f"store-only:{sname}",
+            f"store.{sname} = {sval} has no kv_protocol.h twin — either "
+            "the header lost a durable-store constant or HEADER_TO_STORE "
+            "needs the new mapping", ((srel, sline),)))
+
+    # struct formats must pack to the header-pinned sizes
+    for stname, szname in STORE_STRUCT_SIZES:
+        if stname in store_vals and szname in store_vals:
+            stval, stline = store_vals[stname]
+            if stval != store_vals[szname][0]:
+                out.append(Finding(
+                    "wire", f"store-struct-size:{stname}",
+                    f"store.{stname} packs {stval} bytes but "
+                    f"{szname} = {store_vals[szname][0]}",
+                    ((srel, stline),)))
+    return out
 
 
 def _check_stats_fields(root: str, hdr: dict, hrel: str) -> list[Finding]:
@@ -443,11 +544,15 @@ def _check_raw_literals(root: str, hdr: dict, hrel: str) -> list[Finding]:
                     and not isinstance(node.value, bool)
                     and node.value in distinctive):
                 cname = distinctive[node.value]
+                if cname in HEADER_TO_STORE:
+                    named = f"store.{HEADER_TO_STORE[cname]}"
+                else:
+                    named = f"wire.{HEADER_TO_WIRE.get(cname, '?')}"
                 out.append(Finding(
                     "wire",
                     f"raw-literal:{site}:{cname}",
                     f"protocol value {node.value} ({cname}) appears as "
                     f"a raw literal — use the named "
-                    f"wire.{HEADER_TO_WIRE.get(cname, '?')} mirror",
+                    f"{named} mirror",
                     ((srel, node.lineno), (hrel, hdr[cname][1]))))
     return out

@@ -14,9 +14,23 @@ byte) or inside timed windows relative to fabric start:
          "window": [2.0, 5.0]},
         {"kind": "reset",     "links": [0], "after_ops": 25},
         {"kind": "reset",     "links": [1], "after_bytes": 4096},
-        {"kind": "partition", "links": [1], "window": [6.0, 7.5]}
+        {"kind": "partition", "links": [1], "window": [6.0, 7.5]},
+        {"kind": "kill",      "links": [0], "target": "rank:0",
+         "after_ops": 40},
+        {"kind": "kill",      "target": "group", "at_s": 3.0}
       ]
     }
+
+``kill`` is the process-fault kind (ISSUE 20, the durability story's
+power-loss primitive): SIGKILL one server rank (``target: "rank:N"``)
+or the whole group (``target: "group"``) either when the Nth KV frame
+has been forwarded on an observing link (``after_ops``; ``links`` must
+pin exactly ONE observing link) or at a fabric-clock offset (``at_s``).
+Unlike the network kinds it needs an executor — the fabric's ``killer``
+callback (wired by :class:`~distlr_tpu.ps.server.ServerGroup` for
+``via_chaos`` groups, or ``launch chaos --pids`` standalone); a plan
+with kill faults but no killer registered records the events and warns
+rather than silently dropping the fault.
 
 Validation is LOUD and happens entirely at parse time: unknown fault
 kinds, unknown keys, negative delays, malformed or overlapping windows
@@ -38,7 +52,7 @@ from __future__ import annotations
 import dataclasses
 import json
 
-FAULT_KINDS = ("delay", "throttle", "reset", "partition")
+FAULT_KINDS = ("delay", "throttle", "reset", "partition", "kill")
 
 #: keys every fault object may carry
 _COMMON_KEYS = {"kind", "links", "window"}
@@ -48,6 +62,7 @@ _KIND_KEYS = {
     "throttle": {"bytes_per_sec"},
     "reset": {"after_ops", "after_bytes"},
     "partition": set(),
+    "kill": {"target", "after_ops", "at_s"},
 }
 
 
@@ -60,8 +75,8 @@ class FaultPlanError(ValueError):
 class FaultSpec:
     """One validated fault.  ``links is None`` means every link; a
     ``window`` is ``(start_s, end_s)`` relative to fabric start, ``None``
-    means always active (reset faults are offset-triggered and never
-    windowed)."""
+    means always active (reset and kill faults are point events — offset
+    or clock triggered — and never windowed)."""
 
     index: int
     kind: str
@@ -72,6 +87,10 @@ class FaultSpec:
     bytes_per_sec: float = 0.0
     after_ops: int | None = None
     after_bytes: int | None = None
+    #: kill faults only: "rank:N" (one server rank) or "group" (all)
+    target: str | None = None
+    #: kill faults only: fire at this fabric-clock offset (seconds)
+    at_s: float | None = None
 
     def applies_to(self, link: int) -> bool:
         return self.links is None or link in self.links
@@ -188,6 +207,43 @@ def _parse_fault(i: int, fault) -> FaultSpec:
         if window is None:
             raise _err(i, "window", "partition faults must be timed "
                        "(a window is what bounds the outage)")
+    elif kind == "kill":
+        if window is not None:
+            raise _err(i, "window", "kill faults are one-shot point "
+                       "events (after_ops or at_s), not windows")
+        target = fault.get("target")
+        if not isinstance(target, str) or not (
+                target == "group"
+                or (target.startswith("rank:")
+                    and target[5:].isdigit())):
+            raise _err(i, "target",
+                       f'must be "rank:N" (N >= 0) or "group", '
+                       f"got {target!r}")
+        spec["target"] = target
+        ops = fault.get("after_ops")
+        ats = fault.get("at_s")
+        if (ops is None) == (ats is None):
+            raise _err(i, "after_ops",
+                       "kill needs exactly one of after_ops / at_s")
+        if ops is not None:
+            if isinstance(ops, bool) or not isinstance(ops, int) or ops < 1:
+                raise _err(i, "after_ops",
+                           f"must be an int >= 1, got {ops!r}")
+            spec["after_ops"] = ops
+            if links is None or len(links) != 1:
+                raise _err(i, "links",
+                           "an after_ops kill needs exactly ONE observing "
+                           'link (e.g. "links": [0]) — "the Nth op on any '
+                           'link" is a thread race and the canonical '
+                           "event log must stay deterministic")
+        else:
+            if fault.get("links") is not None:
+                raise _err(i, "links",
+                           "a time-triggered kill (at_s) fires on the "
+                           "fabric clock; links only select the "
+                           "OBSERVING link of an after_ops kill")
+            spec["at_s"] = _number(i, fault, "at_s", required=True,
+                                   minimum=0.0)
     return FaultSpec(**spec)
 
 
@@ -221,8 +277,8 @@ def parse_plan(doc: dict, *, seed: int | None = None) -> FaultPlan:
 
     # Overlap rejection: two WINDOWED kinds of the same kind on a shared
     # link with intersecting windows would double-inject ambiguously —
-    # the plan must say which fault owns the interval.  Resets are
-    # offset-triggered (several on one link = several resets) and exempt.
+    # the plan must say which fault owns the interval.  Resets and kills
+    # are one-shot point events (never windowed) and exempt.
     windowed = [f for f in faults if f.kind in ("delay", "throttle",
                                                 "partition")]
     for ai, a in enumerate(windowed):

@@ -5,9 +5,12 @@ server rank; a :class:`ChaosFabric` is the set of links fronting a
 whole server group, exposing a drop-in ``hosts`` string — point any
 :class:`~distlr_tpu.ps.KVWorker` / ``LivePSWatcher`` at it and every
 byte of KV traffic flows through the fault plan
-(:mod:`distlr_tpu.chaos.plan`).  The faults this injects are exactly
-the ones a SIGKILL-based harness cannot: packet delay and jitter, slow
-links, connection resets mid-op, and full/partial partitions.
+(:mod:`distlr_tpu.chaos.plan`): packet delay and jitter, slow links,
+connection resets mid-op, full/partial partitions — and, since ISSUE
+20, ``kill`` process faults (SIGKILL of a server rank or the whole
+group at a deterministic op offset or clock offset, the durability
+suite's power-loss primitive; executed via the fabric's ``killer``
+callback since the proxy itself holds no pids).
 
 Mechanics per link:
 
@@ -28,7 +31,12 @@ Mechanics per link:
   the server drops without applying;
 * ``partition`` stalls established connections (bytes neither lost nor
   forwarded — TCP semantics of a real partition) and refuses new ones
-  for the window's duration.
+  for the window's duration;
+* ``kill`` fires ONCE per fault: after frame ``after_ops`` has been
+  forwarded on an observing link (a power cut with the triggering push
+  delivered but not necessarily applied — exactly the torn state the
+  durable store must recover from) or when the fabric clock reaches
+  ``at_s``; the event records the plan offset, never wall time.
 
 Every injected fault is counted in ``distlr_chaos_*`` metrics (so a
 fleet scrape shows what was inflicted next to what it cost) and
@@ -55,10 +63,10 @@ log = get_logger(__name__)
 _reg = get_registry()
 _FAULTS = _reg.counter(
     "distlr_chaos_faults_total",
-    "network faults injected by the chaos proxy, by kind "
+    "faults injected by the chaos proxy, by kind "
     "(delay per delayed frame, reset per severed connection, partition "
     "per window activation, partition_refused per refused connect, "
-    "throttle per paced window activation)",
+    "throttle per paced window activation, kill per SIGKILLed target)",
     labelnames=("kind", "link"),
 )
 _OPS = _reg.counter(
@@ -181,6 +189,10 @@ class ChaosLink:
         self._throttle_faults = plan.for_link(link, "throttle")
         self._reset_faults = plan.for_link(link, "reset")
         self._partition_faults = plan.for_link(link, "partition")
+        # op-offset kills observed from this link (time-triggered kills
+        # live on the fabric's clock thread, not any link)
+        self._kill_faults = tuple(f for f in plan.for_link(link, "kill")
+                                  if f.after_ops is not None)
         self._lock = sync.Lock()
         # cumulative per-LINK traffic state (across reconnects), so
         # after_ops/after_bytes offsets mean "the Nth op/byte on this
@@ -531,6 +543,17 @@ class ChaosLink:
                 _OPS.labels(link=link).inc()
                 _BYTES.labels(link=link, direction="c2s").inc(len(frame))
 
+                # kill at op offset: frame N was DELIVERED, then the
+                # target loses power — applied-or-not is exactly the
+                # ambiguity the durable store's recovery must absorb.
+                # One-shot fabric-wide (fire_kill claims the index); the
+                # plan pins ONE observing link so the event log stays
+                # deterministic.
+                for f in self._kill_faults:
+                    if op_index + 1 >= f.after_ops:
+                        self._fabric.fire_kill(f, self.link,
+                                               op=f.after_ops, **trace_kv)
+
                 # reset at op offset: frame N was DELIVERED (sendall
                 # above, graceful upstream close below flushes it), but
                 # its response is already unreachable
@@ -651,10 +674,17 @@ class ChaosFabric:
     """
 
     def __init__(self, upstreams, plan: FaultPlan, *, seed: int | None = None,
-                 protocol: str = "kv"):
+                 protocol: str = "kv", killer=None):
         if seed is not None:
             plan = FaultPlan(faults=plan.faults, seed=int(seed))
         self.plan = plan
+        #: kill-fault executor: callable taking the fault's ``target``
+        #: string ("rank:N" / "group") and SIGKILLing it.  The proxy
+        #: holds sockets, not pids, so the process owner registers this
+        #: (ServerGroup for via_chaos groups; launch chaos via --pids).
+        self._killer = killer
+        self._kill_fired: set[int] = set()
+        self._kill_lock = sync.Lock()
         if isinstance(upstreams, str):
             pairs = []
             for part in upstreams.split(","):
@@ -673,6 +703,13 @@ class ChaosFabric:
             raise ValueError(
                 f"fault[{bad[0]}].links names a link >= the fabric's "
                 f"{len(pairs)} upstream(s)")
+        badt = [f.index for f in plan.faults
+                if f.kind == "kill" and f.target.startswith("rank:")
+                and int(f.target[5:]) >= len(pairs)]
+        if badt:
+            raise ValueError(
+                f"fault[{badt[0]}].target names a rank >= the fabric's "
+                f"{len(pairs)} upstream(s)")
         self._events: list[tuple] = []
         self._events_lock = sync.Lock()
         #: the log hit _MAX_EVENTS and dropped events: past the cap the
@@ -683,6 +720,16 @@ class ChaosFabric:
         self.started_at = sync.monotonic()
         self.links = [ChaosLink(i, up, plan, self, protocol=protocol)
                       for i, up in enumerate(pairs)]
+        # time-triggered kills ride the fabric clock, one timer thread
+        # per at_s fault (stopped/joined by stop())
+        self._stopped = sync.Event()
+        self._kill_timers: list[sync.Thread] = []
+        for f in plan.faults:
+            if f.kind == "kill" and f.at_s is not None:
+                t = sync.Thread(target=self._kill_at, args=(f,),
+                                daemon=True, name=f"chaos-kill-{f.index}")
+                self._kill_timers.append(t)
+                t.start()
 
     @property
     def hosts(self) -> str:
@@ -707,6 +754,55 @@ class ChaosFabric:
 
     def now(self) -> float:
         return sync.monotonic() - self.started_at
+
+    # -- kill faults (ISSUE 20: the power-loss primitive) -----------------
+    def set_killer(self, killer) -> None:
+        """Register/replace the kill-fault executor — a callable taking
+        the fault's ``target`` string (``"rank:N"`` / ``"group"``).
+        ServerGroup wires this AFTER constructing the fabric (the group
+        owns the pids); standalone ``launch chaos`` passes one at
+        construction from ``--pids``."""
+        self._killer = killer
+
+    def _kill_at(self, f: FaultSpec) -> None:
+        while not self._stopped.is_set():
+            remaining = f.at_s - self.now()
+            if remaining <= 0:
+                self.fire_kill(f, -1, at_s=f.at_s)
+                return
+            self._stopped.wait(min(_TICK_S, remaining))
+
+    def fire_kill(self, f: FaultSpec, link: int, **detail) -> None:
+        """Execute a kill fault ONCE fabric-wide (claim-then-act under
+        the fabric lock: several connections pump the observing link
+        concurrently and must not double-SIGKILL).  ``link`` is the
+        observing link for after_ops kills, ``-1`` for fabric-clock
+        (at_s) kills.  The canonical event records the PLAN's offset
+        (op index or at_s), never wall time, and is recorded whether or
+        not a killer is registered — a plan's fault timeline must not
+        depend on deployment wiring."""
+        with self._kill_lock:
+            if f.index in self._kill_fired:
+                return
+            self._kill_fired.add(f.index)
+        self.record(link, "kill", fault=f.index, target=f.target, **detail)
+        _FAULTS.labels(kind="kill", link=str(link)).inc()
+        killer = self._killer
+        if killer is None:
+            log.warning(
+                "chaos: kill fault[%d] (target=%s) fired but no killer "
+                "is registered — event recorded, nothing SIGKILLed "
+                "(ServerGroup(via_chaos=...) wires one automatically; "
+                "standalone `launch chaos` needs --pids)",
+                f.index, f.target)
+            return
+        try:
+            killer(f.target)
+        except Exception:
+            # the killer touches ANOTHER process's lifecycle; its
+            # failure must not take down the pump/timer thread
+            log.exception("chaos: killer failed for fault[%d] target=%s",
+                          f.index, f.target)
 
     def record(self, link: int, kind: str, **detail) -> None:
         # wall-clock twin for the merged timeline: when this process is
@@ -752,6 +848,9 @@ class ChaosFabric:
         }
 
     def stop(self) -> None:
+        self._stopped.set()
+        for t in self._kill_timers:
+            t.join(timeout=2.0)
         for lk in self.links:
             lk.stop()
 

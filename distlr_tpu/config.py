@@ -219,6 +219,25 @@ class Config:
     # storms back off up to 8x harder (still capped by
     # ps_retry_backoff_max_ms), quiet windows decay back.
     ps_retry_adaptive: bool = False
+    # Durable server store (native --store_dir): each spawned rank
+    # persists crash-consistent CRC-checked snapshots of its slice
+    # (weights + FTRL z/n + epoch + push clock) under
+    # <ps_store_dir>/rank-<r>/ every ps_store_interval_s seconds via
+    # tmp+fsync+rename (2 generations kept; torn/corrupt generations
+    # rejected loudly with fallback).  A cold restart with the same
+    # store dir recovers every rank from disk at its persisted epoch —
+    # RPO <= one interval.  None (default) = RAM-only, the prior
+    # behavior.
+    ps_store_dir: str | None = None
+    ps_store_interval_s: float = 5.0
+    # Segmented append-only push WAL on top of the snapshots (the
+    # native server's --store_wal flag): every applied push is logged
+    # and replayed over the newest valid snapshot on restart, driving
+    # RPO to ~0 (bounded only by the group-commit fsync window below).
+    # Requires ps_store_dir; async (sync_mode=False) servers only —
+    # sync-round merge state has no per-push replay semantics.
+    ps_store_wal: bool = False
+    ps_store_wal_fsync_s: float = 0.1
 
     # ---- chaos (distlr_tpu.chaos fault injection) ----
     # Path to a JSON fault plan: local `launch ps` runs interpose the
@@ -588,6 +607,23 @@ class Config:
             raise ValueError(
                 "ps_accum_growth_every must be positive, "
                 f"got {self.ps_accum_growth_every}")
+        if self.ps_store_interval_s <= 0:
+            raise ValueError(
+                "ps_store_interval_s must be positive, "
+                f"got {self.ps_store_interval_s}")
+        if self.ps_store_wal_fsync_s <= 0:
+            raise ValueError(
+                "ps_store_wal_fsync_s must be positive, "
+                f"got {self.ps_store_wal_fsync_s}")
+        if self.ps_store_wal and not self.ps_store_dir:
+            raise ValueError(
+                "ps_store_wal requires ps_store_dir (the WAL lives in "
+                "the same per-rank store directory)")
+        if self.ps_store_wal and self.sync_mode:
+            raise ValueError(
+                "ps_store_wal requires async mode (sync_mode=False): "
+                "sync-round merge state has no per-push replay semantics"
+            )
         if self.chaos_seed is not None and not 0 <= self.chaos_seed < 1 << 64:
             raise ValueError(
                 "chaos_seed must be None (use the plan's seed) or in "

@@ -343,6 +343,34 @@ def _add_config_flags(p: argparse.ArgumentParser) -> None:
         "back when quiet) instead of the static per-run base",
     )
     p.add_argument(
+        "--store-dir", dest="ps_store_dir",
+        help="durable server store: each spawned KV rank persists "
+        "crash-consistent CRC-checked snapshots of its slice (weights "
+        "+ FTRL z/n + epoch + push clock) under <dir>/rank-<r>/ and "
+        "SELF-RECOVERS from them at startup — restarting with the same "
+        "dir is the whole-fleet disaster-recovery path (default: off, "
+        "RAM-only)",
+    )
+    p.add_argument(
+        "--store-interval", dest="ps_store_interval_s", type=float,
+        help="seconds between durable-store snapshots (default 5; the "
+        "worst-case RPO window without --store-wal)",
+    )
+    p.add_argument(
+        "--store-wal", dest="ps_store_wal", action="store_true",
+        default=None,
+        help="segmented append-only push WAL on top of the snapshots: "
+        "every applied push replays over the newest valid snapshot on "
+        "restart, driving RPO to ~0 (bounded by --store-wal-fsync). "
+        "Requires --store-dir; async groups only",
+    )
+    p.add_argument(
+        "--store-wal-fsync", dest="ps_store_wal_fsync_s", type=float,
+        help="seconds between WAL group-commit fsyncs (default 0.1 — "
+        "the power-loss RPO bound; kill -9 alone loses nothing, the "
+        "records are already in the page cache)",
+    )
+    p.add_argument(
         "--ps-compute-backend", dest="ps_compute_backend",
         choices=["auto", "numpy", "cpu", "default"],
         help="where PS workers run their dense steps: auto (plain numpy "
@@ -379,6 +407,8 @@ def _config_from_args(args: argparse.Namespace) -> Config:
             "ps_optimizer", "ftrl_alpha", "ftrl_beta", "ftrl_l1", "ftrl_l2",
             "ps_compress", "ps_accum_start", "ps_accum_growth",
             "ps_accum_growth_every", "ps_accum_max", "ps_retry_adaptive",
+            "ps_store_dir", "ps_store_interval_s", "ps_store_wal",
+            "ps_store_wal_fsync_s", "sync_mode",
             "trace_sample", "prof_hz", "prof_window_s",
             "log_level", "log_ring", "log_dedupe_s",
             "incident_window_s", "incident_settle_s", "incident_max",
@@ -1185,9 +1215,34 @@ def cmd_chaos(args: argparse.Namespace) -> int:
     from distlr_tpu.chaos import ChaosFabric, FaultPlanError, load_plan  # noqa: PLC0415
 
     cfg = _config_from_args(args)
+
+    # kill-fault executor for a standalone fabric: the server processes
+    # are someone else's children, so --pids hands over their pids in
+    # rank order ("rank:N" -> pids[N], "group" -> all of them)
+    killer = None
+    if args.pids:
+        try:
+            pids = [int(p) for p in args.pids.split(",") if p.strip()]
+        except ValueError:
+            print(f"error: --pids must be a comma-separated pid list, "
+                  f"got {args.pids!r}", file=sys.stderr)
+            return 2
+
+        def killer(target: str) -> None:
+            victims = (pids if target == "group"
+                       else pids[int(target.split(":", 1)[1]):][:1])
+            if not victims:
+                log.warning("chaos kill target %r: no such pid", target)
+            for pid in victims:
+                try:
+                    os.kill(pid, signal.SIGKILL)
+                except ProcessLookupError:
+                    pass  # already dead: a kill fault is idempotent
+
     try:
         plan = load_plan(args.plan, seed=args.seed)
-        fabric = ChaosFabric(args.upstreams, plan, protocol=args.protocol)
+        fabric = ChaosFabric(args.upstreams, plan, protocol=args.protocol,
+                             killer=killer)
     except (OSError, FaultPlanError, ValueError) as e:
         print(f"error: {e}", file=sys.stderr)
         return 2
@@ -1236,6 +1291,10 @@ def cmd_ps_server(args: argparse.Namespace) -> int:
     # which ServerGroup.wait() handles).
     signal.signal(signal.SIGTERM, lambda *_: sys.exit(143))
 
+    if args.asynchronous:
+        # fold --async into the Config BEFORE validation: ps_store_wal's
+        # async-only check must see the mode the group will actually run
+        args.sync_mode = False
     cfg = _config_from_args(args)
     ports = [int(s) for s in args.ports.split(",")] if args.ports else None
     if ports and len(ports) != cfg.num_servers:
@@ -1305,6 +1364,15 @@ def cmd_ps_server(args: argparse.Namespace) -> int:
             if cfg.obs_run_dir and cfg.prof_hz > 0 else None),
         prof_window_s=cfg.prof_window_s,
         opt_segments=opt_segments,
+        # durable store (ISSUE 20): each hosted rank persists + self-
+        # recovers its slice under <store-dir>/rank-<r>/ — restarting
+        # this command with the same --store-dir IS the fleet-wide
+        # disaster-recovery path (ranks come back at their persisted
+        # epoch, so surviving clients' fencing just works)
+        store_dir=cfg.ps_store_dir,
+        store_interval_s=cfg.ps_store_interval_s,
+        store_wal=cfg.ps_store_wal,
+        store_wal_fsync_s=cfg.ps_store_wal_fsync_s,
     )
     ctl = None
     try:
@@ -1319,10 +1387,12 @@ def cmd_ps_server(args: argparse.Namespace) -> int:
                 print("NAMESPACES "
                       + ",".join(f"{m}={b}" for m, (b, _d) in layout.items())
                       + f" per_dim={per_dim}", flush=True)
-            if args.elastic:
+            if args.elastic or cfg.ps_store_dir:
                 # the scheduler role (membership coordination): LAYOUT/
                 # STATUS/RESIZE over a tiny TCP line protocol — `launch
-                # ps-ctl` drives it, clients' route= providers poll it
+                # ps-ctl` drives it, clients' route= providers poll it.
+                # Durable groups get the endpoint too (STORE/SNAPSHOT/
+                # RESTORE admin verbs), though plan_resize refuses them.
                 from distlr_tpu.ps.membership import (  # noqa: PLC0415
                     MembershipCoordinator,
                     MembershipServer,
@@ -1350,6 +1420,26 @@ def cmd_ps_ctl(args: argparse.Namespace) -> int:
 
     from distlr_tpu.ps.membership import ctl_request  # noqa: PLC0415
 
+    if args.command == "store" and args.store_dir:
+        # offline inspect: read the on-disk snapshots/WAL directly via
+        # ps/store.py — the post-disaster path, when no coordinator is
+        # alive to ask (torn/corrupt files come back described, never
+        # raised: a disaster inspection must work on a half-burned store)
+        import time  # noqa: PLC0415
+
+        from distlr_tpu.ps import store as ps_store  # noqa: PLC0415
+
+        try:
+            doc = ps_store.inspect_store(args.store_dir, now=time.time())
+        except ps_store.StoreError as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 1
+        print(f"PSCTL {json.dumps(doc)}", flush=True)
+        return 0
+    if not args.ctl:
+        print("error: --ctl host:port required (or `store --store-dir "
+              "<dir>` for offline inspection)", file=sys.stderr)
+        return 2
     if args.command == "resize":
         if args.n is None or args.n < 1:
             print("error: resize needs a target server count "
@@ -2295,14 +2385,25 @@ def main(argv=None) -> int:
              "coordinator (`launch ps-server --elastic`): show the "
              "layout, poll a migration, or live-reshard the group",
     )
-    pc.add_argument("--ctl", required=True,
+    pc.add_argument("--ctl",
                     help="the coordinator endpoint (what ps-server "
-                    "announced as PSCTL host:port)")
-    pc.add_argument("command", choices=["layout", "status", "resize"],
+                    "announced as PSCTL host:port); optional only for "
+                    "`store --store-dir` offline inspection")
+    pc.add_argument("command",
+                    choices=["layout", "status", "resize",
+                             "store", "snapshot", "restore"],
                     help="layout = the routing contract clients follow; "
                     "status = migration state + last-resize stats; "
                     "resize = live-reshard to N server ranks (blocks "
-                    "until the drain completes)")
+                    "until the drain completes); store = inspect the "
+                    "durable store's snapshots/WAL per rank; snapshot = "
+                    "force every rank to snapshot NOW (SIGUSR1); "
+                    "restore = force every rank back to its on-disk "
+                    "state (SIGKILL + respawn through native recovery)")
+    pc.add_argument("--store-dir", dest="store_dir",
+                    help="store only: inspect this on-disk store "
+                    "directly (no live coordinator needed — the "
+                    "post-disaster path)")
     pc.add_argument("n", nargs="?", type=int,
                     help="target server count (resize only)")
     pc.add_argument("--no-wait", dest="no_wait", action="store_true",
@@ -2316,8 +2417,8 @@ def main(argv=None) -> int:
     c = sub.add_parser(
         "chaos",
         help="fault-injection proxy in front of an existing KV server "
-             "group: deterministic delay/throttle/reset/partition from a "
-             "JSON plan; workers connect to the proxied HOSTS",
+             "group: deterministic delay/throttle/reset/partition/kill "
+             "from a JSON plan; workers connect to the proxied HOSTS",
     )
     _add_config_flags(c)
     c.add_argument("--upstreams", required=True,
@@ -2334,6 +2435,13 @@ def main(argv=None) -> int:
     c.add_argument("--events-path", dest="events_path",
                    help="write the deterministic fault-event log here as "
                    "JSON at exit")
+    c.add_argument("--pids", default=None,
+                   help="comma-separated pids of the upstream server "
+                   "processes in RANK order — arms plan kind 'kill' "
+                   "(SIGKILL of rank:N / the whole group at a "
+                   "deterministic op or clock offset, the DR drill's "
+                   "power-loss primitive); without it kill faults only "
+                   "record their event and warn")
     c.add_argument("--protocol", choices=["kv", "serve"], default="kv",
                    help="client->server framing the proxy parses: 'kv' "
                    "(native PS links, the default) or 'serve' (the "

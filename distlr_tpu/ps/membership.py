@@ -385,6 +385,78 @@ class MembershipCoordinator:
         self._record("resize_done", **stats)
         return stats
 
+    # -- durable store admin (ISSUE 20) ------------------------------------
+    def _require_store(self) -> str:
+        root = self.group._args["store_dir"]
+        if not root:
+            raise MembershipError(
+                "the group runs without a durable store "
+                "(launch ps-server needs --store-dir)")
+        return root
+
+    def store_inspect(self) -> dict:
+        """The ``STORE`` verb: scan every rank's on-disk snapshot
+        generations and WAL segments (via :mod:`distlr_tpu.ps.store`)
+        without touching the serving processes."""
+        import time  # noqa: PLC0415
+
+        from distlr_tpu.ps import store as ps_store  # noqa: PLC0415
+
+        doc = ps_store.inspect_store(self._require_store(), now=time.time())
+        doc["ok"] = True
+        return doc
+
+    def store_snapshot(self) -> dict:
+        """The ``SNAPSHOT`` verb: force every live rank to snapshot NOW
+        (SIGUSR1 — the native persistence thread writes out of band, so
+        serving never blocks).  A rank whose state hasn't moved since
+        its last snapshot skips the write (crash consistency makes the
+        existing generation just as good)."""
+        import os  # noqa: PLC0415
+        import signal  # noqa: PLC0415
+
+        self._require_store()
+        signalled = 0
+        for proc in self.group.procs:
+            if proc.poll() is None:
+                os.kill(proc.pid, signal.SIGUSR1)
+                signalled += 1
+        self._record("store_snapshot", signalled=signalled)
+        return {"ok": True, "signalled": signalled,
+                "num_servers": self.group.num_servers}
+
+    def store_restore(self) -> dict:
+        """The ``RESTORE`` verb: force every rank back to its on-disk
+        state — SIGKILL + respawn on the original port, so the process
+        cold-starts through its own recovery path (newest valid
+        snapshot + WAL replay).  Clients see one broken connection per
+        rank and retry; the supervisor (if any) is paused so the
+        intentional kills never double-respawn."""
+        import os  # noqa: PLC0415
+        import signal  # noqa: PLC0415
+
+        self._require_store()
+        with self._lock:
+            if self._status != "active":
+                raise MembershipError(
+                    f"a migration is in flight ({self._status})")
+        if self.supervisor is not None:
+            self.supervisor.pause()
+        restored = []
+        try:
+            for rank, proc in enumerate(list(self.group.procs)):
+                if proc.poll() is None:
+                    os.kill(proc.pid, signal.SIGKILL)
+                    proc.wait()
+                self.group.respawn(rank)
+                restored.append(rank)
+        finally:
+            if self.supervisor is not None:
+                self.supervisor.resume()
+        self._record("store_restore", ranks=restored)
+        return {"ok": True, "restored": restored,
+                "num_servers": self.group.num_servers}
+
     def resize_async(self, new_num_servers: int) -> dict:
         """The daemon-friendly resize entry (ISSUE 16): validate and
         ACCEPT now, migrate on a background thread, report through
@@ -449,7 +521,8 @@ class _CtlTCPServer(socketserver.ThreadingTCPServer):
 
 class MembershipServer:
     """``launch ps-ctl``'s wire: LAYOUT / STATUS / RESIZE <n>
-    [wait=0|wait=1] over a newline-delimited TCP protocol, every reply
+    [wait=0|wait=1] / STORE / SNAPSHOT / RESTORE over a
+    newline-delimited TCP protocol, every reply
     one JSON line — the
     scheduler endpoint clients' ``route=`` providers poll
     (:func:`layout_client`) and operators script against."""
@@ -489,10 +562,17 @@ class MembershipServer:
                     return json.dumps(self.coordinator.resize(int(parts[1])))
                 return json.dumps(
                     self.coordinator.resize_async(int(parts[1])))
+            if verb == "STORE" and len(parts) == 1:
+                return json.dumps(self.coordinator.store_inspect())
+            if verb == "SNAPSHOT" and len(parts) == 1:
+                return json.dumps(self.coordinator.store_snapshot())
+            if verb == "RESTORE" and len(parts) == 1:
+                return json.dumps(self.coordinator.store_restore())
             return json.dumps({"ok": False,
                                "error": f"unknown command {line!r} "
                                         "(LAYOUT | STATUS | "
-                                        "RESIZE <n> [wait=0|wait=1])"})
+                                        "RESIZE <n> [wait=0|wait=1] | "
+                                        "STORE | SNAPSHOT | RESTORE)"})
         except (MembershipError, ValueError) as e:
             return json.dumps({"ok": False, "error": str(e)})
 

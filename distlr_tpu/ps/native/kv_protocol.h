@@ -345,6 +345,61 @@ constexpr uint64_t kMaxValsPerKey = 4096;
 
 static_assert(sizeof(MsgHeader) == 24, "MsgHeader must be 24 bytes");
 
+// --- durable store: on-DISK formats (--store_dir) ----------------------
+//
+// Disk formats are protocol too: the Python reader (distlr_tpu/ps/
+// store.py) mirrors every constant here, and the analysis wire-parity
+// pass fails `make lint` on any drift — the same lint culture that
+// pins the socket framing above.
+//
+// Snapshot file (snap-0.bin / snap-1.bin, two alternating generations;
+// written tmp+fsync+rename so a reader never sees a half-written
+// generation — torn files can only come from a crash mid-rename-free
+// filesystem, and the CRC rejects them):
+//   40-byte header, little-endian, no padding:
+//     u32 magic         kStoreMagic
+//     u16 version       kStoreVersion (bump on ANY layout change)
+//     u16 flags         kStoreFlagFtrl | kStoreFlagInitialized
+//     u16 epoch         membership epoch at capture (kEpoch round)
+//     u16 reserved      zero
+//     u32 crc           CRC32 (zlib polynomial) over the header with
+//                       this field zeroed, then the whole payload
+//     u64 dim           weights_.size() at capture
+//     u64 push_clock    n_push_ at capture — the RPO audit clock
+//     f64 wall_time_s   capture wall time (snapshot-age metric)
+//   payload: dim f32 weights, then (flags & kStoreFlagFtrl) dim f32 z
+//   and dim f32 n — the FTRL accumulators, so a restore is never a
+//   silent warm restart.
+//
+// WAL segment (wal-<push_clock>.log, append-only, rotated at every
+// snapshot; a segment named wal-C holds exactly the records with
+// seq > C up to the next rotation's clock — which is what makes
+// "delete segments older than the oldest on-disk generation" safe):
+//   8-byte segment header: u32 kWalMagic, u16 kStoreVersion, u16 epoch
+//   then records, each:
+//     20-byte record header: u64 seq (n_push_ AFTER the mutation; the
+//       replay skip/apply cursor), u32 nkeys, u8 flags (the wire Flags
+//       bits that describe the mutation: kInitPush/kForceInit/
+//       kOptState), u8 op (Op::kPush, or Op::kEpoch for a membership
+//       flip — then reserved carries the new epoch and nkeys == 0),
+//       u16 reserved, u32 crc (CRC32 over the record payload)
+//     payload: nkeys u64 keys, then nvals f32 vals where nvals is
+//       2*nkeys for kOptState records (the [z..., n...] layout) and
+//       nkeys otherwise.
+//   A torn tail (crash mid-append) truncates replay at the first short
+//   or CRC-failing record — loudly, never silently.
+constexpr uint32_t kStoreMagic = 0xD157510D;
+constexpr uint32_t kStoreVersion = 1;
+constexpr uint32_t kStoreHeaderSize = 40;
+//: generations kept on disk (alternating snap-0 / snap-1)
+constexpr uint32_t kStoreGenerations = 2;
+//: snapshot header flag bits
+constexpr uint32_t kStoreFlagFtrl = 1;
+constexpr uint32_t kStoreFlagInitialized = 2;
+constexpr uint32_t kWalMagic = 0xD157106D;
+constexpr uint32_t kWalHeaderSize = 8;
+constexpr uint32_t kWalRecordHeaderSize = 20;
+
 using Key = uint64_t;
 using Val = float;
 

@@ -43,6 +43,13 @@
 //                        (continuous-profiling windows: per-handler
 //                         thread-CPU deltas as "profwindow" JSONL lines,
 //                         the native half of `launch prof-agg`'s merge)
+//                    [--store_dir=<dir>] [--store_interval=5]
+//                    [--store_wal=0] [--store_wal_fsync=0.1]
+//                        (durable store: crash-consistent snapshot
+//                         generations every --store_interval seconds +
+//                         optional per-push WAL with group-commit fsync;
+//                         cold start recovers from disk before the PORT
+//                         announcement, SIGUSR1 forces a snapshot now)
 //
 // --optimizer selects the server-side update rule applied to incoming
 // gradients (the pluggable point the lr flag already parameterized):
@@ -87,13 +94,18 @@
 // src/main.cc:98-101).
 
 #include <arpa/inet.h>
+#include <dirent.h>
+#include <fcntl.h>
 #include <netinet/in.h>
 #include <netinet/tcp.h>
 #include <pthread.h>
 #include <sys/socket.h>
+#include <sys/stat.h>
 #include <sys/time.h>
 #include <time.h>
 #include <unistd.h>
+
+#include <cerrno>
 
 #include <algorithm>
 #include <atomic>
@@ -162,6 +174,11 @@ class KVServer;
 // For the SIGTERM handler only (a capture-less lambda): the final
 // profile window must not be stranded by ServerGroup.stop()'s terminate.
 static KVServer* g_server = nullptr;
+// SIGUSR1 = "durable snapshot now" (`launch ps-ctl snapshot`): the
+// handler only flips this flag — the persistence loop polls it every
+// 100ms slice and does the actual write from its own thread, so the
+// signal path stays async-signal-safe.
+static std::atomic<bool> g_store_snap_req{false};
 
 class KVServer {
  public:
@@ -170,13 +187,18 @@ class KVServer {
            Opt opt, FtrlParams ftrl_params, bool compress,
            std::string trace_journal, std::string prof_journal,
            double prof_window_s, uint16_t epoch,
-           std::vector<std::pair<uint64_t, Opt>> opt_segments)
+           std::vector<std::pair<uint64_t, Opt>> opt_segments,
+           std::string store_dir, double store_interval_s,
+           bool store_wal, double store_wal_fsync_s)
       : port_(port), num_workers_(num_workers), lr_(lr), sync_(sync),
         last_gradient_(last_gradient), bind_any_(bind_any),
         max_dim_(max_dim), opt_(opt), fp_(ftrl_params),
         compress_(compress), trace_journal_(std::move(trace_journal)),
         prof_journal_(std::move(prof_journal)),
-        prof_window_s_(prof_window_s), epoch_(epoch),
+        prof_window_s_(prof_window_s),
+        store_dir_(std::move(store_dir)),
+        store_interval_s_(store_interval_s), store_wal_(store_wal),
+        store_wal_fsync_s_(store_wal_fsync_s), epoch_(epoch),
         opt_segments_(std::move(opt_segments)) {
     weights_.resize(dim, 0.0f);
     has_ftrl_ = opt_ == Opt::kFtrl;
@@ -208,6 +230,23 @@ class KVServer {
       fflush(nullptr);
       _exit(143);
     });
+    if (!store_dir_.empty()) {
+      signal(SIGUSR1, [](int) { g_store_snap_req.store(true); });
+      // Recovery runs BEFORE the listen socket exists: by the time
+      // "PORT n" is announced the slice is fully restored (snapshot +
+      // WAL replay) at its persisted epoch, so a surviving client's
+      // very first fenced op against the restarted rank already sees
+      // consistent state — there is no "up but empty" window.
+      if (!LoadStore()) return 1;
+      if (store_wal_) {
+        RotateWalLocked(n_push_, epoch_);  // pre-threads: no lock needed
+        if (wal_fd_ < 0) {
+          fprintf(stderr, "[distlr_kv_server] cannot arm --store_wal "
+                  "(segment open failed)\n");
+          return 1;
+        }
+      }
+    }
     listen_fd_ = socket(AF_INET, SOCK_STREAM, 0);
     if (listen_fd_ < 0) { perror("socket"); return 1; }
     int one = 1;
@@ -276,6 +315,17 @@ class KVServer {
           fprintf(stderr, "[distlr_kv_server] cannot start profiler "
                   "thread; profile windows will not be recorded\n");
         }
+      }
+    }
+    if (!store_dir_.empty()) {
+      // Persistence loop: detached like the profiler (and for the same
+      // TSan-matrix reason); the epilogue below waits on
+      // store_loop_done_ (bounded) before the final snapshot.
+      store_loop_done_.store(false);
+      if (!SpawnDetached(&KVServer::StoreTrampoline, this)) {
+        store_loop_done_.store(true);
+        fprintf(stderr, "[distlr_kv_server] cannot start persistence "
+                "thread; periodic snapshots will not be written\n");
       }
     }
 
@@ -349,6 +399,21 @@ class KVServer {
       }
       fclose(trace_f_);
       trace_f_ = nullptr;
+    }
+    if (!store_dir_.empty()) {
+      // bounded wait for the detached persistence loop (it polls
+      // shutdown_ every 100ms) so the final generation below cannot
+      // race an in-flight interval snapshot
+      for (int i = 0; i < 30 && !store_loop_done_.load(); ++i) {
+        usleep(100 * 1000);
+      }
+      if (store_loop_done_.load()) {
+        WriteSnapshot();  // final generation of a clean shutdown
+        WalClose();
+      } else {
+        fprintf(stderr, "[distlr_kv_server] persistence loop still busy "
+                "at shutdown; final snapshot skipped\n");
+      }
     }
     return 0;
   }
@@ -721,6 +786,9 @@ class KVServer {
         // admin SET: the membership coordinator arms the fence — every
         // connection still announced at the old epoch starts bouncing
         epoch_ = h.aux;
+        // epoch flips are durable too: a rank recovering past one must
+        // not fence survivors with a stale epoch
+        WalAppendEpoch(h.aux);
         fprintf(stderr, "[distlr_kv_server] membership epoch -> %u\n",
                 static_cast<unsigned>(h.aux));
       } else if (h.aux != 0) {
@@ -944,6 +1012,10 @@ class KVServer {
       if ((!initialized_ || (h.flags & kForceInit)) && !keys.empty()) {
         for (size_t i = 0; i < keys.size(); ++i) weights_[keys[i]] = vals[i];
         initialized_ = true;
+        // WAL records describe the mutation that ACTUALLY happened (a
+        // no-op'd idempotent re-init is not logged), so replay applies
+        // every record unconditionally.
+        WalAppend(n_push_, kInitPush, Op::kPush, keys, vals);
       }
       const auto out = reply_weights ? WeightsFor(keys) : std::vector<Val>();
       lock.unlock();
@@ -958,6 +1030,10 @@ class KVServer {
       // sync/async handling so it still counts toward the BSP barrier.
       for (size_t i = 0; i < keys.size(); ++i) weights_[keys[i]] = vals[i];
       initialized_ = true;
+      // logged as an init record: the SEMANTIC was a seed (weights
+      // set, not gradient-applied), and replay must reproduce exactly
+      // that regardless of what the wire flags said
+      WalAppend(n_push_, kInitPush, Op::kPush, keys, vals);
       const auto out = reply_weights ? WeightsFor(keys) : std::vector<Val>();
       lock.unlock();
       Respond(fd, h, out.data(), out.size());
@@ -969,6 +1045,9 @@ class KVServer {
       // configured optimizer (SGD or per-coordinate FTRL-Proximal).
       for (size_t i = 0; i < keys.size(); ++i)
         ApplyGrad(keys[i], vals[i]);
+      // empty "present" votes are logged too: the WAL clock must track
+      // n_push_ exactly or the RPO push-clock audit would drift
+      WalAppend(n_push_, 0, Op::kPush, keys, vals);
       const auto out = reply_weights ? WeightsFor(keys) : std::vector<Val>();
       lock.unlock();
       Respond(fd, h, out.data(), out.size());
@@ -1133,6 +1212,7 @@ class KVServer {
         z_[keys[i]] = vals[i];
         nacc_[keys[i]] = vals[n + i];
       }
+      WalAppend(n_push_, kOptState | kInitPush, Op::kPush, keys, vals);
     }
     Respond(fd, h, nullptr, 0);
   }
@@ -1302,6 +1382,591 @@ class KVServer {
     for (auto& p : release) Respond(p.fd, p.header, nullptr, 0);
   }
 
+  // ===== durable store (--store_dir) ===================================
+  // Crash-consistent snapshots + optional push WAL; on-disk formats in
+  // kv_protocol.h, Python mirror distlr_tpu/ps/store.py (the store-
+  // format parity lint pins the two against each other).
+
+  // CRC32 with the zlib polynomial (reflected 0xEDB88320) so Python's
+  // zlib.crc32 verifies native-written files bit for bit.  Chainable
+  // like zlib: Crc32(Crc32(0, a, na), b, nb) == crc32 of a||b.
+  static uint32_t Crc32(uint32_t crc, const void* buf, size_t n) {
+    static const uint32_t* table = [] {
+      static uint32_t t[256];
+      for (uint32_t i = 0; i < 256; ++i) {
+        uint32_t c = i;
+        for (int k = 0; k < 8; ++k)
+          c = (c & 1) ? 0xEDB88320u ^ (c >> 1) : c >> 1;
+        t[i] = c;
+      }
+      return t;
+    }();
+    const auto* p = static_cast<const uint8_t*>(buf);
+    crc ^= 0xFFFFFFFFu;
+    for (size_t i = 0; i < n; ++i)
+      crc = table[(crc ^ p[i]) & 0xFF] ^ (crc >> 8);
+    return crc ^ 0xFFFFFFFFu;
+  }
+
+  std::string SnapPath(int gen) const {
+    return store_dir_ + "/snap-" + std::to_string(gen) + ".bin";
+  }
+
+  std::string WalPath(uint64_t clock) const {
+    char num[32];
+    snprintf(num, sizeof(num), "%020llu", (unsigned long long)clock);
+    return store_dir_ + "/wal-" + num + ".log";
+  }
+
+  // 40-byte snapshot header (layout doc in kv_protocol.h); crc field
+  // left zeroed — the caller stamps it after checksumming.
+  static void FillSnapHeader(uint8_t* b, uint16_t flags, uint16_t epoch,
+                             uint64_t dim, uint64_t clock, double wall) {
+    std::memset(b, 0, kStoreHeaderSize);
+    const uint32_t magic = kStoreMagic;
+    const uint16_t version = static_cast<uint16_t>(kStoreVersion);
+    std::memcpy(b + 0, &magic, 4);
+    std::memcpy(b + 4, &version, 2);
+    std::memcpy(b + 6, &flags, 2);
+    std::memcpy(b + 8, &epoch, 2);
+    std::memcpy(b + 16, &dim, 8);
+    std::memcpy(b + 24, &clock, 8);
+    std::memcpy(b + 32, &wall, 8);
+  }
+
+  struct SnapMeta {
+    bool present = false;
+    bool valid = false;
+    const char* why = "";  // rejection reason when present && !valid
+    uint16_t flags = 0;
+    uint16_t epoch = 0;
+    uint64_t dim = 0;
+    uint64_t clock = 0;
+    double wall = 0.0;
+  };
+
+  // Validate one generation WITHOUT retaining the payload: header
+  // sanity + streaming CRC over the whole file.  The chosen generation
+  // is re-read by LoadSnapPayload — two cheap sequential reads beat
+  // holding both generations' weights in RAM at once.
+  SnapMeta ReadSnapMeta(const std::string& path) {
+    SnapMeta m;
+    FILE* f = fopen(path.c_str(), "rb");
+    if (f == nullptr) return m;  // absent: not an error
+    m.present = true;
+    uint8_t hdr[kStoreHeaderSize];
+    if (fread(hdr, 1, sizeof(hdr), f) != sizeof(hdr)) {
+      m.why = "short header";
+      fclose(f);
+      return m;
+    }
+    uint32_t magic, crc;
+    uint16_t version;
+    std::memcpy(&magic, hdr + 0, 4);
+    std::memcpy(&version, hdr + 4, 2);
+    std::memcpy(&m.flags, hdr + 6, 2);
+    std::memcpy(&m.epoch, hdr + 8, 2);
+    std::memcpy(&crc, hdr + 12, 4);
+    std::memcpy(&m.dim, hdr + 16, 8);
+    std::memcpy(&m.clock, hdr + 24, 8);
+    std::memcpy(&m.wall, hdr + 32, 8);
+    if (magic != kStoreMagic) {
+      m.why = "bad magic";
+    } else if (version != kStoreVersion) {
+      m.why = "unknown version";
+    } else if (m.dim > max_dim_) {
+      m.why = "dim exceeds max_dim";
+    } else {
+      const uint64_t vecs = (m.flags & kStoreFlagFtrl) ? 3 : 1;
+      const uint64_t want = m.dim * vecs * sizeof(Val);
+      std::memset(hdr + 12, 0, 4);  // crc is computed with its field zeroed
+      uint32_t got_crc = Crc32(0, hdr, sizeof(hdr));
+      std::vector<uint8_t> chunk(1 << 20);
+      uint64_t seen = 0;
+      for (;;) {
+        const size_t r = fread(chunk.data(), 1, chunk.size(), f);
+        if (r == 0) break;
+        got_crc = Crc32(got_crc, chunk.data(), r);
+        seen += r;
+        if (seen > want) break;  // oversized: reject below
+      }
+      if (seen != want) m.why = "payload size mismatch (torn write?)";
+      else if (got_crc != crc) m.why = "CRC mismatch";
+      else m.valid = true;
+    }
+    fclose(f);
+    return m;
+  }
+
+  // Restore weights_/z_/nacc_ from an already-validated generation.
+  bool LoadSnapPayload(const std::string& path, const SnapMeta& m) {
+    FILE* f = fopen(path.c_str(), "rb");
+    if (f == nullptr) return false;
+    bool ok = fseek(f, kStoreHeaderSize, SEEK_SET) == 0;
+    weights_.assign(m.dim, 0.0f);
+    ok = ok && fread(weights_.data(), sizeof(Val), m.dim, f) == m.dim;
+    if (ok && (m.flags & kStoreFlagFtrl)) {
+      if (has_ftrl_) {
+        z_.assign(m.dim, 0.0f);
+        nacc_.assign(m.dim, 0.0f);
+        ok = fread(z_.data(), sizeof(Val), m.dim, f) == m.dim &&
+             fread(nacc_.data(), sizeof(Val), m.dim, f) == m.dim;
+      } else {
+        fprintf(stderr, "[distlr_kv_server] store: snapshot carries FTRL "
+                "state but this server runs without FTRL; accumulators "
+                "dropped\n");
+      }
+    } else if (ok && has_ftrl_) {
+      z_.assign(m.dim, 0.0f);
+      nacc_.assign(m.dim, 0.0f);
+      fprintf(stderr, "[distlr_kv_server] store: snapshot has no FTRL "
+              "state; accumulators start at zero (warm restart)\n");
+    }
+    fclose(f);
+    return ok;
+  }
+
+  // Cold-start recovery: newest VALID generation wins; corrupt/torn
+  // generations are rejected LOUDLY with fallback to the other one
+  // (never silently restored — the acceptance contract), then every
+  // WAL record past the snapshot's push clock is replayed on top.
+  // Returns false only when the store directory itself is unusable —
+  // a durable rank that cannot persist must fail at startup, not
+  // quietly serve volatile state.
+  bool LoadStore() {
+    mkdir(store_dir_.c_str(), 0777);  // best-effort; open() is the check
+    store_dirfd_ = open(store_dir_.c_str(), O_RDONLY | O_DIRECTORY);
+    if (store_dirfd_ < 0) {
+      fprintf(stderr, "[distlr_kv_server] --store_dir=%s is not a usable "
+              "directory: %s\n", store_dir_.c_str(), strerror(errno));
+      return false;
+    }
+    SnapMeta metas[kStoreGenerations];
+    int best = -1;
+    for (int g = 0; g < static_cast<int>(kStoreGenerations); ++g) {
+      metas[g] = ReadSnapMeta(SnapPath(g));
+      if (metas[g].present && !metas[g].valid) {
+        ++store_corrupt_;
+        fprintf(stderr, "[distlr_kv_server] store: snapshot %s REJECTED "
+                "(%s); falling back to the other generation\n",
+                SnapPath(g).c_str(), metas[g].why);
+        continue;
+      }
+      if (metas[g].valid) {
+        gen_clock_[g] = metas[g].clock;
+        if (best < 0 || metas[g].clock > metas[best].clock ||
+            (metas[g].clock == metas[best].clock &&
+             metas[g].wall > metas[best].wall)) {
+          best = g;
+        }
+      }
+    }
+    if (best >= 0) {
+      const SnapMeta& m = metas[best];
+      if (!LoadSnapPayload(SnapPath(best), m)) {
+        // validated a moment ago, unreadable now: the disk is lying —
+        // treat like corruption, fall back to zero state loudly
+        ++store_corrupt_;
+        fprintf(stderr, "[distlr_kv_server] store: snapshot %s became "
+                "unreadable during load; starting from zero state\n",
+                SnapPath(best).c_str());
+        weights_.assign(weights_.size(), 0.0f);
+        best = -1;
+      } else {
+        epoch_ = m.epoch;
+        initialized_ = (m.flags & kStoreFlagInitialized) != 0;
+        n_push_ = m.clock;
+        next_gen_ = 1 - best;
+        last_snap_clock_ = m.clock;
+        last_snap_epoch_ = m.epoch;
+      }
+    }
+    if (best < 0 && (metas[0].present || metas[1].present)) {
+      fprintf(stderr, "[distlr_kv_server] store: NO valid snapshot "
+              "generation; starting from zero state\n");
+    }
+    // WAL replay runs regardless of --store_wal: segments written by a
+    // previous (WAL-armed) incarnation must never be ignored silently.
+    const uint64_t replayed = ReplayWal();
+    if (best >= 0 || replayed > 0) {
+      fprintf(stderr, "[distlr_kv_server] store: recovered dim=%zu "
+              "push_clock=%llu epoch=%u (%llu WAL records replayed)\n",
+              weights_.size(), (unsigned long long)n_push_,
+              static_cast<unsigned>(epoch_),
+              (unsigned long long)replayed);
+    }
+    return true;
+  }
+
+  // All wal-*.log segments sorted by start clock (the rotation clock in
+  // the name — see kv_protocol.h for why that ordering is total).
+  std::vector<std::pair<uint64_t, std::string>> WalSegments() {
+    std::vector<std::pair<uint64_t, std::string>> segs;
+    DIR* d = opendir(store_dir_.c_str());
+    if (d == nullptr) return segs;
+    while (dirent* e = readdir(d)) {
+      const std::string name = e->d_name;
+      if (name.rfind("wal-", 0) != 0 || name.size() < 9 ||
+          name.substr(name.size() - 4) != ".log")
+        continue;
+      segs.emplace_back(
+          strtoull(name.c_str() + 4, nullptr, 10),
+          store_dir_ + "/" + name);
+    }
+    closedir(d);
+    std::sort(segs.begin(), segs.end());
+    return segs;
+  }
+
+  uint64_t ReplayWal() {
+    uint64_t applied = 0;
+    for (const auto& [clock, path] : WalSegments()) {
+      (void)clock;
+      applied += ReplaySegment(path);
+    }
+    return applied;
+  }
+
+  // Replay one segment on top of the current state.  A torn tail or a
+  // CRC-failing record stops THIS segment loudly (everything after a
+  // corrupt record is unordered guesswork); sane records before it are
+  // kept.  Pre-snapshot records (seq <= n_push_) are skipped.
+  uint64_t ReplaySegment(const std::string& path) {
+    FILE* f = fopen(path.c_str(), "rb");
+    if (f == nullptr) return 0;
+    uint64_t applied = 0;
+    uint8_t shdr[kWalHeaderSize];
+    uint32_t magic = 0;
+    uint16_t version = 0;
+    if (fread(shdr, 1, sizeof(shdr), f) != sizeof(shdr) ||
+        (std::memcpy(&magic, shdr, 4), magic != kWalMagic) ||
+        (std::memcpy(&version, shdr + 4, 2), version != kStoreVersion)) {
+      fprintf(stderr, "[distlr_kv_server] store: WAL segment %s has a "
+              "bad header; segment skipped\n", path.c_str());
+      fclose(f);
+      return 0;
+    }
+    std::vector<Key> keys;
+    std::vector<Val> vals;
+    for (;;) {
+      uint8_t rh[kWalRecordHeaderSize];
+      const size_t got = fread(rh, 1, sizeof(rh), f);
+      if (got == 0) break;  // clean segment end
+      uint64_t seq;
+      uint32_t nkeys, crc;
+      uint8_t rflags, rop;
+      uint16_t reserved;
+      if (got < sizeof(rh)) {
+        fprintf(stderr, "[distlr_kv_server] store: torn WAL tail in %s "
+                "(short record header); replay stops here\n", path.c_str());
+        break;
+      }
+      std::memcpy(&seq, rh + 0, 8);
+      std::memcpy(&nkeys, rh + 8, 4);
+      rflags = rh[12];
+      rop = rh[13];
+      std::memcpy(&reserved, rh + 14, 2);
+      std::memcpy(&crc, rh + 16, 4);
+      if (nkeys > max_dim_ ||
+          (rop == static_cast<uint8_t>(Op::kEpoch) && nkeys != 0)) {
+        fprintf(stderr, "[distlr_kv_server] store: corrupt WAL record in "
+                "%s (nkeys=%u); replay stops here\n", path.c_str(), nkeys);
+        break;
+      }
+      const uint64_t nvals = (rflags & kOptState) ? 2ull * nkeys : nkeys;
+      keys.resize(nkeys);
+      vals.resize(nvals);
+      if ((nkeys &&
+           fread(keys.data(), sizeof(Key), nkeys, f) != nkeys) ||
+          (nvals &&
+           fread(vals.data(), sizeof(Val), nvals, f) != nvals)) {
+        fprintf(stderr, "[distlr_kv_server] store: torn WAL tail in %s "
+                "(short record payload); replay stops here\n",
+                path.c_str());
+        break;
+      }
+      uint32_t got_crc = Crc32(0, keys.data(), nkeys * sizeof(Key));
+      got_crc = Crc32(got_crc, vals.data(), nvals * sizeof(Val));
+      if (got_crc != crc) {
+        fprintf(stderr, "[distlr_kv_server] store: WAL record CRC "
+                "mismatch in %s; replay stops here\n", path.c_str());
+        break;
+      }
+      if (rop == static_cast<uint8_t>(Op::kEpoch)) {
+        // epoch flips ride the current clock; >= (not >) because a
+        // flip at exactly the snapshot clock is ambiguous about which
+        // side of the capture it landed on — re-applying is idempotent
+        if (seq >= n_push_) epoch_ = reserved;
+        ++applied;
+        continue;
+      }
+      if (seq <= n_push_) continue;  // covered by the snapshot
+      Key max_key = 0;
+      bool keys_ok = true;
+      for (uint32_t i = 0; i < nkeys; ++i) {
+        if (keys[i] >= max_dim_) { keys_ok = false; break; }
+        if (keys[i] > max_key) max_key = keys[i];
+      }
+      if (!keys_ok) {
+        fprintf(stderr, "[distlr_kv_server] store: WAL record key exceeds "
+                "max_dim in %s; replay stops here\n", path.c_str());
+        break;
+      }
+      if (nkeys) EnsureCapacity(max_key);
+      if (rflags & kOptState) {
+        if (has_ftrl_) {
+          for (uint32_t i = 0; i < nkeys; ++i) {
+            z_[keys[i]] = vals[i];
+            nacc_[keys[i]] = vals[nkeys + i];
+          }
+        }
+      } else if (rflags & kInitPush) {
+        for (uint32_t i = 0; i < nkeys; ++i) weights_[keys[i]] = vals[i];
+        initialized_ = true;
+      } else {
+        for (uint32_t i = 0; i < nkeys; ++i) ApplyGrad(keys[i], vals[i]);
+      }
+      n_push_ = seq;
+      ++applied;
+    }
+    fclose(f);
+    return applied;
+  }
+
+  // Open the next WAL segment and swap it in.  Called under mu_ (or
+  // pre-threads): the swap must be atomic with the snapshot's state
+  // copy so the OLD segment holds exactly the records with seq <= the
+  // snapshot clock — the invariant that makes segment deletion safe.
+  // On open failure the previous segment stays active (appends
+  // continue; durability degrades by one rotation, loudly).
+  // Returns the previous fd for the caller to fsync+close OUTSIDE mu_,
+  // or -1 when there is none / the open failed.
+  int RotateWalLocked(uint64_t clock, uint16_t epoch) {
+    const std::string path = WalPath(clock);
+    const int fd = open(path.c_str(), O_WRONLY | O_CREAT | O_APPEND, 0644);
+    if (fd < 0) {
+      fprintf(stderr, "[distlr_kv_server] store: cannot open WAL segment "
+              "%s: %s\n", path.c_str(), strerror(errno));
+      return -1;
+    }
+    // The segment header is written only to a FRESH (or torn-header)
+    // file: a restart at the same push clock re-opens the previous
+    // incarnation's segment in append mode, and a second mid-file
+    // header would read back as a corrupt record.
+    struct stat st {};
+    bool ok = fstat(fd, &st) == 0;
+    if (ok && st.st_size < static_cast<off_t>(kWalHeaderSize)) {
+      ok = ftruncate(fd, 0) == 0;
+      uint8_t hdr[kWalHeaderSize];
+      const uint32_t magic = kWalMagic;
+      const uint16_t version = static_cast<uint16_t>(kStoreVersion);
+      std::memcpy(hdr + 0, &magic, 4);
+      std::memcpy(hdr + 4, &version, 2);
+      std::memcpy(hdr + 6, &epoch, 2);
+      ok = ok && WriteFull(fd, hdr, sizeof(hdr));
+    }
+    if (!ok) {
+      fprintf(stderr, "[distlr_kv_server] store: cannot open WAL segment "
+              "%s: %s\n", path.c_str(), strerror(errno));
+      close(fd);
+      return -1;
+    }
+    const int old = wal_fd_;
+    wal_fd_ = fd;
+    wal_start_clock_ = clock;
+    return old;
+  }
+
+  // Append one mutation record (caller holds mu_ — ordering on disk is
+  // exactly apply order).  write() puts the bytes in the page cache, so
+  // a SIGKILL after the reply loses nothing; the batched fsync in
+  // StoreLoop (group commit) is what bounds POWER-loss exposure to
+  // --store_wal_fsync seconds.
+  void WalAppend(uint64_t seq, uint8_t flags, Op op,
+                 const std::vector<Key>& keys,
+                 const std::vector<Val>& vals) {
+    if (wal_fd_ < 0) return;
+    const uint32_t nkeys = static_cast<uint32_t>(keys.size());
+    const size_t kb = keys.size() * sizeof(Key);
+    const size_t vb = vals.size() * sizeof(Val);
+    wal_buf_.resize(kWalRecordHeaderSize + kb + vb);
+    uint8_t* b = wal_buf_.data();
+    std::memset(b, 0, kWalRecordHeaderSize);
+    std::memcpy(b + 0, &seq, 8);
+    std::memcpy(b + 8, &nkeys, 4);
+    b[12] = flags;
+    b[13] = static_cast<uint8_t>(op);
+    if (kb) std::memcpy(b + kWalRecordHeaderSize, keys.data(), kb);
+    if (vb) std::memcpy(b + kWalRecordHeaderSize + kb, vals.data(), vb);
+    uint32_t crc = Crc32(0, b + kWalRecordHeaderSize, kb + vb);
+    std::memcpy(b + 16, &crc, 4);
+    if (!WriteFull(wal_fd_, b, wal_buf_.size())) {
+      // never-kill-the-rank: a full disk degrades durability, not
+      // service — but LOUDLY, and snapshots keep trying
+      fprintf(stderr, "[distlr_kv_server] store: WAL append failed (%s); "
+              "WAL DISABLED — snapshots continue\n", strerror(errno));
+      close(wal_fd_);
+      wal_fd_ = -1;
+      return;
+    }
+    wal_dirty_.store(true, std::memory_order_relaxed);
+  }
+
+  // Membership-epoch flip record: nkeys == 0, new epoch in `reserved`.
+  void WalAppendEpoch(uint16_t epoch) {
+    if (wal_fd_ < 0) return;
+    uint8_t b[kWalRecordHeaderSize];
+    std::memset(b, 0, sizeof(b));
+    std::memcpy(b + 0, &n_push_, 8);
+    b[12] = kForceInit;
+    b[13] = static_cast<uint8_t>(Op::kEpoch);
+    std::memcpy(b + 14, &epoch, 2);
+    const uint32_t crc = Crc32(0, b + kWalRecordHeaderSize, 0);
+    std::memcpy(b + 16, &crc, 4);
+    if (!WriteFull(wal_fd_, b, sizeof(b))) {
+      fprintf(stderr, "[distlr_kv_server] store: WAL append failed (%s); "
+              "WAL DISABLED — snapshots continue\n", strerror(errno));
+      close(wal_fd_);
+      wal_fd_ = -1;
+      return;
+    }
+    wal_dirty_.store(true, std::memory_order_relaxed);
+  }
+
+  // Group commit: one fsync per --store_wal_fsync window, only when
+  // records actually landed.  Runs on the store thread, which is the
+  // only thread that ever REPLACES wal_fd_ — so reading it here without
+  // mu_ is race-free.
+  void WalSync() {
+    if (wal_fd_ >= 0 && wal_dirty_.exchange(false)) fsync(wal_fd_);
+  }
+
+  void WalClose() {
+    if (wal_fd_ >= 0) {
+      fsync(wal_fd_);
+      close(wal_fd_);
+      wal_fd_ = -1;
+    }
+    if (store_dirfd_ >= 0) {
+      close(store_dirfd_);
+      store_dirfd_ = -1;
+    }
+  }
+
+  // One crash-consistent generation: copy state under mu_ (and rotate
+  // the WAL segment in the same critical section — see RotateWalLocked),
+  // then serialize + tmp + fsync + rename OUTSIDE the lock so handlers
+  // only ever pay for the memcpy, never the disk.
+  void WriteSnapshot() {
+    std::vector<Val> w, z, n;
+    uint64_t clock;
+    uint16_t epoch;
+    bool init;
+    int old_wal = -1;
+    {
+      std::lock_guard<std::mutex> lock(mu_);
+      if (n_push_ == last_snap_clock_ && epoch_ == last_snap_epoch_)
+        return;  // unchanged since the last generation: skip the write
+      w = weights_;
+      if (has_ftrl_) {
+        z = z_;
+        n = nacc_;
+      }
+      clock = n_push_;
+      epoch = epoch_;
+      init = initialized_;
+      if (wal_fd_ >= 0) old_wal = RotateWalLocked(clock, epoch);
+    }
+    if (old_wal >= 0) {
+      fsync(old_wal);  // the closed segment must be durable before the
+      close(old_wal);  // snapshot that supersedes part of it
+    }
+    const uint16_t sflags = static_cast<uint16_t>(
+        (has_ftrl_ ? kStoreFlagFtrl : 0) |
+        (init ? kStoreFlagInitialized : 0));
+    uint8_t hdr[kStoreHeaderSize];
+    FillSnapHeader(hdr, sflags, epoch, w.size(), clock, WallNowS());
+    uint32_t crc = Crc32(0, hdr, sizeof(hdr));
+    crc = Crc32(crc, w.data(), w.size() * sizeof(Val));
+    if (has_ftrl_) {
+      crc = Crc32(crc, z.data(), z.size() * sizeof(Val));
+      crc = Crc32(crc, n.data(), n.size() * sizeof(Val));
+    }
+    std::memcpy(hdr + 12, &crc, 4);
+    const int gen = next_gen_;
+    const std::string final_path = SnapPath(gen);
+    const std::string tmp_path = final_path + ".tmp";
+    const int fd = open(tmp_path.c_str(),
+                        O_WRONLY | O_CREAT | O_TRUNC, 0644);
+    bool ok = fd >= 0 && WriteFull(fd, hdr, sizeof(hdr)) &&
+              WriteFull(fd, w.data(), w.size() * sizeof(Val));
+    if (ok && has_ftrl_) {
+      ok = WriteFull(fd, z.data(), z.size() * sizeof(Val)) &&
+           WriteFull(fd, n.data(), n.size() * sizeof(Val));
+    }
+    ok = ok && fsync(fd) == 0;
+    if (fd >= 0) close(fd);
+    ok = ok && rename(tmp_path.c_str(), final_path.c_str()) == 0;
+    if (!ok) {
+      fprintf(stderr, "[distlr_kv_server] store: snapshot write to %s "
+              "FAILED (%s); previous generations remain\n",
+              final_path.c_str(), strerror(errno));
+      return;
+    }
+    if (store_dirfd_ >= 0) fsync(store_dirfd_);  // make the rename stick
+    gen_clock_[gen] = clock;
+    last_snap_clock_ = clock;
+    last_snap_epoch_ = epoch;
+    next_gen_ = 1 - gen;
+    DeleteStaleSegments();
+  }
+
+  // WAL retention: a segment named wal-C holds exactly seq in
+  // (C, next rotation's clock], so any segment with C < min(on-disk
+  // generation clocks) is fully covered by BOTH generations and can go.
+  // wal_start_clock_ joins the min as a belt-and-braces guard for the
+  // rotation-open-failed path, where the active segment's name is older
+  // than the newest snapshot.
+  void DeleteStaleSegments() {
+    uint64_t boundary = ~0ull;
+    for (uint64_t c : gen_clock_) boundary = std::min(boundary, c);
+    if (wal_fd_ >= 0) boundary = std::min(boundary, wal_start_clock_);
+    if (boundary == 0 || boundary == ~0ull) return;
+    for (const auto& [clock, path] : WalSegments()) {
+      if (clock < boundary) unlink(path.c_str());
+    }
+  }
+
+  void StoreLoop() {
+    double elapsed = 0.0;
+    double fsync_elapsed = 0.0;
+    while (!shutdown_.load()) {
+      // 100ms slices so shutdown (and ps-ctl's SIGUSR1 "snapshot now")
+      // are prompt even with long intervals; this also floors the
+      // effective WAL group-commit window at 100ms
+      usleep(100 * 1000);
+      elapsed += 0.1;
+      fsync_elapsed += 0.1;
+      if (fsync_elapsed + 1e-9 >= store_wal_fsync_s_) {
+        WalSync();
+        fsync_elapsed = 0.0;
+      }
+      if (g_store_snap_req.exchange(false) ||
+          elapsed + 1e-9 >= store_interval_s_) {
+        WriteSnapshot();
+        elapsed = 0.0;
+      }
+    }
+    WalSync();
+  }
+
+  static void* StoreTrampoline(void* p) {
+    auto* self = static_cast<KVServer*>(p);
+    self->StoreLoop();
+    self->store_loop_done_.store(true);
+    return nullptr;
+  }
+
   int port_;
   int num_workers_;
   float lr_;
@@ -1315,6 +1980,29 @@ class KVServer {
   std::string trace_journal_;
   std::string prof_journal_;
   double prof_window_s_;
+  //: durable store config (--store_dir family; formats in kv_protocol.h)
+  std::string store_dir_;
+  double store_interval_s_;
+  bool store_wal_;
+  double store_wal_fsync_s_;
+  int store_dirfd_ = -1;
+  //: active WAL segment fd — handlers append under mu_; ONLY the store
+  //: thread (and startup, pre-threads) replaces it, also under mu_, so
+  //: the store thread may read it lock-free (WalSync)
+  int wal_fd_ = -1;
+  uint64_t wal_start_clock_ = 0;
+  std::vector<uint8_t> wal_buf_;  // append scratch (guarded by mu_)
+  std::atomic<bool> wal_dirty_{false};
+  //: the detached persistence loop has exited (true when never started)
+  std::atomic<bool> store_loop_done_{true};
+  //: snapshot bookkeeping — store-thread-only after startup (the final
+  //: clean-shutdown write happens after store_loop_done_ is observed)
+  int next_gen_ = 0;
+  uint64_t last_snap_clock_ = ~0ull;
+  uint16_t last_snap_epoch_ = 0;
+  uint64_t gen_clock_[kStoreGenerations] = {~0ull, ~0ull};
+  //: generations rejected at load (corrupt/torn) — surfaced on stderr
+  uint64_t store_corrupt_ = 0;
   FILE* prof_f_ = nullptr;
   // per-handler thread-CPU totals, microseconds (atomic: read by
   // HandleStats and the profiler thread without mu_)
@@ -1528,11 +2216,46 @@ int main(int argc, char** argv) {
       return 2;
     }
   }
+  // Durable store (--store_dir): background persistence thread writing
+  // crash-consistent CRC32'd snapshot generations, plus an optional
+  // per-push WAL for RPO≈0 — formats in kv_protocol.h, Python reader
+  // distlr_tpu/ps/store.py.  Empty (the default) = volatile, the
+  // pre-store behavior byte for byte.
+  const std::string store_dir = ArgS(argc, argv, "store_dir", "");
+  const double store_interval = ArgF(argc, argv, "store_interval", 5.0);
+  const bool store_wal = Arg(argc, argv, "store_wal", 0) != 0;
+  const double store_wal_fsync = ArgF(argc, argv, "store_wal_fsync", 0.1);
+  if (store_interval <= 0.0) {
+    std::fprintf(stderr, "[distlr_kv_server] --store_interval must be "
+                 "positive (got %g)\n", store_interval);
+    return 2;
+  }
+  if (store_wal_fsync <= 0.0) {
+    std::fprintf(stderr, "[distlr_kv_server] --store_wal_fsync must be "
+                 "positive (got %g)\n", store_wal_fsync);
+    return 2;
+  }
+  if (store_wal && store_dir.empty()) {
+    std::fprintf(stderr, "[distlr_kv_server] --store_wal=1 requires "
+                 "--store_dir\n");
+    return 2;
+  }
+  if (store_wal && sync) {
+    // A sync round's pre-barrier merge state dies with the worker
+    // connections on any crash, so per-push replay has no meaning
+    // there; snapshots (committed-round state) are the sync story.
+    std::fprintf(stderr, "[distlr_kv_server] --store_wal=1 requires "
+                 "--sync=0 (async): sync-round merge state has no "
+                 "per-push replay semantics\n");
+    return 2;
+  }
   distlr::KVServer server(port, num_workers, static_cast<uint64_t>(dim),
                           static_cast<float>(lr), sync, last_gradient,
                           bind_any, max_dim, opt, fp, compress,
                           trace_journal, prof_journal, prof_window,
                           static_cast<uint16_t>(epoch),
-                          std::move(opt_segments));
+                          std::move(opt_segments),
+                          store_dir, store_interval, store_wal,
+                          store_wal_fsync);
   return server.Run();
 }

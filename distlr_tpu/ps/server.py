@@ -56,8 +56,35 @@ _SERVER_CPU = _reg.gauge(
 _SUP_EVENTS = _reg.counter(
     "distlr_ps_supervisor_events_total",
     "supervisor audit-trail events (respawned/reseeded/seeded-zeros/"
-    "gave-up/respawn-failed)",
+    "gave-up/respawn-failed/reseeded-from-store/store-stale/"
+    "store-corrupt-fallback)",
     labelnames=("event",),
+)
+#: Durable-store health, scanned from each rank's on-disk state
+#: (ps/store.py) by the supervisor's snapshot cycles when the group
+#: runs with a --store_dir.
+_STORE_SNAPSHOT_AGE = _reg.gauge(
+    "distlr_ps_store_snapshot_age_seconds",
+    "age of this rank's newest VALID on-disk snapshot generation (the "
+    "worst-case RPO window when the WAL is off)",
+    labelnames=("rank",),
+)
+_STORE_BYTES = _reg.gauge(
+    "distlr_ps_store_bytes",
+    "on-disk durable-store footprint per rank",
+    labelnames=("rank", "kind"),
+)
+_STORE_WAL_LAG = _reg.gauge(
+    "distlr_ps_store_wal_lag_records",
+    "intact WAL records past this rank's newest valid snapshot — the "
+    "replay depth a cold restart pays (snapshot lag, not data loss)",
+    labelnames=("rank",),
+)
+_STORE_CORRUPT = _reg.gauge(
+    "distlr_ps_store_corrupt_generations",
+    "snapshot generations on disk currently rejected as torn/corrupt "
+    "(>0 means the store is one failure from losing its fallback)",
+    labelnames=("rank",),
 )
 _SNAPSHOT_SECONDS = _reg.histogram(
     "distlr_ps_supervisor_snapshot_seconds",
@@ -202,6 +229,10 @@ class ServerGroup:
         prof_window_s: float | None = None,
         epoch: int = 1,
         opt_segments: list[tuple[int, str]] | None = None,
+        store_dir: str | None = None,
+        store_interval_s: float = 5.0,
+        store_wal: bool = False,
+        store_wal_fsync_s: float = 0.1,
     ):
         if optimizer not in ("sgd", "ftrl", "signsgd"):
             raise ValueError(
@@ -230,6 +261,22 @@ class ServerGroup:
             if prev != dim:
                 raise ValueError(
                     f"opt_segments must cover [0, dim={dim}), got end {prev}")
+        if store_wal and not store_dir:
+            raise ValueError(
+                "store_wal requires store_dir (the WAL lives in the "
+                "same per-rank store directory)")
+        if store_wal and sync:
+            # mirrors the native server's own exit-2 validation: a sync
+            # round's merge buffer has no per-push replay semantics
+            raise ValueError(
+                "store_wal requires an async (sync=False) group — "
+                "sync-round merge state has no per-push replay semantics")
+        if store_dir and store_interval_s <= 0:
+            raise ValueError(
+                f"store_interval_s must be positive, got {store_interval_s}")
+        if store_wal and store_wal_fsync_s <= 0:
+            raise ValueError(
+                f"store_wal_fsync_s must be positive, got {store_wal_fsync_s}")
         if optimizer != "sgd" and last_gradient:
             # Q1 is a reference-SGD parity quirk; there is no "last
             # worker's FTRL step / majority vote / W" reference behavior
@@ -307,6 +354,17 @@ class ServerGroup:
             # the spawn command line byte-identical.
             prof_journal_dir=prof_journal_dir,
             prof_window_s=prof_window_s,
+            # durable store (ISSUE 20): each rank persists crash-
+            # consistent snapshots (+ optional push WAL) of its slice
+            # under <store_dir>/rank-<r>/ and self-recovers from them at
+            # spawn — including supervisor respawns, which then skip the
+            # RAM re-seed when the disk state is at least as new.  None
+            # keeps the spawn command line byte-identical (RAM-only,
+            # the prior behavior).
+            store_dir=store_dir,
+            store_interval_s=store_interval_s,
+            store_wal=store_wal,
+            store_wal_fsync_s=store_wal_fsync_s,
         )
         # serializes respawn() against stop() (supervisor thread vs
         # teardown) and marks teardown so a racing respawn becomes a no-op
@@ -341,6 +399,14 @@ class ServerGroup:
         """Global key slice ``[lo, hi)`` owned by server ``rank`` in the
         CURRENT layout."""
         return self.ranges[rank]
+
+    def store_rank_dir(self, rank: int) -> str:
+        """Rank ``rank``'s durable-store directory (requires a group
+        ``store_dir``) — where its snapshot generations and WAL
+        segments live."""
+        if not self._args["store_dir"]:
+            raise ValueError("group has no store_dir")
+        return os.path.join(self._args["store_dir"], f"rank-{rank}")
 
     def _local_opt_segments(self, lo: int, hi: int) -> str:
         """--opt_segments value for a rank owning global [lo, hi): the
@@ -416,6 +482,22 @@ class ServerGroup:
                        + os.path.join(d, f"kvserver-{rank}.jsonl"))
             if self._args["prof_window_s"] is not None:
                 cmd.append(f"--prof_window={self._args['prof_window_s']}")
+        if self._args["store_dir"]:
+            # per-rank subdirectory: ranks own disjoint key slices, so
+            # their snapshot/WAL files must never collide.  The server
+            # RECOVERS from whatever is already there before announcing
+            # PORT — a cold group restart with the same store_dir is the
+            # whole-fleet disaster-recovery path.
+            d = self.store_rank_dir(rank)
+            os.makedirs(d, exist_ok=True)
+            cmd.append(f"--store_dir={d}")
+            if self._args["store_interval_s"] != 5.0:
+                cmd.append(f"--store_interval={self._args['store_interval_s']}")
+            if self._args["store_wal"]:
+                cmd.append("--store_wal=1")
+                if self._args["store_wal_fsync_s"] != 0.1:
+                    cmd.append(
+                        f"--store_wal_fsync={self._args['store_wal_fsync_s']}")
         # DISTLR_NATIVE_VARIANT spawns ride the sanitizer environment
         # (suppressions wired in, caller's log_path preserved); the
         # standard build passes env=None — the spawn stays byte-
@@ -451,11 +533,35 @@ class ServerGroup:
 
             # one proxy link per rank, targeting the REAL ports — a
             # supervisor respawn reuses the original port, so the link
-            # stays valid across server deaths
-            self.chaos = ChaosFabric(self.direct_hosts, self._chaos_plan)
+            # stays valid across server deaths.  The group owns the
+            # pids, so it is also the kill-fault executor (ISSUE 20:
+            # plan kind "kill" SIGKILLs a rank or the whole group).
+            self.chaos = ChaosFabric(self.direct_hosts, self._chaos_plan,
+                                     killer=self._chaos_kill)
             self._chaos_links = list(self.chaos.links)
         _MEMBERSHIP_SERVERS.set(self.num_servers)
         return self
+
+    def _chaos_kill(self, target: str) -> None:
+        """Kill-fault executor for the embedded chaos fabric (plan kind
+        ``kill``, ISSUE 20): SIGKILL one rank's native server
+        (``"rank:N"``) or every rank (``"group"``).  A supervised group
+        respawns the victims and re-seeds them — from the durable store
+        when ``store_dir`` is armed — which is exactly the power-loss
+        drill the DR acceptance test runs."""
+        with self._lock:
+            if target == "group":
+                victims = list(self.procs)
+            else:
+                rank = int(target.split(":", 1)[1])
+                if rank >= len(self.procs):
+                    log.warning("chaos kill target %r: no such rank",
+                                target)
+                    return
+                victims = [self.procs[rank]]
+        for proc in victims:
+            if proc.poll() is None:
+                proc.kill()
 
     def respawn(self, rank: int) -> bool:
         """Restart a dead server process on its ORIGINAL port (so the
@@ -508,6 +614,12 @@ class ServerGroup:
             raise ValueError(
                 "elastic resize supports async (Hogwild) groups only — "
                 "a sync BSP round cannot straddle a membership change")
+        if self._args["store_dir"]:
+            raise ValueError(
+                "elastic resize of a durable (store_dir) group is not "
+                "supported: the per-rank on-disk slices would no longer "
+                "match the new layout — stop the group, clear or migrate "
+                "the store, and restart at the new size")
         return plan_reshard(
             self.dim, self.ranges, new_num_servers,
             alive=[p.poll() is None for p in self.procs],
@@ -640,13 +752,22 @@ class ServerGroup:
         one.  Elastic groups swap the process list mid-wait
         (commit_resize): a RETIRED rank's exit must not end the wait,
         so the loop re-checks whether the layout moved under it and
-        waits the new ranks too."""
+        waits the new ranks too.  Respawns (supervisor, or the ps-ctl
+        RESTORE verb) replace list ELEMENTS in place instead — so the
+        loop also re-checks liveness of the current processes before
+        concluding the group is done."""
         while True:
             snapshot = self.procs
             for p in list(snapshot):
                 p.wait()
-            if self.procs is snapshot:
-                return
+            if self.procs is not snapshot:
+                continue  # resized mid-wait: wait the new layout too
+            with self._lock:
+                if self._stopped or all(p.poll() is not None
+                                        for p in self.procs):
+                    return
+            # an exited rank was respawned in place while we waited —
+            # the group is still serving; go around again
 
     def stop(self) -> None:
         with self._lock:
@@ -746,7 +867,12 @@ class ServerSupervisor:
         self._paused = threading.Event()
         self._thread: threading.Thread | None = None
         #: (monotonic time, rank, event) audit trail — "respawned",
-        #: "reseeded", "seeded-zeros", "gave-up", "respawn-failed"
+        #: "reseeded", "seeded-zeros", "gave-up", "respawn-failed";
+        #: durable-store groups add "reseeded-from-store" (disk state
+        #: at least as new as the RAM snapshot — re-seed skipped),
+        #: "store-stale" (RAM newer; re-seeded over the disk recovery)
+        #: and "store-corrupt-fallback" (a snapshot generation was
+        #: rejected; recovery used the surviving generation/WAL)
         self.events: list[tuple[float, int, str]] = []
 
     def _record_event(self, when: float, rank: int, event: str) -> None:
@@ -881,9 +1007,70 @@ class ServerSupervisor:
                 # and OTHER ranks' captures proceed regardless
                 continue
         self._snapshot_at = time.monotonic()
+        self._refresh_store_metrics()
+
+    def _refresh_store_metrics(self) -> None:
+        """Mirror each rank's on-disk store health into the registry
+        (``distlr_ps_store_*``) — piggybacks on the snapshot cadence so
+        the scan cost rides an interval that already exists."""
+        if not self._group._args["store_dir"]:
+            return
+        from distlr_tpu.ps import store as ps_store  # noqa: PLC0415
+
+        now = time.time()
+        for r in range(self._group.num_servers):
+            try:
+                rs = ps_store.scan_rank(self._group.store_rank_dir(r))
+            except OSError:
+                continue
+            best = rs.best
+            if best is not None:
+                _STORE_SNAPSHOT_AGE.labels(rank=r).set(
+                    max(0.0, now - best.wall_time))
+            _STORE_BYTES.labels(rank=r, kind="snapshot").set(
+                rs.snapshot_bytes)
+            _STORE_BYTES.labels(rank=r, kind="wal").set(rs.wal_bytes)
+            _STORE_WAL_LAG.labels(rank=r).set(
+                max(0, rs.recovered_clock - rs.snapshot_clock))
+            _STORE_CORRUPT.labels(rank=r).set(rs.corrupt)
 
     def _reseed(self, rank: int) -> bool:
         lo, hi = self._group.key_range(rank)
+        if self._group._args["store_dir"]:
+            # The respawned process already self-recovered from its
+            # on-disk store (LoadStore runs before the PORT announce).
+            # Pushing the RAM snapshot over it would REGRESS the rank
+            # whenever the disk is newer — which it usually is: the
+            # native store interval plus the WAL beat the supervisor's
+            # pull-based capture.  Prefer whichever clock is ahead.
+            from distlr_tpu.ps import store as ps_store  # noqa: PLC0415
+
+            rs = ps_store.scan_rank(self._group.store_rank_dir(rank))
+            now = time.monotonic()
+            if rs.corrupt:
+                # a generation was rejected (torn/corrupt) — recovery
+                # still proceeded from the surviving generation/WAL,
+                # but the fallback must be LOUD, never silent
+                self._record_event(now, rank, "store-corrupt-fallback")
+            disk_clock = rs.recovered_clock
+            best = rs.best
+            has_disk = disk_clock > 0 or (best is not None
+                                          and best.initialized)
+            ram_clock = (self._snap_pushes[rank]
+                         if self._snap_valid[rank] else -1)
+            if has_disk and disk_clock >= ram_clock:
+                self._record_event(now, rank, "reseeded-from-store")
+                log.warning(
+                    "supervisor: server %d recovered from its store "
+                    "(push_clock=%d >= RAM snapshot %d); skipping re-seed",
+                    rank, disk_clock, ram_clock)
+                # force the next snapshot cycle to re-pull this range
+                self._snap_pushes[rank] = -1
+                return True
+            if has_disk:
+                # disk exists but the RAM snapshot is ahead (e.g. a very
+                # long store interval): reseed below, audited
+                self._record_event(now, rank, "store-stale")
         if self._snapshot is not None and self._snap_valid[rank]:
             vals, event = self._snapshot[lo:hi], "reseeded"
         else:

@@ -1363,6 +1363,13 @@ def run_ps_local(cfg: Config, *, eval_fn=None, save=False, resume=False,
             os.path.join(cfg.obs_run_dir.split(os.pathsep)[0], "profiles")
             if cfg.obs_run_dir and cfg.prof_hz > 0 else None),
         prof_window_s=cfg.prof_window_s,
+        # durable store (ISSUE 20): ranks persist + self-recover their
+        # slices under <ps_store_dir>/rank-<r>/; with supervise_servers
+        # the supervisor prefers the disk state over its RAM snapshot
+        store_dir=cfg.ps_store_dir,
+        store_interval_s=cfg.ps_store_interval_s,
+        store_wal=cfg.ps_store_wal,
+        store_wal_fsync_s=cfg.ps_store_wal_fsync_s,
     )
     with contextlib.ExitStack() as stack:
         stack.enter_context(group)

@@ -36,7 +36,7 @@ REPO = repo_root()
 
 
 def _wire_fixture(tmp_path, mutate_header=None, mutate_client=None,
-                  mutate_spec=None):
+                  mutate_spec=None, mutate_store=None):
     """A minimal tree the wire pass can run against: the real header +
     mirrors (the protocol model included — it is a framing site like
     any other), with optional seeded mutations."""
@@ -44,6 +44,7 @@ def _wire_fixture(tmp_path, mutate_header=None, mutate_client=None,
         os.makedirs(tmp_path / rel, exist_ok=True)
     for rel in ("distlr_tpu/ps/wire.py", "distlr_tpu/ps/client.py",
                 "distlr_tpu/ps/membership.py", "distlr_tpu/ps/server.py",
+                "distlr_tpu/ps/store.py",
                 "distlr_tpu/compress/codecs.py",
                 "distlr_tpu/chaos/proxy.py",
                 "distlr_tpu/analysis/protocol/spec.py",
@@ -63,6 +64,9 @@ def _wire_fixture(tmp_path, mutate_header=None, mutate_client=None,
     if mutate_spec:
         spath = tmp_path / "distlr_tpu/analysis/protocol/spec.py"
         spath.write_text(mutate_spec(spath.read_text()))
+    if mutate_store:
+        spath = tmp_path / "distlr_tpu/ps/store.py"
+        spath.write_text(mutate_store(spath.read_text()))
     return str(tmp_path)
 
 
@@ -129,6 +133,37 @@ class TestWireParity:
         assert any(
             k.startswith("raw-literal:distlr_tpu/analysis/protocol/"
                          "spec.py:kMagic") for k in keys), keys
+
+    def test_seeded_store_constant_drift_fails(self, tmp_path):
+        """ISSUE 20 satellite: the durable-store disk format is linted
+        like the wire format — a ps/store.py constant that drifts from
+        the native writer's header fails the parity pass."""
+        root = _wire_fixture(
+            tmp_path,
+            mutate_store=lambda s: s.replace(
+                "STORE_VERSION = 1", "STORE_VERSION = 2"))
+        keys = {f.key for f in wire_parity.check(root=root)}
+        assert "store-value-mismatch:kStoreVersion" in keys, keys
+
+    def test_seeded_store_struct_size_drift_fails(self, tmp_path):
+        """A struct format that no longer packs to the header's size
+        constant (a field added on one side only) is caught too."""
+        root = _wire_fixture(
+            tmp_path,
+            mutate_store=lambda s: s.replace(
+                'WAL_RECORD_STRUCT = struct.Struct("<QIBBHI")',
+                'WAL_RECORD_STRUCT = struct.Struct("<QIBBHII")'))
+        keys = {f.key for f in wire_parity.check(root=root)}
+        assert any(k.startswith("store-struct-size:WAL_RECORD_STRUCT")
+                   for k in keys), keys
+
+    def test_seeded_store_mirror_deletion_fails(self, tmp_path):
+        """Deleting ps/store.py while the header still defines store
+        constants is a loud finding, not a silently skipped pass."""
+        root = _wire_fixture(tmp_path)
+        os.remove(os.path.join(root, "distlr_tpu/ps/store.py"))
+        keys = {f.key for f in wire_parity.check(root=root)}
+        assert "store-mirror-missing" in keys, keys
 
 
 # ---------------------------------------------------------------------------

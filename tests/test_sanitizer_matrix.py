@@ -114,11 +114,77 @@ _DRIVER = textwrap.dedent("""
 """)
 
 
+#: the ISSUE-20 workload: the durable store's background persistence
+#: thread (snapshot writer) and WAL group-commit thread run NEXT TO the
+#: op threads the whole time, with SIGUSR1-forced snapshots landing
+#: mid-WAL-append, then a kill -9 + respawn so the recovery path
+#: (snapshot load + WAL replay) executes under the same sanitizer.
+_STORE_DRIVER = textwrap.dedent("""
+    import os
+    import signal
+    import threading
+    import numpy as np
+    from distlr_tpu.ps import KVWorker, ServerGroup
+
+    dim, workers, steps = 64, 3, 15
+    store = os.path.abspath("store")
+    errors = []
+    with ServerGroup(2, workers, dim, learning_rate=0.1, sync=False,
+                     store_dir=store, store_interval_s=0.1,
+                     store_wal=True, store_wal_fsync_s=0.02) as group:
+        def run(rank):
+            with KVWorker(group.hosts, dim, client_id=rank,
+                          timeout_ms=60_000, sync_group=False) as kv:
+                if rank == 0:
+                    kv.push_init(np.zeros(dim, np.float32))
+                kv.barrier(0)
+                for i in range(steps):
+                    w = kv.pull()
+                    kv.push(w * 0.01 + 1.0)
+                    if i == steps // 2 and rank == 0:
+                        # immediate snapshot while the WAL commit
+                        # thread is appending — the cross-thread pair
+                        # this test exists to race
+                        for p in group.procs:
+                            os.kill(p.pid, signal.SIGUSR1)
+                    kv.stats(rank % 2)
+                kv.barrier(1)
+
+        def guarded(rank):
+            try:
+                run(rank)
+            except Exception as e:
+                errors.append(e)
+                group.stop()
+
+        ts = [threading.Thread(target=guarded, args=(r,), daemon=True)
+              for r in range(workers)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=300)
+        assert not errors, errors[0]
+        assert not any(t.is_alive() for t in ts), "worker wedged"
+        # power loss + cold restart: recovery runs instrumented too
+        group.procs[0].kill()
+        group.procs[0].wait()
+        assert group.respawn(0)
+        with KVWorker(group.hosts, dim, client_id=9,
+                      timeout_ms=60_000, sync_group=False) as kv:
+            assert kv.pull().shape == (dim,)
+            kv.shutdown_servers()
+        group.wait()
+        assert [p.returncode for p in group.procs] == [0, 0], \\
+            [p.returncode for p in group.procs]
+    print("DRIVER_OK")
+""")
+
+
 def _run_variant(variant: str, tmp_path, *, preload: str | None = None,
-                 timeout: int = 300) -> None:
+                 timeout: int = 300, driver_src: str = _DRIVER) -> None:
     _build(variant)
     driver = tmp_path / "driver.py"
-    driver.write_text(_DRIVER)
+    driver.write_text(driver_src)
     log_base = str(tmp_path / f"{variant}_report")
     env = os.environ.copy()
     env.pop("LD_PRELOAD", None)
@@ -164,6 +230,18 @@ def test_tsan_client_and_server_e2e(tmp_path):
     if rt is None:
         pytest.skip("toolchain has no libtsan runtime")
     _run_variant("tsan", tmp_path, preload=rt)
+
+
+@needs_toolchain
+def test_tsan_server_store_e2e(tmp_path):
+    """ISSUE 20: the durable store's snapshot + WAL threads under TSan
+    — persistence armed, SIGUSR1 snapshots racing WAL appends, then a
+    kill -9 respawn whose recovery (snapshot load + WAL replay) runs
+    instrumented too.  Zero unsuppressed reports."""
+    rt = _libtsan()
+    if rt is None:
+        pytest.skip("toolchain has no libtsan runtime")
+    _run_variant("tsan", tmp_path, preload=rt, driver_src=_STORE_DRIVER)
 
 
 @needs_toolchain
